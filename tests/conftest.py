@@ -1,0 +1,14 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX import.
+
+Mirrors the reference's simulated-topology strategy (SURVEY.md §4.2):
+multi-"node" structure is exercised without real multi-chip hardware, via
+XLA's host-platform device partitioning.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

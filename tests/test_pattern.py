@@ -1,0 +1,163 @@
+"""Pure-layer tests: placements, node maps, robin maps, 2-level metadata.
+
+Oracle values are derived from the reference formulas (cited per test)."""
+
+import numpy as np
+import pytest
+
+from tpu_aggcomm.core.meta import aggregator_meta_information
+from tpu_aggcomm.core.pattern import (AggregatorPattern, Direction, Placement,
+                                      create_aggregator_list, node_robin_map,
+                                      reorder_ranklist)
+from tpu_aggcomm.core.topology import static_node_assignment
+
+
+class TestPlacement:
+    def test_first(self):
+        # mpi_test.c:1971-1977 (type 0)
+        np.testing.assert_array_equal(create_aggregator_list(32, 5, 0),
+                                      [0, 1, 2, 3, 4])
+
+    def test_spread_readme_config(self):
+        # README config: 32 procs, 14 aggregators, type 1 (default)
+        lst = create_aggregator_list(32, 14, 1)
+        assert len(lst) == 14
+        assert len(set(lst.tolist())) == 14
+        assert all(0 <= r < 32 for r in lst)
+        # reference formula: remainder = 32/14 = 2, ceiling = 3, floor = 2
+        # i<2: 3i ; else: 6 + 2(i-2)
+        expect = [0, 3, 6, 8, 10, 12, 14, 16, 18, 20, 22, 24, 26, 28]
+        np.testing.assert_array_equal(lst, expect)
+
+    def test_spread_even_divide(self):
+        lst = create_aggregator_list(32, 4, 1)
+        # remainder = 8 >= cb_nodes, so all blocks use ceiling = 8
+        np.testing.assert_array_equal(lst, [0, 8, 16, 24])
+
+    def test_spread_shift(self):
+        lst1 = create_aggregator_list(64, 4, 1)
+        lst2 = create_aggregator_list(64, 4, 2)
+        np.testing.assert_array_equal(lst2, (lst1 - 16) % 64)
+
+    def test_node_robin_placement(self):
+        # mpi_test.c:1991-2003: stride proc_node, wrap to lap%proc_node+1
+        lst = create_aggregator_list(16, 6, 3, proc_node=4)
+        np.testing.assert_array_equal(lst, [0, 4, 8, 12, 1, 5])
+
+    def test_all_placements_unique_and_bounded(self):
+        for procs, cb in [(8, 3), (32, 14), (64, 16), (17, 5)]:
+            for t in [0, 1, 2]:
+                lst = create_aggregator_list(procs, cb, t)
+                assert len(set(lst.tolist())) == cb, (procs, cb, t)
+                assert all(0 <= r < procs for r in lst)
+
+
+class TestRobinMap:
+    def test_stride(self):
+        # mpi_test.c:1116-1133: procs=8, proc_node=2 -> 0,2,4,6,1,3,5,7
+        np.testing.assert_array_equal(node_robin_map(8, 2),
+                                      [0, 2, 4, 6, 1, 3, 5, 7])
+
+    def test_permutation(self):
+        for procs, pn in [(8, 2), (12, 3), (16, 4), (10, 5)]:
+            m = node_robin_map(procs, pn)
+            assert sorted(m.tolist()) == list(range(procs))
+
+
+class TestNodeAssignment:
+    def test_contiguous(self):
+        # lustre_driver_test.c:402-427 (type 0)
+        na = static_node_assignment(10, 4, 0)
+        assert na.nnodes == 3
+        np.testing.assert_array_equal(na.node_of,
+                                      [0, 0, 0, 0, 1, 1, 1, 1, 2, 2])
+        np.testing.assert_array_equal(na.proxies, [0, 4, 8])
+        np.testing.assert_array_equal(na.node_sizes, [4, 4, 2])
+
+    def test_round_robin(self):
+        # lustre_driver_test.c:365-401 (type 1): nprocs=10, nprocs_node=4
+        # remainder=2, temp=2, nrecvs=3; ranks 0..5 cycle 3 nodes, 6..9 cycle 2
+        na = static_node_assignment(10, 4, 1)
+        assert na.nnodes == 3
+        np.testing.assert_array_equal(na.node_of,
+                                      [0, 1, 2, 0, 1, 2, 0, 1, 0, 1])
+        np.testing.assert_array_equal(na.local_ranks(0), [0, 3, 6, 8])
+        np.testing.assert_array_equal(na.local_ranks(2), [2, 5])
+        np.testing.assert_array_equal(na.proxies, [0, 1, 2])
+
+    def test_even_divide(self):
+        na = static_node_assignment(16, 4, 0)
+        assert na.nnodes == 4
+        np.testing.assert_array_equal(na.node_sizes, [4, 4, 4, 4])
+        assert na.proxy_of(13) == 12
+        assert na.is_proxy(12) and not na.is_proxy(13)
+
+
+class TestReorderRanklist:
+    def test_round_robin_across_nodes(self):
+        # lustre_driver_test.c:1374-1414
+        na = static_node_assignment(8, 4, 0)  # nodes: {0-3}, {4-7}
+        ranks = np.array([0, 1, 2, 4])
+        out = reorder_ranklist(na.node_of, ranks, na.nnodes)
+        # deal alternating node0, node1, node0, ... -> 0, 4, 1, 2
+        np.testing.assert_array_equal(out, [0, 4, 1, 2])
+
+
+class TestAggregatorMeta:
+    def test_even_spread_mode0(self):
+        # lustre_driver_test.c:170-179: co local aggs evenly over node ranks
+        na = static_node_assignment(8, 4, 0)
+        meta = aggregator_meta_information(na, np.array([0, 4]), co=2, mode=0)
+        # node 0 ranks [0,1,2,3]: lnp=4, co2=2 -> aggs at ranks[0], ranks[2]
+        np.testing.assert_array_equal(meta.local_aggregators, [0, 2, 4, 6])
+        # binding: every rank bound to an agg on its own node; aggs own themselves
+        assert meta.owner_of[0] == 0 and meta.owner_of[2] == 2
+        assert all(meta.owner_of[r] in (0, 2) for r in range(4))
+        assert all(meta.owner_of[r] in (4, 6) for r in range(4, 8))
+
+    def test_superset_mode1(self):
+        # lustre_driver_test.c:144-167: local aggs ⊇ node's global aggs
+        na = static_node_assignment(8, 4, 0)
+        meta = aggregator_meta_information(na, np.array([1, 3, 5]), co=2, mode=1)
+        assert 1 in meta.local_aggregators and 3 in meta.local_aggregators
+        assert 5 in meta.local_aggregators
+        # node 0 has 2 global aggs -> exactly those; node 1 has 1, topped to 2
+        node1 = [a for a in meta.local_aggregators if a >= 4]
+        assert len(node1) == 2 and 5 in node1
+
+    def test_every_rank_bound(self):
+        for co in [1, 2, 3]:
+            for mode in [0, 1]:
+                na = static_node_assignment(12, 4, 0)
+                meta = aggregator_meta_information(na, np.array([0, 6]), co=co,
+                                                   mode=mode)
+                assert (meta.owner_of >= 0).all()
+                # owner is always on the same node
+                for r in range(12):
+                    assert na.node_of[meta.owner_of[r]] == na.node_of[r]
+                # every local aggregator owns itself
+                for a in meta.local_aggregators:
+                    assert meta.owner_of[a] == a
+
+
+class TestPattern:
+    def test_basic(self):
+        p = AggregatorPattern(32, 14, data_size=2048)
+        assert p.is_agg.sum() == 14
+        assert p.agg_index[int(p.rank_list[3])] == 3
+        assert p.total_bytes == 32 * 14 * 2048
+        assert p.reversed().direction is Direction.MANY_TO_ALL
+
+    def test_dense_counts(self):
+        p = AggregatorPattern(8, 3, data_size=64)
+        send, recv = p.dense_counts()
+        assert send.sum() == 8 * 3 * 64
+        np.testing.assert_array_equal(send.T, recv)
+        # only aggregator columns are nonzero
+        assert (send[:, p.rank_list] == 64).all()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AggregatorPattern(4, 5)
+        with pytest.raises(ValueError):
+            AggregatorPattern(0, 0)

@@ -1,0 +1,25 @@
+"""tpu_aggcomm — TPU-native aggregator-communication benchmark framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the reference
+MPI benchmark harness (QiaoK/MPI-Asynchronous-Communication-Test): it models
+ROMIO-style aggregator traffic — all ranks exchanging with a subset of
+``cb_nodes`` aggregator ranks, in both directions — and races ~22 competing
+communication schedules under one CLI with per-phase timers, max-over-ranks
+reduction, CSV reporting, and deterministic-fill verification.
+
+Layering (see SURVEY.md §7):
+
+- :mod:`tpu_aggcomm.core`      pure pattern / topology / schedule layer
+- :mod:`tpu_aggcomm.backends`  schedule executors (local oracle, jax_ici,
+                               pallas_dma, native C++ runtime)
+- :mod:`tpu_aggcomm.tam`       hierarchical two-level exchange engine
+- :mod:`tpu_aggcomm.harness`   timing, verification, reporting
+- :mod:`tpu_aggcomm.cli`       the ``./test``-compatible command line
+"""
+
+__version__ = "0.1.0"
+
+from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
+from tpu_aggcomm.core.topology import NodeAssignment
+
+__all__ = ["AggregatorPattern", "Direction", "NodeAssignment", "__version__"]

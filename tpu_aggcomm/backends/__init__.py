@@ -1,0 +1,20 @@
+"""Schedule executors.
+
+The ``--backend`` plugin boundary (BASELINE.md north star): every backend
+executes the same compiled :class:`~tpu_aggcomm.core.schedule.Schedule` and
+returns delivered recv slabs plus per-rank timers.
+
+- ``local``  — single-process event-driven oracle (numpy). Validates
+  delivery AND liveness (detects schedule deadlock under rendezvous
+  semantics). The correctness reference for every other backend.
+- ``jax_ici`` — rounds lowered to masked `lax.ppermute` / `lax.all_to_all`
+  steps over a `jax.sharding.Mesh` (ICI on TPU).
+- ``pallas_dma`` — one-sided remote-DMA kernels with semaphores, expressing
+  Issend rendezvous for the sync/half-sync methods.
+- ``native`` — C++ threaded rank runtime (rendezvous queues, real blocking),
+  the parity analog of the reference's MPI execution.
+"""
+
+from tpu_aggcomm.backends.registry import BACKENDS, get_backend
+
+__all__ = ["BACKENDS", "get_backend"]

@@ -1,0 +1,26 @@
+"""Backend registry — the ``--backend`` plugin boundary."""
+
+from __future__ import annotations
+
+__all__ = ["BACKENDS", "get_backend"]
+
+BACKENDS = ("local", "jax_ici", "pallas_dma", "native")
+
+
+def get_backend(name: str):
+    try:
+        if name == "local":
+            from tpu_aggcomm.backends.local import LocalBackend
+            return LocalBackend()
+        if name == "jax_ici":
+            from tpu_aggcomm.backends.jax_ici import JaxIciBackend
+            return JaxIciBackend()
+        if name == "pallas_dma":
+            from tpu_aggcomm.backends.pallas_dma import PallasDmaBackend
+            return PallasDmaBackend()
+        if name == "native":
+            from tpu_aggcomm.backends.native import NativeBackend
+            return NativeBackend()
+    except ImportError as e:
+        raise ValueError(f"backend {name!r} is not available here: {e}") from e
+    raise ValueError(f"unknown backend {name!r}; available: {BACKENDS}")

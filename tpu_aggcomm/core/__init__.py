@@ -1,0 +1,1 @@
+"""Pure, device-free pattern/topology/schedule layer."""

@@ -1,0 +1,254 @@
+"""Aggregator communication patterns.
+
+The reference benchmark studies traffic between the full set of ``nprocs``
+ranks and a chosen subset of ``cb_nodes`` *aggregator* ranks (ROMIO's
+"collective buffering nodes"). This module reproduces, as pure index-array
+computations, the reference's pattern metadata:
+
+- aggregator placement policies 0..3 (reference: mpi_test.c:1952-2006,
+  ``create_aggregator_list``),
+- the node-robin permutation map  (reference: mpi_test.c:1116-1133,
+  ``node_robin_map``),
+- the round-robin aggregator re-shuffle across physical nodes
+  (reference: lustre_driver_test.c:1374-1414, ``reorder_ranklist``).
+
+Everything here is replicated computation: every rank derives the same
+tables, exactly as in the reference (which calls create_aggregator_list on
+every rank). On TPU, these tables parameterize mesh-axis schedules; they are
+host-side numpy, never traced.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+__all__ = [
+    "Direction",
+    "Placement",
+    "AggregatorPattern",
+    "create_aggregator_list",
+    "node_robin_map",
+    "reorder_ranklist",
+]
+
+
+class Direction(enum.Enum):
+    """Traffic direction relative to the aggregator subset.
+
+    ALL_TO_MANY: every rank sends one slab to every aggregator (the *write*
+    funnel of two-phase collective I/O). MANY_TO_ALL: every aggregator sends
+    one slab to every rank (the *read* fan-out).
+    """
+
+    ALL_TO_MANY = "all_to_many"
+    MANY_TO_ALL = "many_to_all"
+
+    @property
+    def senders_are_all(self) -> bool:
+        return self is Direction.ALL_TO_MANY
+
+
+class Placement(enum.IntEnum):
+    """Aggregator placement policy — the reference's ``-t`` flag (0..3)."""
+
+    FIRST = 0        # aggregators = ranks 0..cb_nodes-1
+    SPREAD = 1       # ceiling/floor even spread (reference default)
+    SPREAD_SHIFT = 2 # even spread shifted by -16 mod nprocs
+    NODE_ROBIN = 3   # stride proc_node, wrapping with +1 offset per lap
+
+
+def create_aggregator_list(
+    nprocs: int, cb_nodes: int, placement: int | Placement = Placement.SPREAD,
+    proc_node: int = 1,
+) -> np.ndarray:
+    """Return the ``cb_nodes`` aggregator ranks for a placement policy.
+
+    Pure function of the config — the reference computes the same list
+    redundantly on every rank (mpi_test.c:1952-2006). Policy semantics:
+
+    - 0 (FIRST): ``[0, 1, ..., cb_nodes-1]``.
+    - 1 (SPREAD): split ``nprocs`` into ``cb_nodes`` quasi-equal blocks of
+      ceiling/floor size; aggregator i sits at the start of block i. The
+      first ``nprocs // cb_nodes`` blocks get the ceiling size. (Note the
+      reference reuses ``procs / cb_nodes`` for the *remainder* variable —
+      we reproduce that behavior exactly, it is part of the layout.)
+    - 2 (SPREAD_SHIFT): policy 1 shifted by -16 (mod nprocs).
+    - 3 (NODE_ROBIN): stride ``proc_node`` (one aggregator per simulated
+      node); on wrapping past nprocs, restart at ``lap_count`` offset within
+      the node.
+    """
+    placement = Placement(placement)
+    if cb_nodes < 1 or cb_nodes > nprocs:
+        raise ValueError(f"cb_nodes must be in [1, nprocs]; got {cb_nodes} for nprocs={nprocs}")
+    out = np.empty(cb_nodes, dtype=np.int64)
+    if placement is Placement.FIRST:
+        out[:] = np.arange(cb_nodes)
+    elif placement in (Placement.SPREAD, Placement.SPREAD_SHIFT):
+        # NB: the reference sets remainder = procs / cb_nodes (integer div),
+        # not procs % cb_nodes. Kept verbatim: it only matters when
+        # procs/cb_nodes < cb_nodes and changes which blocks are ceiling-sized.
+        remainder = nprocs // cb_nodes
+        ceiling = (nprocs + cb_nodes - 1) // cb_nodes
+        floor = nprocs // cb_nodes
+        for i in range(cb_nodes):
+            if i < remainder:
+                r = ceiling * i
+            else:
+                r = ceiling * remainder + floor * (i - remainder)
+            if placement is Placement.SPREAD_SHIFT:
+                r = (r - 16 + nprocs * 16) % nprocs
+            out[i] = r
+    else:  # NODE_ROBIN
+        pos = 0
+        for i in range(cb_nodes):
+            out[i] = pos
+            pos += proc_node
+            if pos >= nprocs:
+                pos = pos % proc_node + 1
+    return out
+
+
+def node_robin_map(nprocs: int, proc_node: int) -> np.ndarray:
+    """Round-robin slot→rank permutation with stride ``proc_node``.
+
+    ``map[i]`` is the rank occupying schedule slot ``i``: slots walk rank 0,
+    proc_node, 2*proc_node, ... then wrap to 1, 1+proc_node, ... so that
+    consecutive slots live on different simulated nodes
+    (reference: mpi_test.c:1116-1133).
+    """
+    out = np.empty(nprocs, dtype=np.int64)
+    count = 0
+    lap = 0
+    for i in range(nprocs):
+        out[i] = count
+        count += proc_node
+        if count >= nprocs:
+            lap += 1
+            count = lap
+    return out
+
+
+def reorder_ranklist(process_node_list: np.ndarray, rank_list: np.ndarray,
+                     nnodes: int) -> np.ndarray:
+    """Round-robin re-shuffle of aggregators across physical nodes.
+
+    Groups the aggregator ranks by home node, then deals them out one node at
+    a time so consecutive aggregators land on distinct nodes
+    (reference: lustre_driver_test.c:1374-1414).
+    """
+    cb_nodes = len(rank_list)
+    per_node: list[list[int]] = [[] for _ in range(nnodes)]
+    for r in rank_list:
+        per_node[int(process_node_list[int(r)])].append(int(r))
+    out = np.empty(cb_nodes, dtype=np.int64)
+    idx = [0] * nnodes
+    j = 0
+    for i in range(cb_nodes):
+        while idx[j] == len(per_node[j]):
+            j = (j + 1) % nnodes
+        out[i] = per_node[j][idx[j]]
+        idx[j] += 1
+        j = (j + 1) % nnodes
+    return out
+
+
+@dataclass(frozen=True)
+class AggregatorPattern:
+    """The full traffic-pattern specification for one benchmark run.
+
+    Mirrors the reference CLI config (mpi_test.c:2130-2166): ``nprocs`` ranks
+    exchange fixed-size ``data_size``-byte slabs with ``cb_nodes`` aggregator
+    ranks placed by ``placement``; ``comm_size`` throttles in-flight messages
+    per round; ``proc_node`` sets the simulated ranks-per-node.
+
+    Message-size model: span=1 in the reference (mpi_test.c:98,122-123) —
+    every (rank, aggregator) edge carries exactly ``data_size`` bytes. That
+    uniformity is what lets dense TPU collectives (all_to_all with masked
+    slots) express the pattern exactly.
+    """
+
+    nprocs: int
+    cb_nodes: int
+    data_size: int = 2048
+    direction: Direction = Direction.ALL_TO_MANY
+    placement: Placement = Placement.SPREAD
+    proc_node: int = 1
+    comm_size: int = 200_000_000  # reference default: effectively unthrottled
+    rank_list: np.ndarray = field(init=False, repr=False, compare=False)
+
+    def __post_init__(self):
+        if self.nprocs < 1:
+            raise ValueError("nprocs must be >= 1")
+        if not (1 <= self.cb_nodes <= self.nprocs):
+            raise ValueError("cb_nodes must be in [1, nprocs]")
+        if self.data_size < 1:
+            raise ValueError("data_size must be >= 1")
+        if self.comm_size < 1:
+            raise ValueError("comm_size must be >= 1")
+        object.__setattr__(
+            self, "rank_list",
+            create_aggregator_list(self.nprocs, self.cb_nodes,
+                                   self.placement, self.proc_node))
+
+    # -- derived tables ----------------------------------------------------
+
+    @property
+    def is_agg(self) -> np.ndarray:
+        """Boolean mask of length nprocs: True where the rank is an aggregator."""
+        mask = np.zeros(self.nprocs, dtype=bool)
+        mask[self.rank_list] = True
+        return mask
+
+    @property
+    def agg_index(self) -> np.ndarray:
+        """rank → index into rank_list (or -1 for non-aggregators)."""
+        idx = np.full(self.nprocs, -1, dtype=np.int64)
+        for i, r in enumerate(self.rank_list):
+            idx[int(r)] = i
+        return idx
+
+    @property
+    def senders(self) -> np.ndarray:
+        if self.direction is Direction.ALL_TO_MANY:
+            return np.arange(self.nprocs)
+        return np.asarray(self.rank_list)
+
+    @property
+    def receivers(self) -> np.ndarray:
+        if self.direction is Direction.ALL_TO_MANY:
+            return np.asarray(self.rank_list)
+        return np.arange(self.nprocs)
+
+    @property
+    def n_edges(self) -> int:
+        return self.nprocs * self.cb_nodes
+
+    @property
+    def total_bytes(self) -> int:
+        """Total payload moved per repetition (includes self-edges, as the
+        reference does)."""
+        return self.n_edges * self.data_size
+
+    def reversed(self) -> "AggregatorPattern":
+        d = (Direction.MANY_TO_ALL if self.direction is Direction.ALL_TO_MANY
+             else Direction.ALL_TO_MANY)
+        return replace(self, direction=d)
+
+    def dense_counts(self) -> tuple[np.ndarray, np.ndarray]:
+        """Dense per-(src,dst) byte-count matrices for alltoallw-style dispatch.
+
+        Returns ``(sendcounts, recvcounts)`` each of shape (nprocs, nprocs):
+        ``sendcounts[r, d]`` is what rank r sends to rank d; ``recvcounts`` is
+        its transpose view. Reproduces the translate step
+        (reference: mpi_test.c:233-311) without the displacement plumbing —
+        slab layout is uniform so displacements are implied.
+        """
+        send = np.zeros((self.nprocs, self.nprocs), dtype=np.int64)
+        if self.direction is Direction.ALL_TO_MANY:
+            send[:, self.rank_list] = self.data_size
+        else:
+            send[self.rank_list, :] = self.data_size
+        return send, send.T.copy()

@@ -1,0 +1,176 @@
+"""Schedule intermediate representation.
+
+A *schedule* is what a reference "method" (the ``-m`` switch,
+mpi_test.c:2132-2134) compiles to: one op-program per rank, describing
+exactly which messages are posted, in which order, with which completion
+(waitall) structure, which synchronization mode (eager / rendezvous /
+blocking), and which timer bucket each phase charges.
+
+Two views of the same schedule:
+
+- **per-rank op programs** (`Schedule.programs`) — the ground truth, faithful
+  to the reference's per-rank MPI call sequences. The local oracle and the
+  native C++ runtime execute these directly, preserving rendezvous and
+  blocking semantics.
+- **global round/edge view** (`Schedule.rounds()`) — edges grouped by the
+  round in which their transfer completes. The JAX/ICI backend lowers each
+  round to masked collective steps (ppermute batches / all_to_all); this is
+  the TPU-idiomatic reinterpretation: MPI's per-rank progress becomes
+  mesh-global program steps. The semantic difference (per-rank unordered
+  completion vs. deterministic global steps) is intentional and documented —
+  see SURVEY.md §7 "hard parts" (5).
+
+Op vocabulary (mirrors the reference's L0 call set, SURVEY.md §5.8):
+ISEND (eager, MPI_Isend), ISSEND (rendezvous, MPI_Issend), IRECV, SEND/RECV
+(blocking), SENDRECV (paired blocking), WAITALL (token subset), BARRIER,
+COPY (self-edge memcpy), SIGNAL_SEND/SIGNAL_RECV (0-byte handshake on a
+separate channel — the dup'ed signal_comm of mpi_test.c:1252).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpu_aggcomm.core.pattern import AggregatorPattern
+
+__all__ = ["OpKind", "Op", "Schedule", "TimerBucket"]
+
+
+class OpKind(enum.IntEnum):
+    ISEND = 0        # eager nonblocking send
+    ISSEND = 1       # rendezvous nonblocking send (MPI_Issend semantics)
+    IRECV = 2        # nonblocking receive
+    SEND = 3         # blocking send
+    RECV = 4         # blocking receive
+    SENDRECV = 5     # paired blocking send+receive
+    WAITALL = 6      # complete a set of nonblocking tokens
+    BARRIER = 7      # global barrier
+    COPY = 8         # local memcpy (self-edge)
+    SIGNAL_SEND = 9  # 0-byte nonblocking send on the signal channel
+    SIGNAL_RECV = 10 # 0-byte blocking receive on the signal channel
+    ALLTOALLW = 11   # dense vendor collective (whole pattern in one call)
+
+
+class TimerBucket(enum.Enum):
+    """Which Timer field a timed segment charges (reference Timer,
+    mpi_test.c:25-31)."""
+
+    POST = "post_request_time"
+    RECV_WAIT = "recv_wait_all_time"
+    SEND_WAIT = "send_wait_all_time"
+    RECV_AND_SEND_WAIT = "recv+send"  # waitall charged to both (non-agg paths)
+    BARRIER = "barrier_time"
+    NONE = "none"
+
+
+@dataclass
+class Op:
+    """One step of a rank's program. Field meaning depends on kind:
+
+    sends: ``peer`` = destination rank, ``slot`` = index into the sender's
+    slab array. recvs: ``peer`` = source rank, ``slot`` = index into the
+    receiver's slab array. SENDRECV: send to (peer, slot), receive from
+    (peer2, slot2). WAITALL: ``tokens`` = token ids to complete. COPY:
+    local ``slot`` (send side) → ``slot2`` (recv side). ``round`` tags the
+    global round in which the transfer completes (collective-backend view).
+    ``nbytes`` = payload size (0 ⇒ pure synchronization message).
+    """
+
+    kind: OpKind
+    peer: int = -1
+    slot: int = -1
+    peer2: int = -1
+    slot2: int = -1
+    round: int = 0
+    token: int = -1
+    tokens: tuple[int, ...] = ()
+    bucket: TimerBucket = TimerBucket.NONE
+    nbytes: int = 0
+
+
+@dataclass
+class Schedule:
+    """A compiled method: one op program per rank plus pattern metadata."""
+
+    pattern: AggregatorPattern
+    method_id: int
+    name: str              # reference label, e.g. "All to many balanced"
+    programs: list[list[Op]]
+    collective: bool = False  # True for alltoallw-style dense methods
+    uses_rendezvous: bool = False
+    per_rep: bool = True   # program covers ONE rep; harness loops ntimes
+
+    @property
+    def nprocs(self) -> int:
+        return self.pattern.nprocs
+
+    def data_edges(self) -> np.ndarray:
+        """All payload-carrying (src, dst, slot_src, slot_dst, round) tuples.
+
+        Derived from the *send* side ops plus COPY self-edges. Shape (E, 5).
+        """
+        rows = []
+        for rank, prog in enumerate(self.programs):
+            for op in prog:
+                if op.kind in (OpKind.ISEND, OpKind.ISSEND, OpKind.SEND) and op.nbytes > 0:
+                    rows.append((rank, op.peer, op.slot, -1, op.round))
+                elif op.kind is OpKind.SENDRECV and op.nbytes > 0:
+                    rows.append((rank, op.peer, op.slot, -1, op.round))
+                elif op.kind is OpKind.COPY:
+                    rows.append((rank, rank, op.slot, op.slot2, op.round))
+        return np.array(rows, dtype=np.int64).reshape(-1, 5)
+
+    def rounds(self) -> list[np.ndarray]:
+        """Edges grouped by completion round: list of (E_k, 2) arrays of
+        (src, dst), self-edges included. Rounds are indexed densely from 0."""
+        edges = self.data_edges()
+        if len(edges) == 0:
+            return []
+        out = []
+        for r in range(int(edges[:, 4].max()) + 1):
+            sel = edges[edges[:, 4] == r]
+            out.append(sel[:, :2])
+        return out
+
+    def recv_slot_table(self) -> dict[tuple[int, int], int]:
+        """(src, dst) → receiver slot index, from the receive-side ops.
+
+        Message matching is by directed pair, which is unique per rep in
+        every reference method (tags are ``src + dst`` per edge,
+        mpi_test.c:1776 — unique per direction within a rep).
+        """
+        table: dict[tuple[int, int], int] = {}
+        for rank, prog in enumerate(self.programs):
+            for op in prog:
+                if op.kind in (OpKind.IRECV, OpKind.RECV):
+                    table[(op.peer, rank)] = op.slot
+                elif op.kind is OpKind.SENDRECV:
+                    table[(op.peer2, rank)] = op.slot2
+                elif op.kind is OpKind.COPY:
+                    table[(rank, rank)] = op.slot2
+        return table
+
+    def validate(self) -> None:
+        """Sanity-check the schedule: every data send has a matching receive
+        and every expected pattern edge is covered exactly once."""
+        table = self.recv_slot_table()
+        edges = self.data_edges()
+        seen = set()
+        for src, dst, _sslot, _dslot, _r in edges:
+            key = (int(src), int(dst))
+            if key in seen:
+                raise AssertionError(f"{self.name}: duplicate edge {key}")
+            seen.add(key)
+            if key not in table and not self.collective:
+                raise AssertionError(f"{self.name}: send {key} has no matching recv")
+        # expected coverage: every (sender, receiver) pair of the pattern
+        p = self.pattern
+        expected = {(int(s), int(d)) for s in p.senders for d in p.receivers}
+        if not self.collective and seen != expected:
+            missing = sorted(expected - seen)[:5]
+            extra = sorted(seen - expected)[:5]
+            raise AssertionError(
+                f"{self.name}: edge coverage mismatch; missing={missing} extra={extra}")

@@ -1,0 +1,1 @@
+"""Timing, verification, and reporting harness."""

@@ -1,0 +1,78 @@
+"""Per-phase timers with max-over-ranks reduction.
+
+One unified Timer type (the reference has two divergent structs — 5 fields
+in mpi_test.c:25-31, 4 in lustre_driver_test.c:22-27 — sharing memory
+through an extern; SURVEY.md §2.2 flags this as a hazard not to replicate).
+
+Buckets: request-post, send-waitall, recv-waitall, barrier, total
+(mpi_test.c:25-31). Reduction across ranks is element-wise MAX, mirroring
+``MPI_Reduce(…, 5, MPI_DOUBLE, MPI_MAX, …)`` (mpi_test.c:2184); on the JAX
+backend this is a host-side max over per-device timings (device timing is
+whole-program — see backends/jax_ici.py for how phases are attributed).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpu_aggcomm.core.schedule import TimerBucket
+
+__all__ = ["Timer", "max_reduce", "accumulate"]
+
+
+@dataclass
+class Timer:
+    post_request_time: float = 0.0
+    send_wait_all_time: float = 0.0
+    recv_wait_all_time: float = 0.0
+    barrier_time: float = 0.0
+    total_time: float = 0.0
+
+    def add(self, bucket: TimerBucket, seconds: float) -> None:
+        if bucket is TimerBucket.POST:
+            self.post_request_time += seconds
+        elif bucket is TimerBucket.RECV_WAIT:
+            self.recv_wait_all_time += seconds
+        elif bucket is TimerBucket.SEND_WAIT:
+            self.send_wait_all_time += seconds
+        elif bucket is TimerBucket.RECV_AND_SEND_WAIT:
+            self.recv_wait_all_time += seconds
+            self.send_wait_all_time += seconds
+        elif bucket is TimerBucket.BARRIER:
+            self.barrier_time += seconds
+        # TimerBucket.NONE: untimed segment
+
+    def as_array(self) -> np.ndarray:
+        return np.array([self.post_request_time, self.send_wait_all_time,
+                         self.recv_wait_all_time, self.barrier_time,
+                         self.total_time])
+
+    @staticmethod
+    def from_array(a) -> "Timer":
+        a = np.asarray(a, dtype=np.float64)
+        return Timer(float(a[0]), float(a[1]), float(a[2]), float(a[3]),
+                     float(a[4]))
+
+    def __iadd__(self, other: "Timer") -> "Timer":
+        self.post_request_time += other.post_request_time
+        self.send_wait_all_time += other.send_wait_all_time
+        self.recv_wait_all_time += other.recv_wait_all_time
+        self.barrier_time += other.barrier_time
+        self.total_time += other.total_time
+        return self
+
+
+def max_reduce(timers: list[Timer]) -> Timer:
+    """Element-wise max across ranks (the MPI_Reduce MAX analog)."""
+    if not timers:
+        return Timer()
+    return Timer.from_array(np.stack([t.as_array() for t in timers]).max(axis=0))
+
+
+def accumulate(timers: list[Timer]) -> Timer:
+    out = Timer()
+    for t in timers:
+        out += t
+    return out

@@ -1,0 +1,102 @@
+"""Deterministic-fill payload generation and verification.
+
+The reference's real correctness mechanism (SURVEY.md §4): every payload
+byte is a pure function of (sender, offset, slot-seed, iteration) —
+``MAP_DATA(a,b,c,d) = a+b+c+d`` truncated to char (mpi_test.c:23,71-92).
+Send slab ``slot`` of rank ``r`` is filled with seed ``slot``; the checker
+on the receiving side recomputes the expected bytes from the *sender's*
+identity. In the reference the benchmark-path checks are commented out
+(mpi_test.c:136-143, 205-219); here verification is a first-class
+``--verify`` flag, never disabled by editing code.
+
+The TAM engine uses a second map, ``MAP_DATA3(a,b,c) = 1+3a+5b+7c``
+(lustre_driver_test.c:20,46-58), keyed by (sender, receiver-index, offset).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
+
+__all__ = ["fill_slab", "expected_recv", "make_send_slabs", "verify_recv",
+           "fill_slab_tam", "VerificationError"]
+
+
+class VerificationError(AssertionError):
+    pass
+
+
+def fill_slab(rank: int, size: int, seed: int, iter_: int) -> np.ndarray:
+    """MAP_DATA(rank, offset, seed, iter) as uint8 (mpi_test.c:23, 71-77)."""
+    return ((rank + seed + iter_ + np.arange(size, dtype=np.int64)) % 256
+            ).astype(np.uint8)
+
+
+def fill_slab_tam(sender: int, recv_index: int, size: int) -> np.ndarray:
+    """MAP_DATA(a,b,c) = 1+3a+5b+7c of the TAM engine
+    (lustre_driver_test.c:20): a = sender, b = receiver index, c = offset."""
+    return ((1 + 3 * sender + 5 * recv_index
+             + 7 * np.arange(size, dtype=np.int64)) % 256).astype(np.uint8)
+
+
+def make_send_slabs(p: AggregatorPattern, iter_: int) -> list[np.ndarray | None]:
+    """Per-rank send slab matrices, shape (nslots, data_size) uint8.
+
+    ALL_TO_MANY: every rank has cb_nodes slots (slot = aggregator index,
+    mpi_test.c:193-198). MANY_TO_ALL: aggregators have nprocs slots (slot =
+    destination rank, mpi_test.c:106-110); non-aggregators have None.
+    """
+    out: list[np.ndarray | None] = []
+    agg_index = p.agg_index
+    for rank in range(p.nprocs):
+        if p.direction is Direction.ALL_TO_MANY:
+            nslots = p.cb_nodes
+        elif agg_index[rank] >= 0:
+            nslots = p.nprocs
+        else:
+            out.append(None)
+            continue
+        slabs = np.stack([fill_slab(rank, p.data_size, s, iter_)
+                          for s in range(nslots)])
+        out.append(slabs)
+    return out
+
+
+def expected_recv(p: AggregatorPattern, rank: int, iter_: int) -> np.ndarray | None:
+    """The full expected recv slab matrix for ``rank`` (or None if this rank
+    receives nothing). Mirrors the commented-out reference checks:
+    all-to-many aggregators check slab ``src`` against fill(src, seed=myindex)
+    (mpi_test.c:213-217); many-to-all ranks check slab ``i`` against
+    fill(rank_list[i], seed=rank) (mpi_test.c:138-141)."""
+    agg_index = p.agg_index
+    if p.direction is Direction.ALL_TO_MANY:
+        if agg_index[rank] < 0:
+            return None
+        myindex = int(agg_index[rank])
+        return np.stack([fill_slab(src, p.data_size, myindex, iter_)
+                         for src in range(p.nprocs)])
+    return np.stack([fill_slab(int(p.rank_list[i]), p.data_size, rank, iter_)
+                     for i in range(p.cb_nodes)])
+
+
+def verify_recv(p: AggregatorPattern, recv_bufs: list[np.ndarray | None],
+                iter_: int) -> None:
+    """Raise VerificationError if any delivered slab mismatches the
+    deterministic fill."""
+    for rank in range(p.nprocs):
+        exp = expected_recv(p, rank, iter_)
+        if exp is None:
+            continue
+        got = recv_bufs[rank]
+        if got is None:
+            raise VerificationError(f"rank {rank}: expected recv data, got none")
+        if got.shape != exp.shape:
+            raise VerificationError(
+                f"rank {rank}: recv shape {got.shape} != expected {exp.shape}")
+        bad = np.nonzero(~(got == exp).all(axis=1))[0]
+        if len(bad):
+            s = int(bad[0])
+            raise VerificationError(
+                f"rank {rank}: wrong payload in slab {s}: "
+                f"got {got[s][:8]}... expected {exp[s][:8]}...")
