@@ -7,8 +7,16 @@ XLA's host-platform device partitioning.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the axon TPU tunnel is registered (its sitecustomize
+# sets jax_platforms programmatically, so the env var alone is not enough):
+# the test suite always runs on the virtual 8-device mesh (one real chip
+# can't host an 8-rank pattern; TPU runs happen via bench.py / the CLI).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,341 @@
+"""JAX/ICI backend: schedules lowered to XLA collectives over a device mesh.
+
+The TPU-native execution path (BASELINE.md north star). Each logical rank
+maps to one mesh device; the schedule's global round/edge view lowers to:
+
+- per round: a greedy bipartite **edge coloring** of the round's (src, dst)
+  edges; each color class is a partial permutation carried by one
+  ``lax.ppermute`` step over the mesh axis — exactly the message volume of
+  the reference's Issend/Irecv batches, nothing dense. On TPU every
+  ppermute rides ICI neighbor links.
+- dense methods (m=5/8 Alltoallw): one ``lax.all_to_all`` with zero-masked
+  slots — exact because every pattern edge is uniform ``data_size`` bytes
+  (span=1, mpi_test.c:98).
+- round boundaries: ``lax.optimization_barrier`` so XLA cannot fuse or
+  reorder across throttle rounds (the ``-c`` semantics would otherwise be
+  compiled away — SURVEY.md §7 hard part (2)).
+- reference MPI_Barrier rounds (m=17): a real ``psum`` chained into the
+  dataflow.
+
+Timing semantics (documented difference, SURVEY.md §7 hard part (3)): XLA
+executes one compiled program per rep, so per-phase post/waitall times do
+not exist on this backend; ``total_time`` is the honest number (wall time
+per rep after a warm-up compile, synchronized via ``block_until_ready``).
+``profile_rounds=True`` splits the program at round boundaries into
+separately-jitted segments and reports their summed wall times into
+``recv_wait_all_time`` (adds dispatch sync — use for schedule-shape
+analysis, not headline numbers). Per-phase attribution with device-side
+semaphores lives in the pallas_dma backend; host-side per-op timing lives
+in the native backend.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
+from tpu_aggcomm.core.schedule import OpKind, Schedule
+from tpu_aggcomm.harness.timer import Timer
+from tpu_aggcomm.harness.verify import make_send_slabs
+
+__all__ = ["JaxIciBackend", "color_rounds", "lower_schedule"]
+
+AXIS = "ranks"
+
+
+def color_rounds(edges: np.ndarray) -> list[list[tuple[int, int]]]:
+    """Greedy bipartite edge coloring of one round's (src, dst) edge list.
+
+    Each color class is a partial permutation (no repeated src, no repeated
+    dst) — the unit a single ppermute can carry. Greedy needs at most
+    2Δ-1 colors; the reference's structured rounds typically hit Δ.
+    """
+    src_used: list[set[int]] = []
+    dst_used: list[set[int]] = []
+    colors: list[list[tuple[int, int]]] = []
+    for s, d in edges:
+        s, d = int(s), int(d)
+        for c in range(len(colors)):
+            if s not in src_used[c] and d not in dst_used[c]:
+                colors[c].append((s, d))
+                src_used[c].add(s)
+                dst_used[c].add(d)
+                break
+        else:
+            colors.append([(s, d)])
+            src_used.append({s})
+            dst_used.append({d})
+    return colors
+
+
+@dataclass
+class _Lowered:
+    """Static lowering artifacts for one schedule."""
+    perms: list[list[tuple[int, int]]]      # ppermute perm per color step
+    round_of_color: list[int]               # color step -> round index
+    sslot_tab: np.ndarray                   # (nprocs, C) send slot or -1
+    rslot_tab: np.ndarray                   # (nprocs, C) recv slot or trash row
+    barrier_rounds: dict[int, int]          # round -> number of MPI_Barriers
+    n_send_slots: int
+    n_recv_slots: int                       # excludes the trash row
+
+    @property
+    def n_colors(self) -> int:
+        return len(self.perms)
+
+
+def lower_schedule(schedule: Schedule) -> _Lowered:
+    p = schedule.pattern
+    n = p.nprocs
+    edges = schedule.data_edges()
+    rtable = schedule.recv_slot_table()
+    n_send_slots = p.cb_nodes if p.direction is Direction.ALL_TO_MANY else n
+    n_recv_slots = n if p.direction is Direction.ALL_TO_MANY else p.cb_nodes
+
+    perms: list[list[tuple[int, int]]] = []
+    round_of_color: list[int] = []
+    sslots: list[np.ndarray] = []
+    rslots: list[np.ndarray] = []
+    n_rounds = int(edges[:, 4].max()) + 1 if len(edges) else 0
+    for r in range(n_rounds):
+        sel = edges[edges[:, 4] == r]
+        if len(sel) == 0:
+            continue
+        slot_of = {(int(e[0]), int(e[1])): int(e[2]) for e in sel}
+        for color in color_rounds(sel[:, :2]):
+            ss = np.full(n, -1, dtype=np.int32)
+            rs = np.full(n, n_recv_slots, dtype=np.int32)  # trash row default
+            for (s, d) in color:
+                ss[s] = slot_of[(s, d)]
+                rs[d] = rtable[(s, d)]
+            perms.append(color)
+            round_of_color.append(r)
+            sslots.append(ss)
+            rslots.append(rs)
+
+    barrier_rounds: dict[int, int] = {}
+    if schedule.programs:
+        for op in schedule.programs[0]:  # SPMD-symmetric barrier structure
+            if op.kind is OpKind.BARRIER:
+                barrier_rounds[op.round] = barrier_rounds.get(op.round, 0) + 1
+
+    return _Lowered(
+        perms=perms,
+        round_of_color=round_of_color,
+        sslot_tab=np.stack(sslots, axis=1) if sslots else np.zeros((n, 0), np.int32),
+        rslot_tab=np.stack(rslots, axis=1) if rslots else np.zeros((n, 0), np.int32),
+        barrier_rounds=barrier_rounds,
+        n_send_slots=n_send_slots,
+        n_recv_slots=n_recv_slots,
+    )
+
+
+class JaxIciBackend:
+    """Executes schedules over a jax.sharding.Mesh (one device per rank)."""
+
+    name = "jax_ici"
+
+    def __init__(self, devices=None):
+        self._devices = devices
+
+    def _mesh(self, nprocs: int) -> Mesh:
+        devs = list(self._devices) if self._devices is not None else jax.devices()
+        if len(devs) < nprocs:
+            raise ValueError(
+                f"pattern needs {nprocs} devices, only {len(devs)} available "
+                f"(hint: XLA_FLAGS=--xla_force_host_platform_device_count={nprocs})")
+        return Mesh(np.array(devs[:nprocs]), (AXIS,))
+
+    # ------------------------------------------------------------------
+    def run(self, schedule: Schedule, *, ntimes: int = 1, iter_: int = 0,
+            verify: bool = False, profile_rounds: bool = False):
+        if ntimes < 1:
+            raise ValueError("ntimes must be >= 1")
+        p = schedule.pattern
+        n = p.nprocs
+        mesh = self._mesh(n)
+        sharding = NamedSharding(mesh, P(AXIS))
+
+        if schedule.collective:
+            n_recv_slots = n if p.direction is Direction.ALL_TO_MANY else p.cb_nodes
+            n_send_slots = p.cb_nodes if p.direction is Direction.ALL_TO_MANY else n
+            segments = [self._build_dense(p, mesh)]
+        else:
+            low = lower_schedule(schedule)
+            n_recv_slots, n_send_slots = low.n_recv_slots, low.n_send_slots
+            segments = self._build_ppermute(p, mesh, sharding, low,
+                                            split_rounds=profile_rounds)
+
+        send_g = self._global_send(p, iter_, n_send_slots)
+        send_dev = jax.device_put(send_g, sharding)
+
+        def fresh_recv():
+            return jax.device_put(
+                np.zeros((n, n_recv_slots + 1, p.data_size), dtype=np.uint8),
+                sharding)
+
+        # warm-up: compile every segment outside the timed region
+        warm = fresh_recv()
+        for seg in segments:
+            warm = seg(send_dev, warm)
+        warm.block_until_ready()
+
+        timers = [Timer() for _ in range(n)]
+        self.last_rep_timers = []  # [rep][rank] -> Timer (save_all_timing)
+        recv_dev = None
+        for _ in range(ntimes):
+            recv_dev = fresh_recv()
+            t0 = time.perf_counter()
+            for seg in segments:
+                recv_dev = seg(send_dev, recv_dev)
+                if profile_rounds:
+                    recv_dev.block_until_ready()
+            recv_dev.block_until_ready()
+            dt = time.perf_counter() - t0
+            for t in timers:
+                t.total_time += dt
+                if profile_rounds and len(segments) > 1:
+                    t.recv_wait_all_time += dt
+            self.last_rep_timers.append(
+                [Timer(total_time=dt) for _ in range(n)])
+
+        recv_np = np.asarray(jax.device_get(recv_dev))[:, :n_recv_slots, :]
+        recv_bufs = self._split_recv(p, recv_np)
+        if verify:
+            from tpu_aggcomm.harness.verify import verify_recv
+            verify_recv(p, recv_bufs, iter_)
+        return recv_bufs, timers
+
+    # ------------------------------------------------------------------
+    def _global_send(self, p: AggregatorPattern, iter_: int,
+                     n_send_slots: int) -> np.ndarray:
+        slabs = make_send_slabs(p, iter_)
+        out = np.zeros((p.nprocs, n_send_slots, p.data_size), dtype=np.uint8)
+        for r, s in enumerate(slabs):
+            if s is not None:
+                out[r, :s.shape[0]] = s
+        return out
+
+    def _split_recv(self, p: AggregatorPattern, recv_np: np.ndarray):
+        out = []
+        agg_index = p.agg_index
+        for rank in range(p.nprocs):
+            if p.direction is Direction.ALL_TO_MANY and agg_index[rank] < 0:
+                out.append(None)
+            else:
+                out.append(recv_np[rank])
+        return out
+
+    # ------------------------------------------------------------------
+    def _build_ppermute(self, p: AggregatorPattern, mesh: Mesh, sharding,
+                        low: _Lowered, split_rounds: bool):
+        """One jitted shard_map program per segment; a segment covers the
+        whole rep (default) or one throttle round (profile mode)."""
+        n, ds = p.nprocs, p.data_size
+
+        seg_bounds: list[tuple[int, int]] = []
+        if split_rounds and low.perms:
+            start = 0
+            for c in range(1, low.n_colors):
+                if low.round_of_color[c] != low.round_of_color[c - 1]:
+                    seg_bounds.append((start, c))
+                    start = c
+            seg_bounds.append((start, low.n_colors))
+        else:
+            seg_bounds.append((0, low.n_colors))
+
+        ss_dev = jax.device_put(low.sslot_tab, sharding)
+        rs_dev = jax.device_put(low.rslot_tab, sharding)
+
+        def make_segment(c0: int, c1: int):
+            def local_fn(send, recv, sslot, rslot):
+                # send: (1, S, ds)  recv: (1, R+1, ds)  sslot/rslot: (1, C)
+                send = send[0]
+                recv = recv[0]
+                zero = jnp.zeros((ds,), dtype=jnp.uint8)
+
+                def emit_barriers(recv, rnd):
+                    # real barriers of this round (m=17 in-round,
+                    # m=13/-b and m=19 after-round), chained into the
+                    # dataflow so they cannot be hoisted
+                    for _ in range(low.barrier_rounds.get(rnd, 0)):
+                        tok = lax.psum(
+                            (recv[0, 0].astype(jnp.int32) & 0) + 1, AXIS)
+                        recv = recv + (tok & 0).astype(jnp.uint8)
+                    return recv
+
+                prev_round = None
+                for ci in range(c0, c1):
+                    rnd = low.round_of_color[ci]
+                    if prev_round is not None and rnd != prev_round:
+                        # throttle-round boundary: keep XLA from fusing across
+                        recv = emit_barriers(recv, prev_round)
+                        send, recv = lax.optimization_barrier((send, recv))
+                    prev_round = rnd
+                    ss = sslot[0, ci]
+                    val = jnp.where(ss >= 0,
+                                    jnp.take(send, jnp.maximum(ss, 0), axis=0,
+                                             mode="clip"),
+                                    zero)
+                    got = lax.ppermute(val, AXIS, low.perms[ci])
+                    recv = lax.dynamic_update_index_in_dim(
+                        recv, got, rslot[0, ci], axis=0)
+                if prev_round is not None:
+                    recv = emit_barriers(recv, prev_round)
+                return recv[None]
+
+            sm = jax.shard_map(
+                local_fn, mesh=mesh,
+                in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
+                out_specs=P(AXIS))
+
+            @jax.jit
+            def seg(send, recv):
+                return sm(send, recv, ss_dev, rs_dev)
+
+            return seg
+
+        return [make_segment(c0, c1) for c0, c1 in seg_bounds]
+
+    # ------------------------------------------------------------------
+    def _build_dense(self, p: AggregatorPattern, mesh: Mesh):
+        """m=5/8: one lax.all_to_all of dst-major rows with masked slots.
+
+        Inside shard_map each device builds an (nprocs, ds) dst-major row
+        matrix from its slabs; all_to_all exchanges row d of device s to
+        row s of device d; receivers scatter rows into recv slots. The slot
+        maps are direction-static (the sdispls/rdispls analog)."""
+        n, ds = p.nprocs, p.data_size
+        agg_index = np.asarray(p.agg_index)
+        if p.direction is Direction.ALL_TO_MANY:
+            n_recv_slots = n
+            sslot_of = agg_index                      # slab index for dst
+            rslot_of = np.arange(n)                   # row from src -> slot src
+        else:
+            n_recv_slots = p.cb_nodes
+            sslot_of = np.arange(n)
+            rslot_of = agg_index
+        sslot_c = jnp.asarray(np.maximum(sslot_of, 0), dtype=jnp.int32)
+        smask = jnp.asarray((sslot_of >= 0).astype(np.uint8))[:, None]
+        rslot_c = jnp.asarray(
+            np.where(rslot_of >= 0, rslot_of, n_recv_slots), dtype=jnp.int32)
+
+        def local_fn(send, recv):
+            send = send[0]          # (S, ds)
+            recv = recv[0]          # (R+1, ds)
+            rows = jnp.take(send, sslot_c, axis=0) * smask   # (n, ds) dst-major
+            got = lax.all_to_all(rows, AXIS, split_axis=0, concat_axis=0)
+            recv = recv.at[rslot_c].set(got)
+            return recv[None]
+
+        sm = jax.shard_map(local_fn, mesh=mesh,
+                           in_specs=(P(AXIS), P(AXIS)), out_specs=P(AXIS))
+        return jax.jit(sm)

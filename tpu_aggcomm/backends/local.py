@@ -22,7 +22,7 @@ something the reference never had (its only guard was "it hung on Theta").
 
 from __future__ import annotations
 
-from collections import deque
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -59,12 +59,20 @@ class LocalBackend:
         p = schedule.pattern
         recv_bufs = _alloc_recv(p)
         send_slabs = make_send_slabs(p, iter_)  # deterministic: same every rep
+        self.last_rep_timers = []  # [rep][rank] -> Timer (save_all_timing)
         for _ in range(ntimes):
+            t0 = time.perf_counter()
             _run_one_rep(schedule, recv_bufs, send_slabs)
+            dt = time.perf_counter() - t0
+            self.last_rep_timers.append(
+                [Timer(total_time=dt) for _ in range(p.nprocs)])
         if verify:
             from tpu_aggcomm.harness.verify import verify_recv
             verify_recv(p, recv_bufs, iter_)
         timers = [Timer() for _ in range(p.nprocs)]
+        for rep in self.last_rep_timers:
+            for t, rt in zip(timers, rep):
+                t += rt
         return recv_bufs, timers
 
 

@@ -1,0 +1,98 @@
+"""Command-line interface, flag-compatible with the reference ``./test``.
+
+Reference grammar ``"hp:c:m:d:a:i:k:t:r:b:"`` (mpi_test.c:2130-2166) plus
+the TPU-framework extensions: ``-n`` rank count (the reference gets it from
+``mpiexec -n``), ``--backend``, ``--verify``, ``--profile-rounds``. The
+``pt2pt`` subcommand reproduces mpi_sendrecv_test.c (grammar ``hk:d:i:``).
+
+Examples::
+
+    python -m tpu_aggcomm.cli -n 8 -m 1 -a 3 -d 2048 -c 3 -i 2 --backend local --verify
+    python -m tpu_aggcomm.cli -n 8 -m 0 -a 3 -d 256 --backend jax_ici
+    python -m tpu_aggcomm.cli pt2pt -d 2048 -k 10 -i 100
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpu_aggcomm.backends.registry import BACKENDS
+
+__all__ = ["main", "build_parser"]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="tpu_aggcomm",
+        description="TPU-native aggregator-communication benchmark "
+                    "(capabilities of the reference MPI ./test harness)")
+    sub = ap.add_subparsers(dest="command")
+
+    bench = ap  # main command keeps reference flags at top level
+    bench.add_argument("-n", "--nprocs", type=int, default=None,
+                       help="logical ranks (reference: mpiexec -n; default: "
+                            "number of visible devices)")
+    bench.add_argument("-m", dest="method", type=int, default=0,
+                       help="method id 0-20 (0 = all; mpi_test.c usage)")
+    bench.add_argument("-a", dest="cb_nodes", type=int, default=1,
+                       help="number of aggregators (cb_nodes)")
+    bench.add_argument("-d", dest="data_size", type=int, default=0,
+                       help="message size in bytes")
+    bench.add_argument("-c", dest="comm_size", type=int, default=200_000_000,
+                       help="max in-flight messages per round (throttle)")
+    bench.add_argument("-i", dest="iters", type=int, default=1,
+                       help="outer experiment repetitions (fresh buffers)")
+    bench.add_argument("-k", dest="ntimes", type=int, default=1,
+                       help="timed reps inside one window (no resync)")
+    bench.add_argument("-p", dest="proc_node", type=int, default=1,
+                       help="ranks per (simulated) node")
+    bench.add_argument("-t", dest="agg_type", type=int, default=1,
+                       help="aggregator placement policy 0-3")
+    bench.add_argument("-r", dest="prefix", type=str, default="",
+                       help="per-rank CSV filename prefix")
+    bench.add_argument("-b", dest="barrier_type", type=int, default=0,
+                       help="barrier mode for m=13 (0 none, 1 per rep, 2 per block)")
+    bench.add_argument("--backend", choices=BACKENDS, default="local")
+    bench.add_argument("--verify", action="store_true",
+                       help="deterministic-fill verification (first-class "
+                            "version of the reference's commented-out checks)")
+    bench.add_argument("--profile-rounds", action="store_true",
+                       help="jax_ici: time each throttle round separately")
+    bench.add_argument("--results-csv", default="results.csv")
+
+    pt = sub.add_parser("pt2pt", help="2-rank latency microbenchmark "
+                                      "(mpi_sendrecv_test.c)")
+    pt.add_argument("-d", dest="data_size", type=int, default=0)
+    pt.add_argument("-k", dest="ntimes", type=int, default=0)
+    pt.add_argument("-i", dest="runs", type=int, default=0)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.command == "pt2pt":
+        from tpu_aggcomm.harness.pt2pt import pt2pt_statistics
+        pt2pt_statistics(max(args.data_size, 1), max(args.ntimes, 1),
+                         max(args.runs, 1))
+        return 0
+
+    from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+    nprocs = args.nprocs
+    if nprocs is None:
+        import jax
+        nprocs = len(jax.devices())
+    cfg = ExperimentConfig(
+        nprocs=nprocs, cb_nodes=args.cb_nodes, method=args.method,
+        data_size=args.data_size, comm_size=args.comm_size, iters=args.iters,
+        ntimes=args.ntimes, proc_node=args.proc_node, agg_type=args.agg_type,
+        prefix=args.prefix, barrier_type=args.barrier_type,
+        backend=args.backend, verify=args.verify,
+        results_csv=args.results_csv, profile_rounds=args.profile_rounds)
+    run_experiment(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

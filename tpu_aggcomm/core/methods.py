@@ -786,6 +786,9 @@ def compile_method(method_id: int, pattern: AggregatorPattern,
     """Compile a method id + pattern into a Schedule. The pattern's
     ``direction`` is overridden by the method's inherent direction, exactly
     like the reference where direction is baked into each function."""
+    if method_id not in METHODS:
+        raise ValueError(f"unknown method id {method_id}; valid ids: "
+                         f"{sorted(METHODS)}")
     spec = METHODS[method_id]
     if pattern.direction is not spec.direction:
         pattern = _replace(pattern, direction=spec.direction)

@@ -1,0 +1,75 @@
+"""Point-to-point latency microbenchmark.
+
+TPU-native analog of ``pt2pt_test`` (mpi_sendrecv_test.c:15-74): one
+logical sender and one receiver; per rep, ``runs`` back-to-back transfers,
+then a barrier; mean/std over ``ntimes`` reps; per-rep times written to
+``sendrecv_results.csv``.
+
+On a mesh with ≥2 devices the transfer is a real single-edge
+``lax.ppermute`` 1→0 over ICI (or the virtual CPU mesh). The reference's
+Issend/Irecv+Wait pair becomes one ppermute step — rendezvous and delivery
+are one event on a lockstep collective backend; what's measured is the
+per-message link latency, same quantity as the reference.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["pt2pt_statistics"]
+
+
+def pt2pt_statistics(data_size: int, ntimes: int, runs: int, *,
+                     filename: str = "sendrecv_results.csv",
+                     out=None, devices=None) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < 2:
+        raise ValueError("pt2pt needs >= 2 devices "
+                         "(the reference requires exactly 2 ranks)")
+    mesh = Mesh(np.array(devs[:2]), ("p",))
+    sharding = NamedSharding(mesh, P("p"))
+
+    def local_fn(x):
+        # rank 1 -> rank 0, `runs` sequential transfers (chained so XLA
+        # cannot batch them into one)
+        v = x[0]
+        for _ in range(runs):
+            v = lax.ppermute(v, "p", [(1, 0)])
+            (v,) = lax.optimization_barrier((v,))
+        return v[None]
+
+    fn = jax.jit(jax.shard_map(local_fn, mesh=mesh, in_specs=P("p"),
+                               out_specs=P("p")))
+
+    buf = jax.device_put(
+        np.arange(2 * data_size, dtype=np.uint8).reshape(2, data_size),
+        sharding)
+    fn(buf).block_until_ready()  # warm-up compile
+
+    times = []
+    t_all = time.perf_counter()
+    for _ in range(ntimes):
+        t0 = time.perf_counter()
+        fn(buf).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    total = time.perf_counter() - t_all
+
+    times_a = np.array(times)
+    mean = float(times_a.mean())
+    std = float(np.sqrt(np.maximum((times_a ** 2).mean() - mean * mean, 0.0)))
+    if filename:
+        with open(filename, "w") as fh:
+            for t in times:
+                fh.write(f"{t:.6f}\n")
+    print(f"rank 0, mean = {mean:.6f}, std = {std:.6f}, ntimes = {ntimes}, "
+          f"total_timing = {total:.6f}, mean*ntimes = {mean * ntimes:.6f}",
+          file=out)
+    return {"mean": mean, "std": std, "ntimes": ntimes, "total": total,
+            "times": times}

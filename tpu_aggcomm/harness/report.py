@@ -1,0 +1,100 @@
+"""Console + CSV reporting, byte-compatible with the reference.
+
+- :func:`summarize_results` — the rank-0 console block and the appended
+  ``results.csv`` row with auto-header (mpi_test.c:2068-2118). Numbers are
+  printed with C's ``%lf`` (6 decimal places).
+- :func:`save_all_timing` — the per-rank per-rep CSV dumps
+  (``{prefix}{send_wait_all_times,total_times,post_request_time,
+  barrier_time}_{comm_size}.csv``; mpi_test.c:2008-2066).
+"""
+
+from __future__ import annotations
+
+import os
+
+from tpu_aggcomm.harness.timer import Timer
+
+__all__ = ["summarize_results", "save_all_timing", "config_banner"]
+
+_CSV_HEADER = (
+    "Method,# of processes,# of aggregators,data size,max comm,ntimes,"
+    "aggregator type,rank 0 post_request_time,rank 0 send waitall time,"
+    "rank 0 recv waitall time,rank 0 total time,max post_request_time,"
+    "max send waitall time,max recv waitall time,max total time\n")
+
+
+def _f(x: float) -> str:
+    return f"{x:.6f}"
+
+
+def config_banner(procs: int, cb_nodes: int, proc_node: int, data_size: int,
+                  comm_size: int, ntimes: int, rank_list) -> str:
+    """The rank-0 startup banner (mpi_test.c:2170-2179)."""
+    aggs = "".join(f"{int(r)}, " for r in rank_list)
+    return (f"total number of processes = {procs}, cb_nodes = {cb_nodes}, "
+            f"proc_node = {proc_node}, data size = {data_size}, "
+            f"comm_size = {comm_size}, ntimes={ntimes}\n"
+            f"aggregators = {aggs}\n")
+
+
+def summarize_results(procs: int, cb_nodes: int, data_size: int,
+                      comm_size: int, ntimes: int, agg_type: int,
+                      filename: str, prefix: str, timer0: Timer,
+                      max_timer: Timer, *, out=None) -> str:
+    """Print the per-method console block and append a results.csv row.
+
+    ``prefix`` is the method label (e.g. "All to many"); ``timer0`` is rank
+    0's timer, ``max_timer`` the max-over-ranks reduction. Returns the
+    console block. ``filename=None`` skips the CSV.
+    """
+    block = (
+        "| --------------------------------------\n"
+        f"| {prefix} rank 0 request post time = {_f(timer0.post_request_time)}\n"
+        f"| {prefix} rank 0 send waitall time = {_f(timer0.send_wait_all_time)}\n"
+        f"| {prefix} rank 0 recv waitall time = {_f(timer0.recv_wait_all_time)}\n"
+        f"| {prefix} rank 0 total time = {_f(timer0.total_time)}\n"
+        f"| {prefix} max request post time = {_f(max_timer.post_request_time)}\n"
+        f"| {prefix} max send waitall time = {_f(max_timer.send_wait_all_time)}\n"
+        f"| {prefix} max recv waitall time = {_f(max_timer.recv_wait_all_time)}\n"
+        f"| {prefix} max total time = {_f(max_timer.total_time)}\n")
+    print(block, end="", file=out)
+    if filename:
+        write_header = not os.path.exists(filename)
+        with open(filename, "a") as fh:
+            if write_header:
+                fh.write(_CSV_HEADER)
+            fh.write(
+                f"{prefix},{procs},{cb_nodes},{data_size},{comm_size},"
+                f"{ntimes},{agg_type},"
+                f"{_f(timer0.post_request_time)},{_f(timer0.send_wait_all_time)},"
+                f"{_f(timer0.recv_wait_all_time)},{_f(timer0.total_time)},"
+                f"{_f(max_timer.post_request_time)},{_f(max_timer.send_wait_all_time)},"
+                f"{_f(max_timer.recv_wait_all_time)},{_f(max_timer.total_time)}\n")
+    return block
+
+
+def save_all_timing(procs: int, ntimes: int, comm_size: int,
+                    rep_timers: list[list[Timer]], prefix: str = "",
+                    outdir: str = ".") -> list[str]:
+    """Per-rank per-rep CSV dumps (mpi_test.c:2008-2066).
+
+    ``rep_timers[rep][rank]`` is rank's Timer for that rep. Writes one file
+    per timing field, one row per rank: ``rank,rep0,rep1,...``.
+    """
+    fields = [
+        ("send_wait_all_times", "send_wait_all_time"),
+        ("total_times", "total_time"),
+        ("post_request_time", "post_request_time"),
+        ("barrier_time", "barrier_time"),
+    ]
+    written = []
+    for fname_part, attr in fields:
+        path = os.path.join(outdir, f"{prefix}{fname_part}_{comm_size}.csv")
+        with open(path, "w") as fh:
+            for rank in range(procs):
+                row = [str(rank)]
+                for rep in range(ntimes):
+                    row.append(_f(getattr(rep_timers[rep][rank], attr)))
+                fh.write(",".join(row) + "\n")
+        written.append(path)
+    return written

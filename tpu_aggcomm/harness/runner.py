@@ -1,0 +1,83 @@
+"""Experiment driver: the analog of the reference ``main`` loop
+(mpi_test.c:2120-2347) — iter × method dispatch, max-over-ranks reduction,
+console/CSV reporting, optional verification."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpu_aggcomm.backends import get_backend
+from tpu_aggcomm.core.methods import METHODS, compile_method, method_ids
+from tpu_aggcomm.core.pattern import AggregatorPattern
+from tpu_aggcomm.harness.report import (config_banner, save_all_timing,
+                                        summarize_results)
+from tpu_aggcomm.harness.timer import Timer, max_reduce
+
+__all__ = ["ExperimentConfig", "run_experiment"]
+
+
+@dataclass
+class ExperimentConfig:
+    """Mirrors the reference CLI grammar ``hp:c:m:d:a:i:k:t:r:b:``
+    (mpi_test.c:2130-2166) plus the backend switch."""
+
+    nprocs: int
+    cb_nodes: int = 1            # -a
+    method: int = 0              # -m  (0 = run all dispatched methods)
+    data_size: int = 0           # -d
+    comm_size: int = 200_000_000 # -c
+    iters: int = 1               # -i
+    ntimes: int = 1              # -k
+    proc_node: int = 1           # -p
+    agg_type: int = 1            # -t
+    prefix: str = ""             # -r
+    barrier_type: int = 0        # -b
+    backend: str = "local"       # --backend
+    verify: bool = False         # --verify
+    results_csv: str | None = "results.csv"
+    profile_rounds: bool = False
+
+
+def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
+    """Run the experiment loop; returns one record per (iter, method) with
+    rank-0 and max timers."""
+    backend = get_backend(cfg.backend)
+    pattern = AggregatorPattern(
+        nprocs=cfg.nprocs, cb_nodes=cfg.cb_nodes,
+        data_size=max(cfg.data_size, 1), placement=cfg.agg_type,
+        proc_node=cfg.proc_node, comm_size=cfg.comm_size)
+    print(config_banner(cfg.nprocs, cfg.cb_nodes, cfg.proc_node,
+                        cfg.data_size, cfg.comm_size, cfg.ntimes,
+                        pattern.rank_list), end="", file=out)
+
+    methods = method_ids() if cfg.method == 0 else [cfg.method]
+    for m in methods:
+        if m not in METHODS:
+            raise ValueError(f"unknown method id {m}; valid ids: "
+                             f"{sorted(METHODS)}")
+    records = []
+    for i in range(cfg.iters):
+        for m in methods:
+            spec = METHODS[m]
+            sched = compile_method(m, pattern, barrier_type=cfg.barrier_type)
+            kwargs = {}
+            if cfg.profile_rounds and backend.name == "jax_ici":
+                kwargs["profile_rounds"] = True
+            recv, timers = backend.run(sched, ntimes=cfg.ntimes, iter_=i,
+                                       verify=cfg.verify, **kwargs)
+            max_timer = max_reduce(timers)
+            summarize_results(cfg.nprocs, cfg.cb_nodes, cfg.data_size,
+                              cfg.comm_size, cfg.ntimes, cfg.agg_type,
+                              cfg.results_csv, spec.name, timers[0],
+                              max_timer, out=out)
+            if m == 13:
+                rep_timers = getattr(backend, "last_rep_timers", None)
+                if rep_timers:
+                    save_all_timing(cfg.nprocs, cfg.ntimes, cfg.comm_size,
+                                    rep_timers, cfg.prefix)
+            records.append({
+                "iter": i, "method": m, "name": spec.name,
+                "timer0": timers[0], "max_timer": max_timer,
+            })
+        print("| --------------------------------------", file=out)
+    return records
