@@ -39,28 +39,35 @@ def main() -> int:
     agg_index = jnp.asarray(np.asarray(p.agg_index))
     rank_list = jnp.asarray(np.asarray(p.rank_list))
 
-    send = jnp.arange(PROCS * CB_NODES * DATA_SIZE, dtype=jnp.uint8)
-    send = send.reshape(PROCS, CB_NODES, DATA_SIZE)
+    # REPS independent rep buffers: every rep exchanges ITS OWN slabs, so
+    # no rep is loop-invariant and XLA cannot hoist or CSE the exchange
+    # (a previous version chained a `& 0` dependency — it constant-folded
+    # and the loop timed a memcpy; verified via optimized HLO). All data is
+    # generated and checked ON DEVICE: host↔device transfers through the
+    # TPU tunnel would otherwise dominate the run.
+    @jax.jit
+    def make_send():
+        send = jnp.arange(REPS * PROCS * CB_NODES * DATA_SIZE,
+                          dtype=jnp.uint8)
+        return send.reshape(REPS, PROCS, CB_NODES, DATA_SIZE)
+
+    send = make_send()
+    send.block_until_ready()
 
     @jax.jit
     def exchange_reps(send):
-        # one rep: every rank's slab for aggregator g lands in g's recv row.
-        # The carry is threaded into each rep's input (dep is always 0) so
-        # the loop body is NOT loop-invariant — XLA cannot hoist the
-        # exchange out of the rep loop.
-        def one(recv_carry, _):
-            dep = (recv_carry[0, 0, 0] & 0)
-            recv = jnp.transpose(send + dep, (1, 0, 2))  # (CB, PROCS, ds)
-            (recv,) = lax.optimization_barrier((recv,))
-            return recv, None
-        recv, _ = lax.scan(one, jnp.zeros((CB_NODES, PROCS, DATA_SIZE),
-                                          jnp.uint8), None, length=REPS)
-        return recv
+        # rep r: every rank's slab for aggregator g lands in g's recv row
+        return jnp.transpose(send, (0, 2, 1, 3))  # (REPS, CB, PROCS, ds)
 
     # correctness: the exchanged slabs must match the pattern semantics
-    recv = np.asarray(exchange_reps(send))
-    expect = np.transpose(np.asarray(send), (1, 0, 2))
-    assert (recv == expect).all(), "exchange produced wrong slabs"
+    # (checked on device; only the scalar verdict comes back)
+    @jax.jit
+    def check(send):
+        recv = exchange_reps(send)
+        expect = jnp.transpose(send, (0, 2, 1, 3))
+        return jnp.array_equal(recv, expect)
+
+    assert bool(check(send)), "exchange produced wrong slabs"
 
     # timed: best of 5 windows of REPS reps
     best = float("inf")

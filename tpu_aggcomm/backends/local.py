@@ -54,15 +54,25 @@ class LocalBackend:
 
     name = "local"
 
-    def run(self, schedule: Schedule, *, ntimes: int = 1, iter_: int = 0,
+    def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
             verify: bool = False):
+        from tpu_aggcomm.tam.engine import TamMethod, tam_oracle
         p = schedule.pattern
-        recv_bufs = _alloc_recv(p)
-        send_slabs = make_send_slabs(p, iter_)  # deterministic: same every rep
+        if isinstance(schedule, TamMethod):
+            run_rep = lambda bufs: tam_oracle(schedule, iter_)  # noqa: E731
+            recv_bufs = None
+        else:
+            recv_bufs = _alloc_recv(p)
+            send_slabs = make_send_slabs(p, iter_)  # same every rep
+
+            def run_rep(bufs):
+                _run_one_rep(schedule, bufs, send_slabs)
+                return bufs
+
         self.last_rep_timers = []  # [rep][rank] -> Timer (save_all_timing)
         for _ in range(ntimes):
             t0 = time.perf_counter()
-            _run_one_rep(schedule, recv_bufs, send_slabs)
+            recv_bufs = run_rep(recv_bufs)
             dt = time.perf_counter() - t0
             self.last_rep_timers.append(
                 [Timer(total_time=dt) for _ in range(p.nprocs)])

@@ -41,10 +41,14 @@ class ExperimentConfig:
 def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
     """Run the experiment loop; returns one record per (iter, method) with
     rank-0 and max timers."""
+    if cfg.data_size < 1:
+        raise ValueError("data_size (-d) must be >= 1 "
+                         "(the reference's -d 0 default sends empty messages; "
+                         "pass an explicit size)")
     backend = get_backend(cfg.backend)
     pattern = AggregatorPattern(
         nprocs=cfg.nprocs, cb_nodes=cfg.cb_nodes,
-        data_size=max(cfg.data_size, 1), placement=cfg.agg_type,
+        data_size=cfg.data_size, placement=cfg.agg_type,
         proc_node=cfg.proc_node, comm_size=cfg.comm_size)
     print(config_banner(cfg.nprocs, cfg.cb_nodes, cfg.proc_node,
                         cfg.data_size, cfg.comm_size, cfg.ntimes,
@@ -55,11 +59,15 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
         if m not in METHODS:
             raise ValueError(f"unknown method id {m}; valid ids: "
                              f"{sorted(METHODS)}")
+    # schedules do not depend on the iteration (only the fill seed does):
+    # compile once per method, reuse across iters
+    compiled = {m: compile_method(m, pattern, barrier_type=cfg.barrier_type)
+                for m in methods}
     records = []
     for i in range(cfg.iters):
         for m in methods:
             spec = METHODS[m]
-            sched = compile_method(m, pattern, barrier_type=cfg.barrier_type)
+            sched = compiled[m]
             kwargs = {}
             if cfg.profile_rounds and backend.name == "jax_ici":
                 kwargs["profile_rounds"] = True

@@ -1,0 +1,9 @@
+"""TAM — the hierarchical two-level aggregation engine.
+
+TPU-native re-design of the reference's lustre_driver_test.c runtime core
+(SURVEY.md §2.2, §3.3). See :mod:`tpu_aggcomm.tam.engine`.
+"""
+
+from tpu_aggcomm.tam.engine import TamMethod, gen_tam_schedule
+
+__all__ = ["TamMethod", "gen_tam_schedule"]

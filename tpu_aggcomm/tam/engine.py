@@ -1,0 +1,302 @@
+"""Two-level (TAM) hierarchical exchange engine.
+
+The reference's runtime core is ``collective_write`` and its two sibling
+engines (lustre_driver_test.c:944-1309, 754-926, 604-728): all ranks funnel
+their aggregator traffic through one *proxy* per node (intra-node gather →
+proxy↔proxy inter-node exchange → local delivery). m=15/16 wrap that engine
+behind the method registry (mpi_test.c:313-419).
+
+TPU-native redesign — the mesh IS the hierarchy. We map ranks onto a 2-axis
+``(node, local)`` mesh (inner axis = ICI slice, outer axis = DCN /
+inter-slice, SURVEY.md §2.5 row "Hierarchical 2-level"):
+
+- **two_level** (the default engine for m=15/16 on the jax backend):
+  every chip participates in both hops — ``all_to_all`` on the *node* axis
+  (slabs grouped by destination node), then ``all_to_all`` on the *local*
+  axis (slabs delivered to the owning local aggregator). This is the
+  TPU-idiomatic analog of collective_write3 (every rank reachable through
+  shared memory ⇒ every chip reachable through ICI): funneling through one
+  proxy chip would serialize a node's DCN traffic through a single chip's
+  links, which is exactly backwards on TPU hardware. The reference's
+  derived-datatype zero-copy tricks (collective_write2's hindexed views,
+  l_d_t.c:848-904) become the static slot-index maps that drive the buffer
+  packs — computed once on host, compiled into the program.
+
+- **proxy oracle** (the local backend's engine): the faithful 5-phase
+  structure — P1 size exchange is compile-time static here (XLA needs
+  static shapes anyway; the reference's runtime size handshake,
+  l_d_t.c:996-1041, carries no information in the uniform span=1 pattern),
+  P2 pack+gather to the proxy, P3 proxy↔proxy runs, P4 local delivery,
+  P5 scatter. Produces per-phase byte counts so schedule shape is testable.
+
+Two-level *aggregator metadata* (``co`` local aggregators per node,
+collective_write2's architecture) plugs in through
+:func:`tpu_aggcomm.core.meta.aggregator_meta_information`; the proxy engine
+is its ``co=1`` special case.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace as _replace
+
+import numpy as np
+
+from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
+from tpu_aggcomm.core.topology import NodeAssignment, static_node_assignment
+
+__all__ = ["TamMethod", "gen_tam_schedule", "tam_oracle",
+           "tam_two_level_jax", "tam_phase_bytes"]
+
+
+@dataclass
+class TamMethod:
+    """Compiled TAM method — the object compile_method returns for m=15/16.
+
+    Not a generic Schedule: like the reference, TAM is a separate engine
+    behind the same registry (mpi_test.c:34-38 extern boundary)."""
+
+    pattern: AggregatorPattern
+    method_id: int
+    name: str
+    assignment: NodeAssignment
+    collective = False
+
+    @property
+    def nprocs(self) -> int:
+        return self.pattern.nprocs
+
+
+def gen_tam_schedule(p: AggregatorPattern) -> TamMethod:
+    """m=15 (all_to_many) / m=16 (many_to_all): simulated contiguous node
+    map from proc_node, exactly like the reference wrappers
+    (mpi_test.c:395: static_node_assignment type 0)."""
+    assignment = static_node_assignment(p.nprocs, p.proc_node, 0)
+    if p.direction is Direction.ALL_TO_MANY:
+        return TamMethod(p, 15, "All to many TAM", assignment)
+    return TamMethod(p, 16, "Many to all TAM", assignment)
+
+
+# ---------------------------------------------------------------------------
+# proxy-path oracle (numpy): faithful 5-phase structure + per-phase volumes
+
+def tam_phase_bytes(p: AggregatorPattern, na: NodeAssignment) -> dict:
+    """Byte volumes each phase moves in the proxy engine — the quantities
+    the reference's phase timers bracket (l_d_t.c:996-1309). Used by tests
+    to pin the schedule *shape* (intra vs inter traffic) independent of
+    timing."""
+    ds = p.data_size
+    node_of = na.node_of
+    agg_nodes = node_of[np.asarray(p.rank_list)]
+    if p.direction is Direction.ALL_TO_MANY:
+        senders, receivers = np.arange(p.nprocs), np.asarray(p.rank_list)
+    else:
+        senders, receivers = np.asarray(p.rank_list), np.arange(p.nprocs)
+
+    p2 = 0  # non-proxy rank -> its proxy (pack of all its slabs)
+    for s in senders:
+        if not na.is_proxy(int(s)):
+            p2 += len(receivers) * ds if p.direction is Direction.ALL_TO_MANY \
+                else p.nprocs * ds
+    p3 = 0  # proxy -> proxy (slabs whose destination lives on another node)
+    for s in senders:
+        for r in receivers:
+            if node_of[int(s)] != node_of[int(r)]:
+                p3 += ds
+    p4 = 0  # proxy -> final non-proxy destination
+    for s in senders:
+        for r in receivers:
+            if not na.is_proxy(int(r)):
+                p4 += ds
+    return {"intra_gather": p2, "inter_exchange": p3, "local_delivery": p4}
+
+
+def tam_oracle(tam: TamMethod, iter_: int = 0):
+    """Single-process proxy-path execution: pack → gather-at-proxy →
+    inter-node runs → local delivery → scatter. Data-identical to the dense
+    exchange (the engine only changes the route), so delivery is computed
+    through the explicit relay structure and then verified by the caller."""
+    from tpu_aggcomm.harness.verify import make_send_slabs
+
+    p = tam.pattern
+    na = tam.assignment
+    send = make_send_slabs(p, iter_)
+    agg_index = p.agg_index
+
+    # staging: per node, the proxy's aggregate buffer of (origin, slot) slabs
+    proxy_hold: list[list[tuple[int, int]]] = [[] for _ in range(na.nnodes)]
+    if p.direction is Direction.ALL_TO_MANY:
+        senders = range(p.nprocs)
+        slots = lambda s: range(p.cb_nodes)                  # noqa: E731
+        dest_of = lambda s, i: int(p.rank_list[i])           # noqa: E731
+    else:
+        senders = [int(r) for r in p.rank_list]
+        slots = lambda s: range(p.nprocs)                    # noqa: E731
+        dest_of = lambda s, i: i                             # noqa: E731
+
+    # P2: every sender's slabs arrive at its node proxy (self-pack for the
+    # proxy itself; one packed Issend otherwise — l_d_t.c:1069-1105)
+    for s in senders:
+        proxy_hold[int(na.node_of[s])].extend((s, i) for i in slots(s))
+
+    # P3: proxies exchange per-destination-node runs (l_d_t.c:1121-1194)
+    node_in: list[list[tuple[int, int]]] = [[] for _ in range(na.nnodes)]
+    for node, held in enumerate(proxy_hold):
+        for (s, i) in held:
+            node_in[int(na.node_of[dest_of(s, i)])].append((s, i))
+
+    # P4/P5: destination proxy re-packs per local rank and delivers
+    from tpu_aggcomm.backends.local import _alloc_recv
+    recv = _alloc_recv(p)
+    for node, incoming in enumerate(node_in):
+        for (s, i) in incoming:
+            d = dest_of(s, i)
+            if p.direction is Direction.ALL_TO_MANY:
+                recv[d][s] = send[s][i]
+            else:
+                recv[d][int(agg_index[s])] = send[s][i]
+    return recv
+
+
+# ---------------------------------------------------------------------------
+# TPU-native two-level engine (jax): all_to_all on node axis, then local axis
+
+def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
+                      ntimes: int = 1):
+    """Run the two-level exchange on a (node, local) mesh. Returns
+    (per-rank recv slabs, per-rep wall times). Rank r lives at mesh
+    coordinate (r // L, r % L) with L = ranks per node (contiguous node
+    map, the same shape static_node_assignment type 0 fabricates)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpu_aggcomm.harness.verify import make_send_slabs
+
+    p = tam.pattern
+    na = tam.assignment
+    n, ds = p.nprocs, p.data_size
+    L = int(na.node_sizes[0])
+    N = na.nnodes
+    if N * L != n:
+        raise ValueError(
+            f"two-level mesh needs nprocs divisible by proc_node; got "
+            f"nprocs={n}, proc_node={L}")
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+
+    mesh = Mesh(np.array(devices[:n]).reshape(N, L), ("node", "local"))
+    agg_index = np.asarray(p.agg_index)
+    rank_list = np.asarray(p.rank_list)
+    agg_node = (rank_list // L).astype(np.int64)
+    agg_local = (rank_list % L).astype(np.int64)
+    # per node: which aggregator (global slab index) sits at which local
+    K = max(int(c) for c in np.bincount(agg_node, minlength=N)) if len(rank_list) else 0
+    K = max(K, 1)
+    # aggs_of_node[b, j] = global agg index of node b's j-th aggregator (-1 pad)
+    aggs_of_node = np.full((N, K), -1, dtype=np.int64)
+    cnt = np.zeros(N, dtype=np.int64)
+    for gi, b in enumerate(agg_node):
+        aggs_of_node[b, cnt[b]] = gi
+        cnt[b] += 1
+    # local_of_aggslot[b, j] = local coordinate of that aggregator
+    local_of_aggslot = np.where(
+        aggs_of_node >= 0, agg_local[np.maximum(aggs_of_node, 0)], -1)
+
+    slabs = make_send_slabs(p, iter_)
+    send_g = np.zeros(
+        (n, (p.cb_nodes if p.direction is Direction.ALL_TO_MANY else n), ds),
+        dtype=np.uint8)
+    for r, s in enumerate(slabs):
+        if s is not None:
+            send_g[r, :s.shape[0]] = s
+    send_g = send_g.reshape(N, L, -1, ds)
+
+    sharding = NamedSharding(mesh, P("node", "local"))
+    send_dev = jax.device_put(send_g, sharding)
+
+    aggs_of_node_j = jnp.asarray(aggs_of_node)
+    local_of_aggslot_j = jnp.asarray(local_of_aggslot)
+
+    if p.direction is Direction.ALL_TO_MANY:
+
+        def local_fn(send):
+            # send: (1, 1, cb, ds) — my slab for each global aggregator
+            x = send[0, 0]
+            # hop 1 (DCN/node axis): group my slabs by destination node:
+            # row b = my slabs for node b's aggregators (K-padded)
+            sel = jnp.maximum(aggs_of_node_j, 0)              # (N, K)
+            mask = (aggs_of_node_j >= 0).astype(jnp.uint8)[..., None]
+            bynode = jnp.take(x, sel.reshape(-1), axis=0).reshape(N, K, ds) * mask
+            got1 = lax.all_to_all(bynode, "node", 0, 0)        # (N, K, ds)
+            # got1[a, j] = slab from source (a, my_local) for my node's agg j
+            # hop 2 (ICI/local axis): deliver each agg column j to the local
+            # coordinate that hosts that aggregator.
+            dst_local = jnp.where(local_of_aggslot_j >= 0, local_of_aggslot_j, L)
+            mynode = lax.axis_index("node")
+            dl = jnp.take(dst_local, mynode, axis=0)           # (K,)
+            # build (L+1, N, ds) buffer: row l' = columns j with dl[j] == l'
+            # K may exceed 1 per local only if two aggs share a local slot,
+            # which cannot happen (distinct ranks -> distinct locals per node)
+            buf = jnp.zeros((L + 1, N, ds), jnp.uint8)
+            buf = buf.at[dl].set(jnp.transpose(got1, (1, 0, 2)))
+            buf = buf[:L]
+            got2 = lax.all_to_all(buf, "local", 0, 0)          # (L, N, ds)
+            # got2[l', a] = slab from source rank a*L + l' (zeros if I'm not
+            # an aggregator). recv[src] ordering: src = a*L + l'.
+            recv = jnp.transpose(got2, (1, 0, 2)).reshape(n, ds)
+            return recv[None, None]
+
+        out_rows = n
+    else:
+
+        def local_fn(send):
+            # send: (1, 1, n, ds) — aggregator's slab for each dest rank
+            x = send[0, 0]
+            # hop 1 (ICI/local axis): split my slabs by destination local.
+            # row l' = my slabs for ranks (a, l'), a in [0, N)
+            bylocal = x.reshape(N, L, ds).transpose(1, 0, 2)   # (L, N, ds)
+            got1 = lax.all_to_all(bylocal, "local", 0, 0)      # (L, N, ds)
+            # got1[lg, a] = slab from (my_node, lg) for rank (a, my_local).
+            # keep only rows where (my_node, lg) is an aggregator; tag by
+            # its per-node agg slot j so hop 2 can address it statically.
+            mynode = lax.axis_index("node")
+            ls = jnp.take(local_of_aggslot_j, mynode, axis=0)  # (K,) locals
+            sel = jnp.minimum(jnp.maximum(ls, 0), L - 1)
+            mask = (ls >= 0).astype(jnp.uint8)[..., None, None]
+            byslot = jnp.take(got1, sel, axis=0) * mask        # (K, N, ds)
+            # hop 2 (DCN/node axis): send column a to node a
+            got2 = lax.all_to_all(jnp.transpose(byslot, (1, 0, 2)),
+                                  "node", 0, 0)                # (N, K, ds)
+            # got2[b, j] = slab from node b's agg j for me -> recv slot =
+            # global agg index aggs_of_node[b, j]
+            flat_idx = jnp.where(aggs_of_node_j >= 0, aggs_of_node_j,
+                                 p.cb_nodes).reshape(-1)       # (N*K,)
+            recv = jnp.zeros((p.cb_nodes + 1, ds), jnp.uint8)
+            recv = recv.at[flat_idx].set(got2.reshape(-1, ds))
+            return recv[:p.cb_nodes][None, None]
+
+        out_rows = p.cb_nodes
+
+    fn = jax.jit(jax.shard_map(
+        local_fn, mesh=mesh, in_specs=P("node", "local"),
+        out_specs=P("node", "local")))
+
+    import time as _time
+    fn(send_dev).block_until_ready()  # warm-up compile
+    rep_times = []
+    out_dev = None
+    for _ in range(max(ntimes, 1)):
+        t0 = _time.perf_counter()
+        out_dev = fn(send_dev)
+        out_dev.block_until_ready()
+        rep_times.append(_time.perf_counter() - t0)
+    out = np.asarray(jax.device_get(out_dev)).reshape(n, out_rows, ds)
+
+    recv_bufs = []
+    for rank in range(n):
+        if p.direction is Direction.ALL_TO_MANY:
+            recv_bufs.append(out[rank] if agg_index[rank] >= 0 else None)
+        else:
+            recv_bufs.append(out[rank])
+    return recv_bufs, rep_times
