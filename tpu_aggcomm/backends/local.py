@@ -87,15 +87,9 @@ class LocalBackend:
 
 
 def _alloc_recv(p: AggregatorPattern) -> list[np.ndarray | None]:
-    out: list[np.ndarray | None] = []
-    agg_index = p.agg_index
-    for rank in range(p.nprocs):
-        if p.direction is Direction.ALL_TO_MANY:
-            out.append(np.zeros((p.nprocs, p.data_size), dtype=np.uint8)
-                       if agg_index[rank] >= 0 else None)
-        else:
-            out.append(np.zeros((p.cb_nodes, p.data_size), dtype=np.uint8))
-    return out
+    from tpu_aggcomm.harness.verify import recv_slot_counts
+    return [np.zeros((c, p.data_size), dtype=np.uint8) if c else None
+            for c in recv_slot_counts(p)]
 
 
 def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:

@@ -20,7 +20,19 @@ import numpy as np
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 
 __all__ = ["fill_slab", "expected_recv", "make_send_slabs", "verify_recv",
-           "fill_slab_tam", "VerificationError"]
+           "recv_slot_counts", "fill_slab_tam", "VerificationError"]
+
+
+def recv_slot_counts(p: "AggregatorPattern") -> list[int]:
+    """How many recv slabs each rank owns — THE single definition of the
+    recv-buffer layout (prepare_* analog, mpi_test.c:94-133/162-202):
+    all-to-many aggregators own nprocs slabs (others none); many-to-all
+    ranks all own cb_nodes slabs. Backends must derive their buffers from
+    this so they cannot diverge from the verifier."""
+    agg_index = p.agg_index
+    if p.direction is Direction.ALL_TO_MANY:
+        return [p.nprocs if agg_index[r] >= 0 else 0 for r in range(p.nprocs)]
+    return [p.cb_nodes] * p.nprocs
 
 
 class VerificationError(AssertionError):

@@ -1,0 +1,395 @@
+// Native schedule executor: N-thread rank runtime with MPI-faithful
+// message semantics.
+//
+// This is the framework's native runtime tier (the reference's entire
+// program is native C — SURVEY.md §2 "no component may be a pure-Python
+// stand-in"). It executes the same compiled op programs as the Python
+// backends, but with REAL concurrency semantics:
+//
+//   - ISSEND (MPI_Issend analog): completes only when the matching receive
+//     is posted — true rendezvous, the congestion-exposing behavior the
+//     reference builds its sync/half-sync studies on (mpi_test.c Issend
+//     call sites).
+//   - ISEND: eager — payload buffered at post time, completes immediately.
+//   - SEND/RECV/SENDRECV: blocking (standard-mode send = eager buffer).
+//   - WAITALL over explicit token sets; BARRIER via shared generation
+//     counter; 0-byte SIGNAL channel (the dup'ed signal_comm analog,
+//     mpi_test.c:1252); ALLTOALLW as barrier + direct shared-memory copy.
+//
+// Each rank is one thread; channels are per-(src,dst[,signal]) FIFO queues
+// (message matching per directed pair is unique per rep in every reference
+// schedule; FIFO covers the multi-rep no-resync case, mpi_test.c:2150).
+// Per-op timer buckets mirror the reference's MPI_Wtime bracketing.
+//
+// C ABI only (ctypes-friendly); no Python.h dependency.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum OpKind : int32_t {
+  kIsend = 0,
+  kIssend = 1,
+  kIrecv = 2,
+  kSend = 3,
+  kRecv = 4,
+  kSendrecv = 5,
+  kWaitall = 6,
+  kBarrier = 7,
+  kCopy = 8,
+  kSignalSend = 9,
+  kSignalRecv = 10,
+  kAlltoallw = 11,
+};
+
+enum Bucket : int32_t {
+  kPost = 0,
+  kRecvWait = 1,
+  kSendWait = 2,
+  kRecvAndSendWait = 3,
+  kBarrierB = 4,
+  kNone = 5,
+};
+
+struct NOp {
+  int32_t kind;
+  int32_t peer;
+  int32_t slot;
+  int32_t peer2;
+  int32_t slot2;
+  int32_t token;
+  int32_t nbytes;
+  int32_t bucket;
+  int32_t ntokens;   // WAITALL: number of tokens
+  int32_t tok_ofs;   // WAITALL: offset into wait_tokens array
+};
+
+struct Timer5 {
+  double post = 0, send_wait = 0, recv_wait = 0, barrier = 0, total = 0;
+};
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// One message in flight on a channel. Rendezvous (Issend) vs eager (Isend/
+// Send) is expressed through `send_done`: rendezvous sends pass their token
+// flag (set at match time); eager sends pass null (flag set at post time).
+struct Msg {
+  const uint8_t* src_data = nullptr;  // sender slab (valid whole run)
+  int32_t nbytes = 0;
+  std::atomic<bool>* send_done = nullptr;
+};
+
+struct Channel {
+  std::deque<Msg> sends;
+  std::deque<std::pair<uint8_t*, std::atomic<bool>*>> recvs;  // dst buf, flag
+};
+
+struct Runtime {
+  int n;
+  std::mutex mu;                 // single lock: correctness over scalability
+  std::condition_variable cv;    // (1-core image; contention is the workload)
+  std::vector<Channel> data_ch;  // n*n
+  std::vector<std::deque<int>> signal_ch;  // n*n: queue of 0-byte signals
+  // barrier
+  int barrier_waiting = 0;
+  int64_t barrier_gen = 0;
+  // alltoallw rendezvous
+  int a2a_waiting = 0;
+  int64_t a2a_gen = 0;
+
+  explicit Runtime(int nprocs)
+      : n(nprocs), data_ch(nprocs * nprocs), signal_ch(nprocs * nprocs) {}
+
+  Channel& ch(int src, int dst) { return data_ch[src * n + dst]; }
+
+  // Try to match the channel head send/recv; called with mu held.
+  void match(int src, int dst) {
+    Channel& c = ch(src, dst);
+    while (!c.sends.empty() && !c.recvs.empty()) {
+      Msg m = c.sends.front();
+      c.sends.pop_front();
+      auto [buf, rflag] = c.recvs.front();
+      c.recvs.pop_front();
+      if (m.nbytes > 0 && buf != nullptr && m.src_data != nullptr) {
+        std::memcpy(buf, m.src_data, m.nbytes);
+      }
+      if (m.send_done) m.send_done->store(true, std::memory_order_release);
+      if (rflag) rflag->store(true, std::memory_order_release);
+    }
+  }
+};
+
+struct RankCtx {
+  Runtime* rt;
+  int rank;
+  const NOp* ops;
+  int nops;
+  const int32_t* wait_tokens;
+  // slab bases
+  const uint8_t* send_base;   // this rank's send slabs (nslots * data_size)
+  uint8_t* recv_base;         // this rank's recv slabs
+  int data_size;
+  // token flags for this rank
+  std::vector<std::atomic<bool>> flags;
+  Timer5* timers;             // per-rep Timer array (ntimes entries)
+  // global alltoallw inputs
+  const uint8_t* const* all_send_bases;
+  const int32_t* a2a_src_slot;  // per (dst,src): sender slot or -1
+  const int32_t* a2a_dst_slot;  // per (dst,src): recv slot
+};
+
+void run_rank(RankCtx* cx, int ntimes) {
+  Runtime& rt = *cx->rt;
+  const int n = rt.n;
+  for (int rep = 0; rep < ntimes; ++rep) {
+    Timer5& t = cx->timers[rep];
+    for (auto& f : cx->flags) f.store(false, std::memory_order_relaxed);
+    double rep_start = now_s();
+    for (int i = 0; i < cx->nops; ++i) {
+      const NOp& op = cx->ops[i];
+      double t0 = now_s();
+      switch (op.kind) {
+        case kIsend:
+        case kIssend: {
+          std::unique_lock<std::mutex> lk(rt.mu);
+          Msg m;
+          m.src_data = cx->send_base + (size_t)op.slot * cx->data_size;
+          m.nbytes = op.nbytes;
+          m.send_done = &cx->flags[op.token];
+          if (op.kind == kIsend) {
+            // eager: complete at post; payload stays valid (deterministic
+            // fill is never overwritten), so the copy happens at match.
+            cx->flags[op.token].store(true, std::memory_order_release);
+            m.send_done = nullptr;
+          }
+          rt.ch(cx->rank, op.peer).sends.push_back(m);
+          rt.match(cx->rank, op.peer);
+          rt.cv.notify_all();
+          break;
+        }
+        case kIrecv: {
+          std::unique_lock<std::mutex> lk(rt.mu);
+          uint8_t* buf = cx->recv_base + (size_t)op.slot * cx->data_size;
+          rt.ch(op.peer, cx->rank).recvs.push_back({buf, &cx->flags[op.token]});
+          rt.match(op.peer, cx->rank);
+          rt.cv.notify_all();
+          break;
+        }
+        case kSend: {
+          // standard-mode blocking send: eager buffer semantics (see the
+          // oracle's rationale — strict rendezvous deadlocks m=6/7)
+          std::unique_lock<std::mutex> lk(rt.mu);
+          Msg m;
+          m.src_data = cx->send_base + (size_t)op.slot * cx->data_size;
+          m.nbytes = op.nbytes;
+          rt.ch(cx->rank, op.peer).sends.push_back(m);
+          rt.match(cx->rank, op.peer);
+          rt.cv.notify_all();
+          break;
+        }
+        case kRecv: {
+          std::unique_lock<std::mutex> lk(rt.mu);
+          uint8_t* buf = cx->recv_base + (size_t)op.slot * cx->data_size;
+          std::atomic<bool> done{false};
+          rt.ch(op.peer, cx->rank).recvs.push_back({buf, &done});
+          rt.match(op.peer, cx->rank);
+          rt.cv.notify_all();
+          rt.cv.wait(lk, [&] { return done.load(std::memory_order_acquire); });
+          break;
+        }
+        case kSendrecv: {
+          // pairwise methods post zero-byte slots with slot = -1 and
+          // receivers without buffers (mpi_test.c:466-478); never form the
+          // pointer in those cases (UB even if unread)
+          std::unique_lock<std::mutex> lk(rt.mu);
+          Msg m;
+          m.src_data = (op.nbytes > 0 && op.slot >= 0)
+                           ? cx->send_base + (size_t)op.slot * cx->data_size
+                           : nullptr;
+          m.nbytes = op.nbytes;
+          rt.ch(cx->rank, op.peer).sends.push_back(m);
+          rt.match(cx->rank, op.peer);
+          uint8_t* buf = (cx->recv_base != nullptr && op.slot2 >= 0)
+                             ? cx->recv_base + (size_t)op.slot2 * cx->data_size
+                             : nullptr;
+          std::atomic<bool> done{false};
+          rt.ch(op.peer2, cx->rank).recvs.push_back({buf, &done});
+          rt.match(op.peer2, cx->rank);
+          rt.cv.notify_all();
+          rt.cv.wait(lk, [&] { return done.load(std::memory_order_acquire); });
+          break;
+        }
+        case kWaitall: {
+          std::unique_lock<std::mutex> lk(rt.mu);
+          rt.cv.wait(lk, [&] {
+            for (int k = 0; k < op.ntokens; ++k) {
+              int tok = cx->wait_tokens[op.tok_ofs + k];
+              if (!cx->flags[tok].load(std::memory_order_acquire)) return false;
+            }
+            return true;
+          });
+          break;
+        }
+        case kBarrier: {
+          std::unique_lock<std::mutex> lk(rt.mu);
+          int64_t my_gen = rt.barrier_gen;
+          if (++rt.barrier_waiting == n) {
+            rt.barrier_waiting = 0;
+            ++rt.barrier_gen;
+            rt.cv.notify_all();
+          } else {
+            rt.cv.wait(lk, [&] { return rt.barrier_gen != my_gen; });
+          }
+          break;
+        }
+        case kCopy: {
+          std::memcpy(cx->recv_base + (size_t)op.slot2 * cx->data_size,
+                      cx->send_base + (size_t)op.slot * cx->data_size,
+                      cx->data_size);
+          break;
+        }
+        case kSignalSend: {
+          std::unique_lock<std::mutex> lk(rt.mu);
+          rt.signal_ch[cx->rank * n + op.peer].push_back(1);
+          if (op.token >= 0)
+            cx->flags[op.token].store(true, std::memory_order_release);
+          rt.cv.notify_all();
+          break;
+        }
+        case kSignalRecv: {
+          std::unique_lock<std::mutex> lk(rt.mu);
+          auto& q = rt.signal_ch[op.peer * n + cx->rank];
+          rt.cv.wait(lk, [&] { return !q.empty(); });
+          q.pop_front();
+          break;
+        }
+        case kAlltoallw: {
+          // barrier in, shared-memory exchange, barrier out — the whole
+          // pattern in "one collective" (mpi_test.c:627/912)
+          std::unique_lock<std::mutex> lk(rt.mu);
+          int64_t my_gen = rt.a2a_gen;
+          if (++rt.a2a_waiting == n) {
+            rt.a2a_waiting = 0;
+            ++rt.a2a_gen;
+            rt.cv.notify_all();
+          } else {
+            rt.cv.wait(lk, [&] { return rt.a2a_gen != my_gen; });
+          }
+          lk.unlock();
+          if (cx->recv_base != nullptr) {
+            for (int src = 0; src < n; ++src) {
+              int32_t ss = cx->a2a_src_slot[cx->rank * n + src];
+              if (ss < 0) continue;
+              int32_t ds = cx->a2a_dst_slot[cx->rank * n + src];
+              std::memcpy(cx->recv_base + (size_t)ds * cx->data_size,
+                          cx->all_send_bases[src] + (size_t)ss * cx->data_size,
+                          cx->data_size);
+            }
+          }
+          // closing barrier so no rank races into the next rep's exchange
+          lk.lock();
+          my_gen = rt.a2a_gen;
+          if (++rt.a2a_waiting == n) {
+            rt.a2a_waiting = 0;
+            ++rt.a2a_gen;
+            rt.cv.notify_all();
+          } else {
+            rt.cv.wait(lk, [&] { return rt.a2a_gen != my_gen; });
+          }
+          break;
+        }
+      }
+      double dt = now_s() - t0;
+      switch (op.bucket) {
+        case kPost: t.post += dt; break;
+        case kRecvWait: t.recv_wait += dt; break;
+        case kSendWait: t.send_wait += dt; break;
+        case kRecvAndSendWait: t.recv_wait += dt; t.send_wait += dt; break;
+        case kBarrierB: t.barrier += dt; break;
+        default: break;
+      }
+    }
+    t.total = now_s() - rep_start;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Execute one compiled schedule. Arrays are flattened per rank:
+//   ops[prog_ofs[r] .. prog_ofs[r+1])   rank r's op program
+//   wait_tokens                         shared token-id pool for WAITALLs
+//   send_slabs + send_ofs[r]*data_size  rank r's send slabs (contiguous)
+//   recv_bufs + recv_ofs[r]*data_size   rank r's recv slabs (contiguous;
+//                                       recv_ofs[r] < 0 => rank receives
+//                                       nothing)
+//   a2a_src_slot/a2a_dst_slot           (n*n) alltoallw slot maps, or null
+//   timers_out                          n * ntimes * 5 doubles
+// Returns 0 on success.
+int agg_run_schedule(int nprocs, int ntimes, int data_size,
+                     const NOp* ops, const int32_t* prog_ofs,
+                     const int32_t* wait_tokens,
+                     const uint8_t* send_slabs, const int32_t* send_ofs,
+                     uint8_t* recv_bufs, const int32_t* recv_ofs,
+                     const int32_t* a2a_src_slot, const int32_t* a2a_dst_slot,
+                     int32_t max_token, double* timers_out) {
+  Runtime rt(nprocs);
+  std::vector<RankCtx> ctxs(nprocs);
+  std::vector<std::vector<Timer5>> timers(nprocs,
+                                          std::vector<Timer5>(ntimes));
+  std::vector<const uint8_t*> send_bases(nprocs);
+  for (int r = 0; r < nprocs; ++r) {
+    send_bases[r] = send_slabs + (size_t)send_ofs[r] * data_size;
+  }
+  for (int r = 0; r < nprocs; ++r) {
+    RankCtx& cx = ctxs[r];
+    cx.rt = &rt;
+    cx.rank = r;
+    cx.ops = ops + prog_ofs[r];
+    cx.nops = prog_ofs[r + 1] - prog_ofs[r];
+    cx.wait_tokens = wait_tokens;
+    cx.send_base = send_bases[r];
+    cx.recv_base =
+        recv_ofs[r] < 0 ? nullptr
+                        : recv_bufs + (size_t)recv_ofs[r] * data_size;
+    cx.data_size = data_size;
+    cx.flags = std::vector<std::atomic<bool>>(max_token + 1);
+    cx.timers = timers[r].data();
+    cx.all_send_bases = send_bases.data();
+    cx.a2a_src_slot = a2a_src_slot;
+    cx.a2a_dst_slot = a2a_dst_slot;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nprocs);
+  for (int r = 0; r < nprocs; ++r) {
+    threads.emplace_back(run_rank, &ctxs[r], ntimes);
+  }
+  for (auto& th : threads) th.join();
+  for (int r = 0; r < nprocs; ++r) {
+    for (int m = 0; m < ntimes; ++m) {
+      const Timer5& t = timers[r][m];
+      double* o = timers_out + ((size_t)r * ntimes + m) * 5;
+      o[0] = t.post;
+      o[1] = t.send_wait;
+      o[2] = t.recv_wait;
+      o[3] = t.barrier;
+      o[4] = t.total;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
