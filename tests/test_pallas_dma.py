@@ -1,0 +1,60 @@
+"""Pallas remote-DMA backend (interpret mode on the CPU mesh): the
+sync-family methods the backend exists for, plus permutation completion."""
+
+import numpy as np
+import pytest
+
+from tpu_aggcomm.backends.pallas_dma import PallasDmaBackend, complete_permutation
+from tpu_aggcomm.core.methods import compile_method
+from tpu_aggcomm.core.pattern import AggregatorPattern
+
+
+def test_complete_permutation():
+    perm = complete_permutation([(0, 3), (2, 1)], 4)
+    assert perm[0] == 3 and perm[2] == 1
+    assert sorted(perm.tolist()) == [0, 1, 2, 3]
+    # self-loops preferred for idle devices
+    perm2 = complete_permutation([(1, 2)], 4)
+    assert perm2[0] == 0 and perm2[3] == 3
+
+
+# the sync/half-sync/signal family — the methods whose rendezvous semantics
+# this backend exists to express (SURVEY.md §7 hard part 1)
+@pytest.mark.parametrize("method", [6, 7, 11, 12, 18])
+def test_pallas_sync_family(method):
+    p = AggregatorPattern(8, 3, data_size=64, comm_size=3)
+    sched = compile_method(method, p)
+    recv, timers = PallasDmaBackend().run(sched, verify=True)
+    assert timers[0].total_time > 0
+
+
+@pytest.mark.parametrize("method", [1, 3, 20])
+def test_pallas_general_methods(method):
+    p = AggregatorPattern(8, 3, data_size=32, comm_size=2)
+    sched = compile_method(method, p)
+    PallasDmaBackend().run(sched, verify=True)
+
+
+def test_pallas_dense_delegates():
+    p = AggregatorPattern(8, 3, data_size=32)
+    sched = compile_method(8, p)
+    recv, _ = PallasDmaBackend().run(sched, verify=True)
+
+
+def test_pallas_barrier_method():
+    # m=17 barriers every round; m=13 -b 1 barriers at rep end
+    p = AggregatorPattern(8, 3, data_size=32, comm_size=4)
+    PallasDmaBackend().run(compile_method(17, p), verify=True)
+    PallasDmaBackend().run(compile_method(13, p, barrier_type=1), verify=True)
+
+
+def test_pallas_unpadded_data_size():
+    # data_size not a multiple of 128 exercises the pad/slice path
+    p = AggregatorPattern(8, 3, data_size=100, comm_size=3)
+    PallasDmaBackend().run(compile_method(12, p), verify=True)
+
+
+def test_pallas_rejects_tam():
+    p = AggregatorPattern(8, 3, data_size=16, proc_node=2)
+    with pytest.raises(ValueError, match="TAM"):
+        PallasDmaBackend().run(compile_method(15, p))

@@ -61,7 +61,11 @@ def build_library(force: bool = False) -> str:
     try:
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
                _SRC, "-o", tmp]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        res = subprocess.run(cmd, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"native runtime build failed ({' '.join(cmd)}):\n"
+                f"{res.stderr}")
         os.replace(tmp, out)
     finally:
         if os.path.exists(tmp):

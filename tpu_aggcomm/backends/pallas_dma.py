@@ -1,0 +1,280 @@
+"""Pallas remote-DMA backend: one-sided pushes with explicit semaphores.
+
+The TPU-native transport tier for the *synchronization-sensitive* methods
+(SURVEY.md §7 hard part (1)): ``lax.ppermute`` has no notion of a
+synchronous send, so the congestion behavior the reference studies with
+MPI_Issend (m=6/7/11/12/18) only exists on TPU as explicit semaphore
+protocol. This backend runs a whole rep as ONE Pallas kernel per device,
+built from **permutation-DMA steps**: each step, every chip issues exactly
+one ``make_async_remote_copy`` along a full permutation of the mesh
+(schedule edges completed with self-loops), then waits its send and its
+arrival semaphores. Steps:
+
+- one data step per color (the same bipartite-coloring lowering the
+  jax_ici backend uses), pushing the sender's slab directly into the
+  receiver's recv-buffer slot — one-sided, like the reference's
+  aggregation writes;
+- **rendezvous (Issend) = CTS-before-RTS**: methods built on Issend get a
+  grant step (the reverse permutation) before each data step — the
+  receiver's chip must explicitly clear the sender before data moves. The
+  reference's m=18 control-signal handshake (mpi_test.c:1283-1301) is this
+  protocol made explicit: on this backend it is simply the transport.
+- reference MPI_Barrier rounds = n rotation steps (everyone hears from
+  everyone).
+
+Design note: steps are SPMD-uniform — non-participating chips move a dummy
+row to their own trash slot — because divergent (``pl.when``-gated) remote
+DMA is neither interpretable nor good TPU practice; the volume overhead is
+one row per idle chip per step. Per-phase host timing is not observable
+inside one kernel (total_time only); the native backend carries per-phase
+attribution.
+
+Runs compiled on real TPU meshes and in Pallas interpret mode on the
+virtual CPU mesh (auto-selected off-TPU), so the same kernel is testable
+everywhere.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
+from tpu_aggcomm.core.schedule import Schedule
+from tpu_aggcomm.harness.timer import Timer
+from tpu_aggcomm.harness.verify import make_send_slabs, recv_slot_counts
+
+__all__ = ["PallasDmaBackend", "complete_permutation"]
+
+AXIS = "ranks"
+
+
+def _pad128(x: int) -> int:
+    return (x + 127) // 128 * 128
+
+
+def complete_permutation(pairs: list[tuple[int, int]], n: int) -> np.ndarray:
+    """Extend a partial permutation (unique srcs, unique dsts) to a full
+    bijection on [0, n): unmatched sources are paired with unmatched
+    destinations (self first when possible). Returns dst_of (n,)."""
+    dst_of = np.full(n, -1, dtype=np.int64)
+    used_dst = np.zeros(n, dtype=bool)
+    for s, d in pairs:
+        dst_of[s] = d
+        used_dst[d] = True
+    free_src = [i for i in range(n) if dst_of[i] < 0]
+    free_dst = [i for i in range(n) if not used_dst[i]]
+    # prefer self-loops, then pair the rest in order
+    for i in list(free_src):
+        if i in free_dst:
+            dst_of[i] = i
+            free_src.remove(i)
+            free_dst.remove(i)
+    for s, d in zip(free_src, free_dst):
+        dst_of[s] = d
+    return dst_of
+
+
+class PallasDmaBackend:
+    """Executes schedules as semaphore-synchronized remote-DMA kernels."""
+
+    name = "pallas_dma"
+
+    def __init__(self, devices=None, interpret: bool | None = None):
+        self._devices = devices
+        self._interpret = interpret
+        self._cache: dict = {}
+
+    def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
+            verify: bool = False):
+        from tpu_aggcomm.tam.engine import TamMethod
+        if isinstance(schedule, TamMethod):
+            raise ValueError("TAM methods run on the local/jax_ici backends")
+        if ntimes < 1:
+            raise ValueError("ntimes must be >= 1")
+        if schedule.collective:
+            # dense vendor-collective methods belong to lax.all_to_all;
+            # delegate so `--backend pallas_dma -m 0` still covers them
+            from tpu_aggcomm.backends.jax_ici import JaxIciBackend
+            jb = JaxIciBackend(self._devices)
+            out = jb.run(schedule, ntimes=ntimes, iter_=iter_, verify=verify)
+            self.last_rep_timers = jb.last_rep_timers
+            return out
+
+        p = schedule.pattern
+        n = p.nprocs
+        devs = list(self._devices) if self._devices is not None else jax.devices()
+        if len(devs) < n:
+            raise ValueError(f"pattern needs {n} devices, have {len(devs)}")
+        interpret = (self._interpret if self._interpret is not None
+                     else devs[0].platform != "tpu")
+        mesh = Mesh(np.array(devs[:n]), (AXIS,))
+        sharding = NamedSharding(mesh, P(AXIS))
+
+        fn, pds, n_send_slots, n_recv_slots, tabs = self._lower(
+            schedule, mesh, interpret)
+
+        # slab arenas padded to the DMA row size; one extra dummy row at the
+        # end feeds the uniform self-loop steps
+        slabs = make_send_slabs(p, iter_)
+        send_g = np.zeros((n, n_send_slots + 1, pds), dtype=np.uint8)
+        for r, s in enumerate(slabs):
+            if s is not None:
+                send_g[r, :s.shape[0], :p.data_size] = s
+        send_dev = jax.device_put(send_g, sharding)
+        tab_devs = [jax.device_put(t, sharding) for t in tabs]
+
+        fn(send_dev, *tab_devs).block_until_ready()  # warm-up compile
+
+        timers = [Timer() for _ in range(n)]
+        self.last_rep_timers = []
+        out = None
+        for _ in range(ntimes):
+            t0 = time.perf_counter()
+            out = fn(send_dev, *tab_devs)
+            out.block_until_ready()
+            dt = time.perf_counter() - t0
+            for t in timers:
+                t.total_time += dt
+            self.last_rep_timers.append([Timer(total_time=dt)
+                                         for _ in range(n)])
+
+        recv_np = np.asarray(jax.device_get(out))[:, :n_recv_slots,
+                                                  :p.data_size]
+        counts = recv_slot_counts(p)
+        recv_bufs = [recv_np[r] if counts[r] else None for r in range(n)]
+        if verify:
+            from tpu_aggcomm.harness.verify import verify_recv
+            verify_recv(p, recv_bufs, iter_)
+        return recv_bufs, timers
+
+    # ------------------------------------------------------------------
+    def _lower(self, schedule: Schedule, mesh: Mesh, interpret: bool):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        from tpu_aggcomm.backends.jax_ici import lower_schedule
+
+        p = schedule.pattern
+        n = p.nprocs
+        pds = _pad128(p.data_size)
+        low = lower_schedule(schedule)
+        rtable = schedule.recv_slot_table()
+        rdv = bool(schedule.uses_rendezvous)
+        n_recv_slots = low.n_recv_slots
+        trash = n_recv_slots            # recv trash row index
+        dummy = low.n_send_slots        # send dummy row index
+
+        # Build the uniform permutation-step program: per step, tables of
+        # (dst, src, send slot, remote recv slot) for every device.
+        step_dst: list[np.ndarray] = []
+        step_src: list[np.ndarray] = []
+        step_sslot: list[np.ndarray] = []
+        step_rslot: list[np.ndarray] = []
+
+        def add_step(dst_of: np.ndarray, sslot: np.ndarray,
+                     rslot: np.ndarray):
+            src_of = np.empty(n, dtype=np.int64)
+            src_of[dst_of] = np.arange(n)
+            step_dst.append(dst_of.astype(np.int32))
+            step_src.append(src_of.astype(np.int32))
+            step_sslot.append(sslot.astype(np.int32))
+            step_rslot.append(rslot.astype(np.int32))
+
+        def add_barrier():
+            # n rotation steps: after them every chip has heard from every
+            # other chip — a full barrier out of permutation steps
+            for k in range(1, n + 1):
+                dst_of = (np.arange(n) + k) % n
+                add_step(dst_of, np.full(n, dummy), np.full(n, trash))
+
+        # init barrier: no data may land before every chip has zeroed its
+        # recv buffer (the reference's MPI_Barrier after prepare_*, e.g.
+        # mpi_test.c:1762). Tokens landing early only touch the trash row.
+        add_barrier()
+
+        C = low.n_colors
+        for c in range(C):
+            pairs = low.perms[c]
+            data_perm = complete_permutation(pairs, n)
+            sslot = np.full(n, dummy, dtype=np.int64)
+            rslot = np.full(n, trash, dtype=np.int64)
+            for (s, d) in pairs:
+                sslot[s] = int(low.sslot_tab[s, c])
+                rslot[s] = rtable[(s, d)]   # sender-side view of remote slot
+            if rdv:
+                # CTS grant step: the reverse permutation (receiver -> sender)
+                cts_pairs = [(d, s) for (s, d) in pairs]
+                add_step(complete_permutation(cts_pairs, n),
+                         np.full(n, dummy), np.full(n, trash))
+            add_step(data_perm, sslot, rslot)
+            rnd = low.round_of_color[c]
+            is_last_of_round = (c + 1 == C
+                                or low.round_of_color[c + 1] != rnd)
+            if is_last_of_round:
+                for _ in range(low.barrier_rounds.get(rnd, 0)):
+                    add_barrier()
+
+        NS = len(step_dst)
+        dst_tab = np.stack(step_dst, axis=1)      # (n, NS)
+        src_tab = np.stack(step_src, axis=1)
+        sslot_tab = np.stack(step_sslot, axis=1)
+        rslot_tab = np.stack(step_rslot, axis=1)
+
+        cache_key = (p, interpret, dst_tab.tobytes(), sslot_tab.tobytes(),
+                     rslot_tab.tobytes())
+        if cache_key in self._cache:
+            return self._cache[cache_key]
+
+        R1 = n_recv_slots + 1
+
+        def kernel(dst_r, src_r, sslot_r, rslot_r, send_r, recv_r,
+                   ssem, rsem):
+            recv_r[...] = jnp.zeros((1, R1, pds), jnp.uint8)
+            for st in range(NS):
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=send_r.at[0, pl.ds(sslot_r[0, st], 1)],
+                    dst_ref=recv_r.at[0, pl.ds(rslot_r[0, st], 1)],
+                    send_sem=ssem, recv_sem=rsem,
+                    device_id=dst_r[0, st],
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                rdma.start()
+                rdma.wait_send()
+                # await my arrival for this step (every chip receives
+                # exactly one row per step; uniform sizes keep semaphore
+                # accounting exact)
+                rdma_in = pltpu.make_async_remote_copy(
+                    src_ref=send_r.at[0, pl.ds(0, 1)],
+                    dst_ref=recv_r.at[0, pl.ds(rslot_r[0, st], 1)],
+                    send_sem=ssem, recv_sem=rsem,
+                    device_id=src_r[0, st],
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                rdma_in.wait_recv()
+
+        def outer(send, dst_a, src_a, sslot_a, rslot_a):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((1, R1, pds), jnp.uint8),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 4
+                + [pl.BlockSpec(memory_space=pl.ANY)],
+                out_specs=pl.BlockSpec(memory_space=pl.ANY),
+                scratch_shapes=[pltpu.SemaphoreType.DMA,
+                                pltpu.SemaphoreType.DMA],
+                compiler_params=pltpu.CompilerParams(
+                    has_side_effects=True, collective_id=0),
+                interpret=interpret,
+            )(dst_a, src_a, sslot_a, rslot_a, send)
+
+        sm = jax.shard_map(outer, mesh=mesh,
+                           in_specs=(P(AXIS),) * 5, out_specs=P(AXIS),
+                           check_vma=False)
+        fn = jax.jit(sm)
+        tabs = [dst_tab, src_tab, sslot_tab, rslot_tab]
+        result = (fn, pds, low.n_send_slots, n_recv_slots, tabs)
+        self._cache[cache_key] = result
+        return result

@@ -114,6 +114,19 @@ struct Runtime {
 
   Channel& ch(int src, int dst) { return data_ch[src * n + dst]; }
 
+  // Generation-counted rendezvous of all n ranks; caller holds `lk` on mu.
+  void gen_barrier(std::unique_lock<std::mutex>& lk, int& waiting,
+                   int64_t& gen) {
+    int64_t my_gen = gen;
+    if (++waiting == n) {
+      waiting = 0;
+      ++gen;
+      cv.notify_all();
+    } else {
+      cv.wait(lk, [&] { return gen != my_gen; });
+    }
+  }
+
   // Try to match the channel head send/recv; called with mu held.
   void match(int src, int dst) {
     Channel& c = ch(src, dst);
@@ -244,14 +257,7 @@ void run_rank(RankCtx* cx, int ntimes) {
         }
         case kBarrier: {
           std::unique_lock<std::mutex> lk(rt.mu);
-          int64_t my_gen = rt.barrier_gen;
-          if (++rt.barrier_waiting == n) {
-            rt.barrier_waiting = 0;
-            ++rt.barrier_gen;
-            rt.cv.notify_all();
-          } else {
-            rt.cv.wait(lk, [&] { return rt.barrier_gen != my_gen; });
-          }
+          rt.gen_barrier(lk, rt.barrier_waiting, rt.barrier_gen);
           break;
         }
         case kCopy: {
@@ -279,14 +285,7 @@ void run_rank(RankCtx* cx, int ntimes) {
           // barrier in, shared-memory exchange, barrier out — the whole
           // pattern in "one collective" (mpi_test.c:627/912)
           std::unique_lock<std::mutex> lk(rt.mu);
-          int64_t my_gen = rt.a2a_gen;
-          if (++rt.a2a_waiting == n) {
-            rt.a2a_waiting = 0;
-            ++rt.a2a_gen;
-            rt.cv.notify_all();
-          } else {
-            rt.cv.wait(lk, [&] { return rt.a2a_gen != my_gen; });
-          }
+          rt.gen_barrier(lk, rt.a2a_waiting, rt.a2a_gen);
           lk.unlock();
           if (cx->recv_base != nullptr) {
             for (int src = 0; src < n; ++src) {
@@ -300,14 +299,7 @@ void run_rank(RankCtx* cx, int ntimes) {
           }
           // closing barrier so no rank races into the next rep's exchange
           lk.lock();
-          my_gen = rt.a2a_gen;
-          if (++rt.a2a_waiting == n) {
-            rt.a2a_waiting = 0;
-            ++rt.a2a_gen;
-            rt.cv.notify_all();
-          } else {
-            rt.cv.wait(lk, [&] { return rt.a2a_gen != my_gen; });
-          }
+          rt.gen_barrier(lk, rt.a2a_waiting, rt.a2a_gen);
           break;
         }
       }
