@@ -1,0 +1,194 @@
+"""Workload layer + collective_write-family engines.
+
+Pins the initialize_setting semantics (lustre_driver_test.c:447-549), the
+four engine routes' delivery (test_correctness, l_d_t.c:46-58), their
+per-hop byte accounting, and the JAX two-level mesh engine against the
+oracles on the virtual 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_aggcomm.core.meta import aggregator_meta_information
+from tpu_aggcomm.core.topology import static_node_assignment
+from tpu_aggcomm.core.workload import StripeType, Workload, initialize_setting
+from tpu_aggcomm.harness.verify import VerificationError, fill_slab_tam
+from tpu_aggcomm.tam.workload_engines import (
+    RouteStats, cw2_local_agg, cw2_local_agg_jax, cw3_shared, cw_benchmark,
+    cw_proxy, recv_index_map, run_workload_engine)
+
+
+def _mk(nprocs=8, per_node=4, blocklen=5, stripe=StripeType.ALL, kind=0):
+    na = static_node_assignment(nprocs, per_node, kind)
+    return na, initialize_setting(na, blocklen, stripe)
+
+
+# ---------------------------------------------------------------------------
+# initialize_setting semantics
+
+def test_stripe_aggregator_sets():
+    na = static_node_assignment(8, 4, 0)
+    assert list(initialize_setting(na, 3, StripeType.SAME).aggregators) == [0, 4]
+    assert list(initialize_setting(na, 3, StripeType.GREATER).aggregators) == [1, 3, 5, 7]
+    assert list(initialize_setting(na, 3, StripeType.LESS).aggregators) == [0, 1, 2, 3]
+    assert list(initialize_setting(na, 3, StripeType.ALL).aggregators) == list(range(8))
+
+
+def test_sizes_match_reference_formula():
+    # send_size[dst] = 1 + rank % blocklen for dst in aggregator set, else 0
+    # (l_d_t.c:471-472 and siblings)
+    na, wl = _mk(blocklen=3, stripe=StripeType.GREATER)
+    for rank in range(8):
+        ss = wl.send_size(rank)
+        for dst in range(8):
+            expect = (1 + rank % 3) if dst % 2 else 0
+            assert ss[dst] == expect
+        rs = wl.recv_size(rank)
+        if rank % 2:
+            assert list(rs) == [1 + i % 3 for i in range(8)]
+        else:
+            assert not rs.any()
+
+
+def test_fill_is_map_data3():
+    _, wl = _mk(blocklen=4)
+    msg = wl.fill(3, 5)
+    assert len(msg) == 1 + 3 % 4
+    np.testing.assert_array_equal(msg, fill_slab_tam(3, 5, len(msg)))
+    # MAP_DATA(a,b,c) = 1 + 3a + 5b + 7c (l_d_t.c:20)
+    assert msg[0] == (1 + 3 * 3 + 5 * 5) % 256
+
+
+def test_verify_catches_corruption():
+    na, wl = _mk()
+    recv, _ = cw_benchmark(wl)
+    wl.verify_all(recv)
+    recv[3][2][0] ^= 0xFF
+    with pytest.raises(VerificationError):
+        wl.verify_recv(3, recv[3])
+
+
+def test_workload_validation():
+    na = static_node_assignment(4, 2, 0)
+    with pytest.raises(ValueError):
+        Workload(nprocs=4, blocklen=0, stripe=StripeType.ALL,
+                 aggregators=np.arange(4))
+    with pytest.raises(ValueError):
+        Workload(nprocs=4, blocklen=2, stripe=StripeType.ALL,
+                 aggregators=np.array([4]))
+
+
+# ---------------------------------------------------------------------------
+# oracle engines: delivery + route accounting
+
+STRIPES = list(StripeType)
+
+
+@pytest.mark.parametrize("stripe", STRIPES)
+@pytest.mark.parametrize("kind", [0, 1])
+def test_benchmark_and_proxy_deliver(stripe, kind):
+    na, wl = _mk(nprocs=12, per_node=4, blocklen=5, stripe=stripe, kind=kind)
+    for engine in ("benchmark", "proxy"):
+        recv, stats = run_workload_engine(engine, wl, na)
+        wl.verify_all(recv)
+        assert isinstance(stats, RouteStats)
+
+
+@pytest.mark.parametrize("stripe", STRIPES)
+@pytest.mark.parametrize("co,mode", [(1, 0), (2, 0), (2, 1), (4, 1)])
+def test_local_agg_delivers(stripe, co, mode):
+    na, wl = _mk(nprocs=12, per_node=4, blocklen=5, stripe=stripe)
+    meta = aggregator_meta_information(na, wl.aggregators, co, mode)
+    recv, stats = cw2_local_agg(wl, na, meta)
+    wl.verify_all(recv)
+    # every byte crosses the exchange hop exactly once
+    assert (stats.exchange_intra_bytes + stats.exchange_inter_bytes
+            == wl.total_bytes)
+
+
+def test_shared_requires_local_agg_destinations():
+    na, wl = _mk(nprocs=8, per_node=4, stripe=StripeType.ALL)
+    meta = aggregator_meta_information(na, wl.aggregators, 2, 0)
+    # co=2 < ranks per node: some destination is not a local aggregator
+    with pytest.raises(ValueError):
+        cw3_shared(wl, na, meta)
+
+
+@pytest.mark.parametrize("stripe", STRIPES)
+def test_shared_delivers_with_mode1(stripe):
+    na, wl = _mk(nprocs=8, per_node=4, blocklen=3, stripe=stripe)
+    # mode 1 with co = node size makes every destination a local aggregator
+    meta = aggregator_meta_information(na, wl.aggregators, 4, 1)
+    recv, stats = cw3_shared(wl, na, meta)
+    wl.verify_all(recv)
+    assert stats.staged_bytes == wl.total_bytes  # everyone stages everything
+    assert stats.gather_bytes == 0               # no link crossed intra-group
+
+
+def test_benchmark_route_stats():
+    na, wl = _mk(nprocs=8, per_node=4, blocklen=4, stripe=StripeType.LESS)
+    _, stats = cw_benchmark(wl)
+    assert stats.direct_bytes == wl.total_bytes == stats.network_bytes
+
+
+def test_proxy_route_stats_split_by_node():
+    na, wl = _mk(nprocs=8, per_node=4, blocklen=4, stripe=StripeType.SAME)
+    _, stats = cw_proxy(wl, na)
+    sizes = wl.msg_size
+    # inter-node: every (src, dst) pair whose nodes differ, relayed by proxies
+    expect_inter = sum(int(sizes[s]) for s in range(8)
+                       for d in wl.aggregators
+                       if na.node_of[s] != na.node_of[int(d)])
+    assert stats.exchange_inter_bytes == expect_inter
+    # gather: non-proxy senders forward their full pack to the proxy
+    expect_gather = sum(int(sizes[s]) * len(wl.aggregators)
+                        for s in range(8) if not na.is_proxy(s))
+    assert stats.gather_bytes == expect_gather
+
+
+def test_recv_index_map_partitions_ranks():
+    na, wl = _mk(nprocs=12, per_node=4, blocklen=5)
+    meta = aggregator_meta_information(na, wl.aggregators, 2, 0)
+    rim = recv_index_map(wl, meta)
+    seen = sorted(src for group in rim.values() for (src, _sz) in group)
+    assert seen == list(range(12))
+    for group in rim.values():  # ascending source order within a group
+        srcs = [s for (s, _) in group]
+        assert srcs == sorted(srcs)
+
+
+def test_run_workload_engine_dispatch_errors():
+    na, wl = _mk()
+    with pytest.raises(ValueError):
+        run_workload_engine("local_agg", wl, na)  # meta required
+    with pytest.raises(ValueError):
+        run_workload_engine("nope", wl, na)
+
+
+# ---------------------------------------------------------------------------
+# JAX mesh engine vs oracle
+
+@pytest.mark.parametrize("stripe", STRIPES)
+@pytest.mark.parametrize("co,mode", [(1, 0), (2, 0), (2, 1)])
+def test_cw2_jax_matches_oracle(stripe, co, mode):
+    import jax
+
+    na, wl = _mk(nprocs=8, per_node=4, blocklen=5, stripe=stripe)
+    meta = aggregator_meta_information(na, wl.aggregators, co, mode)
+    recv, times = cw2_local_agg_jax(wl, na, meta, jax.devices(), ntimes=2)
+    wl.verify_all(recv)
+    assert len(times) == 2
+    oracle, _ = cw2_local_agg(wl, na, meta)
+    for g in recv:
+        for src in range(8):
+            np.testing.assert_array_equal(recv[g][src], oracle[g][src])
+
+
+def test_cw2_jax_rejects_bad_topology():
+    import jax
+
+    na = static_node_assignment(8, 4, 1)  # round-robin map: not mesh-able
+    wl = initialize_setting(na, 3, StripeType.ALL)
+    meta = aggregator_meta_information(na, wl.aggregators, 1, 0)
+    with pytest.raises(ValueError):
+        cw2_local_agg_jax(wl, na, meta, jax.devices())

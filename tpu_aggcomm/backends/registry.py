@@ -2,9 +2,12 @@
 
 from __future__ import annotations
 
-__all__ = ["BACKENDS", "get_backend"]
+__all__ = ["BACKENDS", "DEVICE_FREE_BACKENDS", "get_backend"]
 
 BACKENDS = ("local", "jax_ici", "pallas_dma", "native")
+
+# backends that execute without accelerator devices (pure host runtimes)
+DEVICE_FREE_BACKENDS = ("local", "native")
 
 
 def get_backend(name: str):

@@ -17,7 +17,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from tpu_aggcomm.backends.registry import BACKENDS
+from tpu_aggcomm.backends.registry import BACKENDS, DEVICE_FREE_BACKENDS
 
 __all__ = ["main", "build_parser"]
 
@@ -32,7 +32,8 @@ def build_parser() -> argparse.ArgumentParser:
     bench = ap  # main command keeps reference flags at top level
     bench.add_argument("-n", "--nprocs", type=int, default=None,
                        help="logical ranks (reference: mpiexec -n; default: "
-                            "number of visible devices)")
+                            "number of visible devices for device backends, "
+                            "32 for the device-free local/native backends)")
     bench.add_argument("-m", dest="method", type=int, default=0,
                        help="method id 0-20 (0 = all; mpi_test.c usage)")
     bench.add_argument("-a", dest="cb_nodes", type=int, default=1,
@@ -81,8 +82,12 @@ def main(argv=None) -> int:
     from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
     nprocs = args.nprocs
     if nprocs is None:
-        import jax
-        nprocs = len(jax.devices())
+        if args.backend in DEVICE_FREE_BACKENDS:
+            # device-free backends: the reference README example's rank count
+            nprocs = 32
+        else:
+            import jax
+            nprocs = len(jax.devices())
     cfg = ExperimentConfig(
         nprocs=nprocs, cb_nodes=args.cb_nodes, method=args.method,
         data_size=args.data_size, comm_size=args.comm_size, iters=args.iters,

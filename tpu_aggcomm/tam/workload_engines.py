@@ -1,0 +1,401 @@
+"""Variable-size workload engines: the collective_write family.
+
+The reference ships four generations of its hierarchical exchange engine
+(lustre_driver_test.c): ``collective_write`` (944-1309, the production
+proxy path), ``collective_write2`` (754-926, two-level local aggregators +
+zero-copy derived datatypes), ``collective_write3`` (604-728, MPI-3 shared
+-memory windows for the intra-node hop), and ``collective_write_benchmark``
+(1311-1330, flat direct exchange).  All four deliver the same bytes — for
+every destination ``g`` and source ``s``, ``recv_buf[s] = MAP_DATA(s,g,·)``
+— and differ only in the *route*.  Here each engine is
+
+- an **oracle**: an explicit numpy simulation of the route that returns the
+  delivered buffers plus per-hop byte accounting (``RouteStats``), so tests
+  can pin both delivery and route shape; and
+- for the two-level engine, a **JAX mesh program**
+  (:func:`cw2_local_agg_jax`) on a ``(node, local)`` mesh — intra-node hops
+  ride the inner (ICI) axis, aggregator↔aggregator exchange rides the outer
+  (DCN) axis.  The reference's hindexed derived datatypes
+  (``create_recv_type``, l_d_t.c:1332-1361; the MPI_BOTTOM sends at
+  848-856, 899-902) become static index maps — message sizes are pure
+  functions of rank (workload property), so every pack/scatter compiles to
+  fixed gathers over padded buffers.
+
+Source ordering: the reference orders a group's sources by the
+``aggregator_local_ranks`` array on the send side (l_d_t.c:885-904) but by
+ascending rank scan on the receive side (create_recv_type, 1339-1346);
+those differ whenever the binding scan inserts the aggregator's own rank
+out of order (l_d_t.c:193-229).  Since collective_write2 is dead code in
+the reference (call commented out at 1497), we fix the hazard: both sides
+use ascending source rank within a group (:func:`recv_index_map`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpu_aggcomm.core.meta import AggregatorMeta
+from tpu_aggcomm.core.topology import NodeAssignment
+from tpu_aggcomm.core.workload import Workload
+
+__all__ = [
+    "RouteStats", "recv_index_map",
+    "cw_benchmark", "cw_proxy", "cw2_local_agg", "cw3_shared",
+    "cw2_local_agg_jax", "WORKLOAD_ENGINES", "run_workload_engine",
+]
+
+
+@dataclass
+class RouteStats:
+    """Bytes moved per hop class — the quantities the reference's phase
+    timers bracket. ``staged_bytes`` counts shared-memory staging
+    (collective_write3's window fill), which crosses no network link."""
+
+    direct_bytes: int = 0        # flat src -> dst messages
+    gather_bytes: int = 0        # non-aggregator -> its local aggregator/proxy
+    exchange_intra_bytes: int = 0  # agg <-> agg on the same node
+    exchange_inter_bytes: int = 0  # agg <-> agg across nodes (the DCN hop)
+    delivery_bytes: int = 0      # proxy -> final local destination
+    staged_bytes: int = 0        # shared-window staging (no link crossed)
+
+    @property
+    def network_bytes(self) -> int:
+        return (self.direct_bytes + self.gather_bytes +
+                self.exchange_intra_bytes + self.exchange_inter_bytes +
+                self.delivery_bytes)
+
+
+def _empty_recv(wl: Workload) -> dict[int, list[np.ndarray | None]]:
+    return {int(g): wl.alloc_recv_bufs(int(g)) for g in wl.aggregators}
+
+
+def recv_index_map(wl: Workload, meta: AggregatorMeta) -> dict[int, list[tuple[int, int]]]:
+    """``create_recv_type`` analog (l_d_t.c:1332-1361): for each local
+    aggregator, the ordered ``(source_rank, size)`` runs that make up one
+    incoming group message at any destination.  In MPI this list becomes an
+    hindexed datatype over scattered ``recv_buf`` pointers; on TPU it is the
+    static scatter map from a received packed segment into per-source slots."""
+    sizes = wl.msg_size
+    out: dict[int, list[tuple[int, int]]] = {}
+    for agg in meta.local_aggregators:
+        out[int(agg)] = [(int(w), int(sizes[w]))
+                         for w in np.nonzero(meta.owner_of == agg)[0]]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# collective_write_benchmark (l_d_t.c:1311-1330): flat direct exchange
+
+def cw_benchmark(wl: Workload):
+    """Direct Issend/Irecv per (src, dst) pair — the baseline route."""
+    recv = _empty_recv(wl)
+    stats = RouteStats()
+    for dst in wl.aggregators:
+        for src in range(wl.nprocs):
+            msg = wl.fill(src, int(dst))
+            recv[int(dst)][src][:] = msg
+            stats.direct_bytes += len(msg)
+    return recv, stats
+
+
+# ---------------------------------------------------------------------------
+# collective_write (l_d_t.c:944-1309): proxy path, one relay per node
+
+def cw_proxy(wl: Workload, na: NodeAssignment):
+    """The production 5-phase proxy route with variable sizes.
+
+    P1 (size exchange) is compile-time static here — sizes are pure
+    functions of rank (the reference's runtime handshake, l_d_t.c:996-1041,
+    carries no extra information for these workloads).  P2: every rank's
+    packed sends go to its node proxy; P3: proxies exchange per-node runs;
+    P4: destination proxies deliver each local destination its slab;
+    P5: local scatter into recv_buf.
+    """
+    recv = _empty_recv(wl)
+    stats = RouteStats()
+    sizes = wl.msg_size
+    is_dst = wl.is_aggregator
+
+    # P2: sender pack -> node proxy (self-pack for the proxy, l_d_t.c:1069-1105)
+    # holdings[node] = list of (src, dst) messages staged at that node's proxy
+    holdings: list[list[tuple[int, int]]] = [[] for _ in range(na.nnodes)]
+    for src in range(wl.nprocs):
+        pack = [(src, int(d)) for d in wl.aggregators]
+        holdings[int(na.node_of[src])].extend(pack)
+        if not na.is_proxy(src):
+            stats.gather_bytes += int(sizes[src]) * len(wl.aggregators)
+
+    # P3: proxy -> proxy per-destination-node runs (l_d_t.c:1121-1194)
+    incoming: list[list[tuple[int, int]]] = [[] for _ in range(na.nnodes)]
+    for node, held in enumerate(holdings):
+        for (src, dst) in held:
+            dnode = int(na.node_of[dst])
+            incoming[dnode].append((src, dst))
+            if dnode != node:
+                stats.exchange_inter_bytes += int(sizes[src])
+            # same-node messages are the memcpy at l_d_t.c:1184 — no link
+
+    # P4/P5: destination proxy re-packs per local destination and delivers
+    for node, msgs in enumerate(incoming):
+        for (src, dst) in msgs:
+            recv[dst][src][:] = wl.fill(src, dst)
+            if not na.is_proxy(dst):
+                stats.delivery_bytes += int(sizes[src])
+    # non-destination ranks receive nothing; is_dst guard for clarity
+    assert all(is_dst[d] for d in recv)
+    return recv, stats
+
+
+# ---------------------------------------------------------------------------
+# collective_write2 (l_d_t.c:754-926): two-level local aggregators
+
+def cw2_local_agg(wl: Workload, na: NodeAssignment, meta: AggregatorMeta):
+    """Two-level route: rank → its local aggregator (packed hindexed send,
+    l_d_t.c:848-856) → per-destination segments → global destination
+    (received through the recv_index_map scatter)."""
+    recv = _empty_recv(wl)
+    stats = RouteStats()
+    sizes = wl.msg_size
+    rim = recv_index_map(wl, meta)
+
+    # hop 1: gather at local aggregators (skip self, l_d_t.c:829-856)
+    for src in range(wl.nprocs):
+        owner = int(meta.owner_of[src])
+        if owner != src:
+            stats.gather_bytes += int(sizes[src]) * len(wl.aggregators)
+
+    # hop 2: local aggregator -> each global destination, one packed segment
+    # per (group, destination); scattered at the destination via the index map
+    for agg, group in rim.items():
+        for dst in wl.aggregators:
+            seg_bytes = 0
+            for (src, sz) in group:
+                recv[int(dst)][src][:] = wl.fill(src, int(dst))
+                seg_bytes += sz
+            if int(na.node_of[agg]) == int(na.node_of[int(dst)]):
+                stats.exchange_intra_bytes += seg_bytes
+            else:
+                stats.exchange_inter_bytes += seg_bytes
+    return recv, stats
+
+
+# ---------------------------------------------------------------------------
+# collective_write3 (l_d_t.c:604-728): shared-window intra hop
+
+def cw3_shared(wl: Workload, na: NodeAssignment, meta: AggregatorMeta):
+    """Shared-memory route: group members stage [sizes header | packed
+    sends] in a shared window (l_d_t.c:647-663); after the fence the local
+    aggregator reads every member's staging zero-copy (shared_query,
+    667-671) and exchanges hindexed segments directly with the destination
+    aggregators (705-711).
+
+    Requires every destination to be a local aggregator (the reference
+    sends only to ``local_aggregators`` — use meta mode 1, which makes
+    local aggregators a superset of the global set).  The TPU analog of the
+    shared window is staging in same-slice HBM: the inner-axis hop of
+    :func:`cw2_local_agg_jax` with zero link cost.
+    """
+    is_local = meta.is_local_aggregator
+    missing = [int(d) for d in wl.aggregators if not is_local[int(d)]]
+    if missing:
+        raise ValueError(
+            f"collective_write3 route requires destinations to be local "
+            f"aggregators (meta mode 1); not local: {missing}")
+    # shared windows exist per intra-group; groups must not span nodes
+    for agg in meta.local_aggregators:
+        nodes = {int(na.node_of[w]) for w in meta.owned_ranks(int(agg))}
+        nodes.add(int(na.node_of[int(agg)]))
+        if len(nodes) > 1:
+            raise ValueError(f"group of local aggregator {int(agg)} spans "
+                             f"nodes {sorted(nodes)}; shared window invalid")
+
+    recv = _empty_recv(wl)
+    stats = RouteStats()
+    sizes = wl.msg_size
+    rim = recv_index_map(wl, meta)
+    for agg, group in rim.items():
+        for (src, _sz) in group:
+            stats.staged_bytes += int(sizes[src]) * len(wl.aggregators)
+        for dst in wl.aggregators:
+            seg_bytes = 0
+            for (src, sz) in group:
+                recv[int(dst)][src][:] = wl.fill(src, int(dst))
+                seg_bytes += sz
+            if int(agg) == int(dst):
+                continue  # self segment: local memcpy
+            if int(na.node_of[int(agg)]) == int(na.node_of[int(dst)]):
+                stats.exchange_intra_bytes += seg_bytes
+            else:
+                stats.exchange_inter_bytes += seg_bytes
+    return recv, stats
+
+
+# ---------------------------------------------------------------------------
+# JAX mesh engine for the two-level route
+
+def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
+                      devices, ntimes: int = 1):
+    """Run the collective_write2 route on a ``(node, local)`` mesh.
+
+    Rank ``r`` lives at coordinate ``(r // L, r % L)`` (contiguous node
+    map).  Three compiled hops, all static shapes (messages padded to the
+    workload's max size ``S`` and masked):
+
+    1. inner-axis ``all_to_all``: every rank's padded send block ``(G, S)``
+       lands at its local aggregator (the hindexed gather, l_d_t.c:848-856);
+    2. outer-axis ``all_to_all``: local aggregators forward per-destination
+       segments toward each destination's node (the MPI_BOTTOM Issend per
+       global aggregator, l_d_t.c:899-902);
+    3. inner-axis ``all_to_all``: segments hop to the destination's local
+       coordinate and scatter into per-source recv rows (recv_types,
+       l_d_t.c:1332-1361).
+
+    Returns ``(recv_by_rank, rep_times)``; recv rows are unpadded to the
+    true per-source sizes before being handed back.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n = wl.nprocs
+    if na.nnodes < 1 or n % na.nnodes:
+        raise ValueError("cw2_local_agg_jax needs equal-size nodes")
+    L = n // na.nnodes
+    N = na.nnodes
+    if not np.array_equal(na.node_of, np.arange(n) // L):
+        raise ValueError("cw2_local_agg_jax needs the contiguous node map "
+                         "(static_node_assignment kind 0)")
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+
+    S = wl.max_msg_size
+    aggs = np.asarray(wl.aggregators, dtype=np.int64)
+    G = len(aggs)
+    sizes = np.asarray(wl.msg_size)
+
+    # destination geometry: node + local coordinate of each destination,
+    # grouped per node with K = max destinations on one node
+    agg_node = aggs // L
+    agg_local = aggs % L
+    K = max(1, int(np.bincount(agg_node, minlength=N).max()))
+    aggs_of_node = np.full((N, K), -1, dtype=np.int64)   # -> index into aggs
+    cnt = np.zeros(N, dtype=np.int64)
+    for gi, b in enumerate(agg_node):
+        aggs_of_node[b, cnt[b]] = gi
+        cnt[b] += 1
+    local_of_slot = np.where(aggs_of_node >= 0,
+                             agg_local[np.maximum(aggs_of_node, 0)], -1)
+
+    owner_local = (np.asarray(meta.owner_of) % L).astype(np.int64)  # per rank
+
+    # host-side payload: (N, L, G, S) padded send blocks
+    send_g = np.zeros((n, G, S), dtype=np.uint8)
+    for r in range(n):
+        m = int(sizes[r])
+        for gi, g in enumerate(aggs):
+            send_g[r, gi, :m] = wl.fill(r, int(g))
+    send_g = send_g.reshape(N, L, G, S)
+
+    mesh = Mesh(np.array(devices[:n]).reshape(N, L), ("node", "local"))
+    sharding = NamedSharding(mesh, P("node", "local"))
+    send_dev = jax.device_put(send_g, sharding)
+
+    owner_local_j = jnp.asarray(owner_local.reshape(N, L))
+    aggs_of_node_j = jnp.asarray(aggs_of_node)
+    local_of_slot_j = jnp.asarray(local_of_slot)
+
+    def local_fn(send):
+        x = send[0, 0]                                   # (G, S) my block
+        mynode = lax.axis_index("node")
+        mylocal = lax.axis_index("local")
+
+        # hop 1 (inner axis): block -> my local aggregator's coordinate
+        my_owner = owner_local_j[mynode, mylocal]        # scalar
+        buf1 = jnp.zeros((L + 1, G, S), jnp.uint8).at[my_owner].set(x)[:L]
+        held = lax.all_to_all(buf1, "local", 0, 0)       # (L, G, S)
+        # held[l'] = block of source (mynode, l') iff I am its owner
+
+        # hop 2 (outer axis): per-destination-node segments
+        # buf2[b', j, l'] = held[l', slot j of node b']
+        sel = jnp.maximum(aggs_of_node_j, 0)             # (N, K)
+        mask = (aggs_of_node_j >= 0).astype(jnp.uint8)[..., None, None]
+        byslot = jnp.take(held, sel.reshape(-1), axis=1)  # (L, N*K, S)
+        byslot = byslot.reshape(L, N, K, S).transpose(1, 2, 0, 3) * mask
+        got2 = lax.all_to_all(byslot, "node", 0, 0)      # (N, K, L, S)
+        # got2[b_src, j, l_src] = message (b_src·L+l_src -> my-node slot j)
+        # held at the source-side owner's local coordinate (= my coordinate)
+
+        # hop 3 (inner axis): slot j -> the destination's local coordinate
+        dl = jnp.where(local_of_slot_j[mynode] >= 0,
+                       local_of_slot_j[mynode], L)       # (K,)
+        buf3 = jnp.zeros((L + 1, K, N, L, S), jnp.uint8)
+        buf3 = buf3.at[dl].set(got2.transpose(1, 0, 2, 3))[:L]
+        got3 = lax.all_to_all(buf3, "local", 0, 0)       # (L, K, N, L, S)
+        # got3[l_holder, j, b_src, l_src]: nonzero only at the destination
+        # coordinate of slot j, from the holder that owned (b_src, l_src).
+        # Disjoint owners => sum collapses the holder axis losslessly.
+        merged = got3.sum(axis=0, dtype=jnp.uint8)       # (K, N, L, S)
+
+        # select my slot (at most one destination per (node, local) coord)
+        is_mine = (local_of_slot_j[mynode] == mylocal)   # (K,)
+        recv = jnp.where(is_mine[:, None, None, None], merged, 0
+                         ).sum(axis=0, dtype=jnp.uint8)  # (N, L, S)
+        return recv.reshape(n, S)[None, None]
+
+    fn = jax.jit(jax.shard_map(local_fn, mesh=mesh,
+                               in_specs=P("node", "local"),
+                               out_specs=P("node", "local")))
+
+    fn(send_dev).block_until_ready()                     # warm-up compile
+    rep_times = []
+    out_dev = None
+    for _ in range(max(ntimes, 1)):
+        t0 = _time.perf_counter()
+        out_dev = fn(send_dev)
+        out_dev.block_until_ready()
+        rep_times.append(_time.perf_counter() - t0)
+    out = np.asarray(jax.device_get(out_dev)).reshape(n, n, S)
+
+    is_dst = wl.is_aggregator
+    recv_by_rank: dict[int, list[np.ndarray | None]] = {}
+    for g in wl.aggregators:
+        g = int(g)
+        recv_by_rank[g] = [out[g, src, :int(sizes[src])].copy()
+                           for src in range(n)]
+    assert all(is_dst[g] for g in recv_by_rank)
+    return recv_by_rank, rep_times
+
+
+# ---------------------------------------------------------------------------
+# registry
+
+WORKLOAD_ENGINES = {
+    "benchmark": cw_benchmark,       # collective_write_benchmark
+    "proxy": cw_proxy,               # collective_write
+    "local_agg": cw2_local_agg,      # collective_write2
+    "shared": cw3_shared,            # collective_write3
+}
+
+
+def run_workload_engine(engine: str, wl: Workload, na: NodeAssignment,
+                        meta: AggregatorMeta | None = None):
+    """Dispatch one oracle engine by name; verifies nothing — callers run
+    ``wl.verify_all`` on the returned buffers (the reference's
+    test_correctness step, l_d_t.c:1502)."""
+    if engine == "benchmark":
+        return cw_benchmark(wl)
+    if engine == "proxy":
+        return cw_proxy(wl, na)
+    if meta is None:
+        raise ValueError(f"engine {engine!r} needs aggregator metadata (co)")
+    if engine == "local_agg":
+        return cw2_local_agg(wl, na, meta)
+    if engine == "shared":
+        return cw3_shared(wl, na, meta)
+    raise ValueError(f"unknown workload engine {engine!r}; "
+                     f"choose from {sorted(WORKLOAD_ENGINES)}")
