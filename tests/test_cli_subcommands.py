@@ -1,0 +1,57 @@
+"""CLI `tam` and `sweep` subcommands (DEBUG driver + Theta job scripts)."""
+
+import contextlib
+import io
+
+import pytest
+
+from tpu_aggcomm.cli import THETA_COMM_SIZES, main
+
+
+def run_cli(argv):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(argv)
+    return rc, buf.getvalue()
+
+
+def test_theta_grid_matches_job_scripts():
+    # script_theta_*.sh sweeps powers of two 1..8192 plus "unthrottled"
+    assert THETA_COMM_SIZES == (1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
+                                1024, 2048, 4096, 8192, 999_999_999)
+
+
+@pytest.mark.parametrize("engine", ["benchmark", "proxy", "local_agg"])
+def test_tam_subcommand_engines(engine):
+    rc, out = run_cli(["tam", "-n", "12", "-p", "4", "-b", "5", "-t", "3",
+                       "-c", "2", "--engine", engine])
+    assert rc == 0
+    assert "correctness: PASSED" in out
+    assert "blocklen = 5, nprocs_node = 4" in out
+
+
+def test_tam_subcommand_shared_mode1():
+    rc, out = run_cli(["tam", "-n", "8", "-p", "4", "-t", "2", "-c", "4",
+                       "--mode", "1", "--engine", "shared"])
+    assert rc == 0
+    assert "correctness: PASSED" in out
+
+
+def test_tam_subcommand_jax_engine():
+    rc, out = run_cli(["tam", "-n", "8", "-p", "4", "-b", "3", "-t", "1",
+                       "-c", "2", "--mode", "1", "--engine", "jax", "-k", "2"])
+    assert rc == 0
+    assert "two-level mesh (compiled)" in out
+    assert "correctness: PASSED" in out
+
+
+def test_sweep_subcommand_accumulates_csv(tmp_path):
+    csv = tmp_path / "results.csv"
+    rc, out = run_cli(["sweep", "-n", "8", "-a", "2", "-d", "64", "-i", "1",
+                       "-m", "1", "--backend", "local", "--verify",
+                       "--comm-sizes", "1,2", "--results-csv", str(csv)])
+    assert rc == 0
+    assert out.count("RUN_OPTS:") == 2
+    lines = csv.read_text().strip().splitlines()
+    assert len(lines) == 3  # header + one row per grid point
+    assert lines[0].startswith("Method,")

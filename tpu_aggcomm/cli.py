@@ -67,7 +67,147 @@ def build_parser() -> argparse.ArgumentParser:
     pt.add_argument("-d", dest="data_size", type=int, default=0)
     pt.add_argument("-k", dest="ntimes", type=int, default=0)
     pt.add_argument("-i", dest="runs", type=int, default=0)
+
+    # TAM workload harness — the reference's DEBUG driver
+    # (lustre_driver_test.c:1417-1509, grammar "hp:b:n:t:r:c:")
+    tam = sub.add_parser(
+        "tam", help="hierarchical-engine workload harness: topology -> "
+                    "synthetic workload -> aggregator metadata -> engine -> "
+                    "correctness check (the reference's DEBUG driver)")
+    tam.add_argument("-n", "--nprocs", type=int, default=8,
+                     help="logical ranks (reference: mpiexec -n)")
+    tam.add_argument("-p", dest="proc_node", type=int, default=4,
+                     help="ranks per (simulated) node")
+    tam.add_argument("-b", dest="blocklen", type=int, default=16,
+                     help="message block unit size (sizes are 1 + rank %% b)")
+    tam.add_argument("-t", dest="stripe", type=int, default=0, choices=[0, 1, 2, 3],
+                     help="workload type: 0 SAME (node proxies), 1 GREATER "
+                          "(odd ranks), 2 LESS (first half), 3 ALL")
+    tam.add_argument("-r", dest="rank_assignment", type=int, default=0,
+                     choices=[0, 1], help="node map: 0 contiguous, 1 round-robin")
+    tam.add_argument("-c", dest="co", type=int, default=1,
+                     help="local aggregators per node")
+    tam.add_argument("-k", dest="ntimes", type=int, default=1,
+                     help="timed engine repetitions")
+    tam.add_argument("--mode", type=int, default=0, choices=[0, 1],
+                     help="local-aggregator selection: 0 even spread, "
+                          "1 superset of global aggregators")
+    tam.add_argument("--engine",
+                     choices=("proxy", "local_agg", "shared", "benchmark", "jax"),
+                     default="proxy",
+                     help="route: collective_write / _2 / _3 / _benchmark "
+                          "oracles, or the compiled two-level mesh program")
+
+    # sweep — the Theta job scripts (script_theta_*.sh:33-106)
+    sw = sub.add_parser(
+        "sweep", help="throttle sweep over the reference job-script grid "
+                      "(-c in 1,2,4,...,8192,unthrottled)")
+    sw.add_argument("-n", "--nprocs", type=int, default=None)
+    sw.add_argument("-m", dest="method", type=int, default=1,
+                    help="method id (scripts use 1 / 2)")
+    sw.add_argument("-a", dest="cb_nodes", type=int, default=4)
+    sw.add_argument("-d", dest="data_size", type=int, default=2048)
+    sw.add_argument("-i", dest="iters", type=int, default=5)
+    sw.add_argument("-k", dest="ntimes", type=int, default=1)
+    sw.add_argument("-p", dest="proc_node", type=int, default=1)
+    sw.add_argument("-t", dest="agg_type", type=int, default=1)
+    sw.add_argument("--backend", choices=BACKENDS, default="local")
+    sw.add_argument("--verify", action="store_true")
+    sw.add_argument("--results-csv", default="results.csv")
+    sw.add_argument("--comm-sizes", type=str, default=None,
+                    help="comma-separated throttle values (default: the "
+                         "Theta grid 1,2,4,...,8192,999999999)")
     return ap
+
+
+THETA_COMM_SIZES = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                    4096, 8192, 999_999_999)  # script_theta_*.sh:33-106
+
+
+def _run_tam(args) -> int:
+    """The DEBUG-driver flow (lustre_driver_test.c:1417-1509):
+    static_node_assignment -> initialize_setting -> aggregator_meta_information
+    -> engine -> test_correctness."""
+    import time
+
+    from tpu_aggcomm.core.meta import aggregator_meta_information
+    from tpu_aggcomm.core.topology import static_node_assignment
+    from tpu_aggcomm.core.workload import StripeType, initialize_setting
+    from tpu_aggcomm.tam.workload_engines import (cw2_local_agg_jax,
+                                                  run_workload_engine)
+
+    na = static_node_assignment(args.nprocs, args.proc_node,
+                                args.rank_assignment)
+    wl = initialize_setting(na, args.blocklen, StripeType(args.stripe))
+    meta = aggregator_meta_information(na, wl.aggregators, args.co, args.mode)
+    # the reference's rank-0 config banner (l_d_t.c:1455-1457)
+    print(f"blocklen = {args.blocklen}, nprocs_node = {args.proc_node}, "
+          f"rank_assignment = {args.rank_assignment}, type = {args.stripe}, "
+          f"co = {args.co}")
+    print(f"| nprocs = {args.nprocs}, nodes = {na.nnodes}, "
+          f"aggregators = {len(wl.aggregators)}, "
+          f"local aggregators = {len(meta.local_aggregators)}, "
+          f"total bytes = {wl.total_bytes}")
+
+    if args.engine == "jax":
+        import jax
+        recv, times = cw2_local_agg_jax(wl, na, meta, jax.devices(),
+                                        ntimes=args.ntimes)
+        wl.verify_all(recv)
+        print(f"| engine = two-level mesh (compiled), reps = {len(times)}, "
+              f"min rep = {min(times):.6f} s")
+    else:
+        times = []
+        stats = None
+        for _ in range(max(args.ntimes, 1)):
+            t0 = time.perf_counter()
+            recv, stats = run_workload_engine(args.engine, wl, na, meta)
+            times.append(time.perf_counter() - t0)
+        wl.verify_all(recv)
+        print(f"| engine = {args.engine}, reps = {len(times)}, "
+              f"min rep = {min(times):.6f} s")
+        print(f"| route bytes: gather = {stats.gather_bytes}, "
+              f"exchange intra/inter = {stats.exchange_intra_bytes}/"
+              f"{stats.exchange_inter_bytes}, "
+              f"delivery = {stats.delivery_bytes}, "
+              f"direct = {stats.direct_bytes}, staged = {stats.staged_bytes}")
+    print("| correctness: PASSED")
+    return 0
+
+
+def _default_nprocs(backend: str) -> int:
+    """Rank count when -n is omitted: the reference README example's 32 for
+    device-free backends, the visible device count otherwise."""
+    if backend in DEVICE_FREE_BACKENDS:
+        return 32
+    import jax
+    return len(jax.devices())
+
+
+def _run_sweep(args) -> int:
+    """One experiment per throttle value over the Theta grid; rows
+    accumulate in results.csv exactly like repeated ./test invocations."""
+    from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+
+    nprocs = args.nprocs if args.nprocs is not None \
+        else _default_nprocs(args.backend)
+    if args.comm_sizes:
+        grid = [int(x) for x in args.comm_sizes.split(",") if x.strip()]
+        if not grid:
+            raise SystemExit("--comm-sizes: no valid throttle values")
+    else:
+        grid = list(THETA_COMM_SIZES)
+    for c in grid:
+        print(f"RUN_OPTS: -a {args.cb_nodes} -d {args.data_size} -c {c} "
+              f"-m {args.method} -i {args.iters}")
+        cfg = ExperimentConfig(
+            nprocs=nprocs, cb_nodes=args.cb_nodes, method=args.method,
+            data_size=args.data_size, comm_size=c, iters=args.iters,
+            ntimes=args.ntimes, proc_node=args.proc_node,
+            agg_type=args.agg_type, backend=args.backend, verify=args.verify,
+            results_csv=args.results_csv)
+        run_experiment(cfg)
+    return 0
 
 
 def main(argv=None) -> int:
@@ -78,16 +218,14 @@ def main(argv=None) -> int:
         pt2pt_statistics(max(args.data_size, 1), max(args.ntimes, 1),
                          max(args.runs, 1))
         return 0
+    if args.command == "tam":
+        return _run_tam(args)
+    if args.command == "sweep":
+        return _run_sweep(args)
 
     from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
-    nprocs = args.nprocs
-    if nprocs is None:
-        if args.backend in DEVICE_FREE_BACKENDS:
-            # device-free backends: the reference README example's rank count
-            nprocs = 32
-        else:
-            import jax
-            nprocs = len(jax.devices())
+    nprocs = args.nprocs if args.nprocs is not None \
+        else _default_nprocs(args.backend)
     cfg = ExperimentConfig(
         nprocs=nprocs, cb_nodes=args.cb_nodes, method=args.method,
         data_size=args.data_size, comm_size=args.comm_size, iters=args.iters,

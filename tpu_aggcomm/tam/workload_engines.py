@@ -271,7 +271,9 @@ def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
         raise ValueError("cw2_local_agg_jax needs the contiguous node map "
                          "(static_node_assignment kind 0)")
     if len(devices) < n:
-        raise ValueError(f"need {n} devices, have {len(devices)}")
+        raise ValueError(
+            f"need {n} devices, have {len(devices)} (hint: JAX_PLATFORMS=cpu "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n})")
 
     S = wl.max_msg_size
     aggs = np.asarray(wl.aggregators, dtype=np.int64)
