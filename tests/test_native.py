@@ -57,3 +57,48 @@ def test_native_rejects_tam():
     p = AggregatorPattern(8, 3, data_size=16, proc_node=2)
     with pytest.raises(ValueError, match="TAM"):
         NativeBackend().run(compile_method(15, p))
+
+
+# ---------------------------------------------------------------------------
+# native variable-size workload engine (agg_run_workload_proxy)
+
+def test_native_workload_proxy_all_stripes():
+    from tpu_aggcomm.backends.native import run_workload_proxy
+    from tpu_aggcomm.core.topology import static_node_assignment
+    from tpu_aggcomm.core.workload import StripeType, initialize_setting
+
+    for kind in (0, 1):
+        for stripe in StripeType:
+            na = static_node_assignment(12, 4, kind)
+            wl = initialize_setting(na, 7, stripe)
+            recv, times = run_workload_proxy(wl, na, ntimes=2)
+            wl.verify_all(recv)
+            assert len(times) == 2 and all(t > 0 for t in times)
+
+
+def test_native_workload_proxy_matches_oracle():
+    from tpu_aggcomm.backends.native import run_workload_proxy
+    from tpu_aggcomm.core.topology import static_node_assignment
+    from tpu_aggcomm.core.workload import StripeType, initialize_setting
+    from tpu_aggcomm.tam.workload_engines import cw_proxy
+
+    na = static_node_assignment(9, 3, 0)
+    wl = initialize_setting(na, 4, StripeType.GREATER)
+    recv_n, _ = run_workload_proxy(wl, na)
+    recv_o, _ = cw_proxy(wl, na)
+    for g in recv_o:
+        for src in range(9):
+            np.testing.assert_array_equal(recv_n[g][src], recv_o[g][src])
+
+
+def test_native_workload_proxy_degenerate_shapes():
+    from tpu_aggcomm.backends.native import run_workload_proxy
+    from tpu_aggcomm.core.topology import static_node_assignment
+    from tpu_aggcomm.core.workload import StripeType, initialize_setting
+
+    # one rank per node, single node, blocklen > nprocs
+    for (n, p) in [(6, 1), (5, 5), (1, 1)]:
+        na = static_node_assignment(n, p, 0)
+        wl = initialize_setting(na, 10, StripeType.ALL)
+        recv, _ = run_workload_proxy(wl, na)
+        wl.verify_all(recv)

@@ -25,7 +25,8 @@ from tpu_aggcomm.core.schedule import OpKind, Schedule, TimerBucket
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs
 
-__all__ = ["NativeBackend", "build_library", "library_path"]
+__all__ = ["NativeBackend", "build_library", "library_path",
+           "run_workload_proxy"]
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "native", "aggcomm_runtime.cc")
@@ -80,6 +81,18 @@ def _load():
     global _lib
     if _lib is None:
         lib = ctypes.CDLL(build_library())
+        lib.agg_run_workload_proxy.restype = ctypes.c_int
+        lib.agg_run_workload_proxy.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int32),     # node_of
+            np.ctypeslib.ndpointer(np.int32),     # proxies
+            np.ctypeslib.ndpointer(np.int32),     # aggs
+            np.ctypeslib.ndpointer(np.int32),     # msg_sizes
+            np.ctypeslib.ndpointer(np.uint8),     # send_msgs
+            np.ctypeslib.ndpointer(np.int64),     # send_block_ofs
+            np.ctypeslib.ndpointer(np.uint8),     # recv_out
+            np.ctypeslib.ndpointer(np.float64),   # rep_times_out
+        ]
         lib.agg_run_schedule.restype = ctypes.c_int
         lib.agg_run_schedule.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -121,6 +134,56 @@ def _flatten(schedule: Schedule):
     ops = np.asarray(rows, dtype=np.int32).reshape(-1, _OP_FIELDS)
     return (ops, np.asarray(prog_ofs, dtype=np.int32),
             np.asarray(wait_tokens or [0], dtype=np.int32), max_token)
+
+
+def run_workload_proxy(wl, na, ntimes: int = 1):
+    """Run a variable-size workload through the native collective_write
+    proxy engine (``agg_run_workload_proxy``): real threads, real pack /
+    proxy-exchange / re-pack memcpy walks.
+
+    Returns ``(recv_by_rank, rep_times)`` in the same shape the oracle
+    engines return — per-aggregator lists of per-source byte arrays and an
+    (nprocs, ntimes) per-rank wall-time matrix reduced to per-rep maxima.
+    """
+    lib = _load()
+    n = wl.nprocs
+    sizes = np.asarray(wl.msg_size, dtype=np.int32)
+    aggs = np.asarray(wl.aggregators, dtype=np.int32)
+    G = len(aggs)
+
+    # per-src blocks: G messages in ascending-aggregator order
+    block_bytes = (sizes.astype(np.int64)) * G
+    send_block_ofs = np.zeros(n, dtype=np.int64)
+    send_block_ofs[1:] = np.cumsum(block_bytes)[:-1]
+    send_msgs = np.zeros(max(int(block_bytes.sum()), 1), dtype=np.uint8)
+    for src in range(n):
+        o = int(send_block_ofs[src])
+        m = int(sizes[src])
+        for gi, g in enumerate(aggs):
+            send_msgs[o + gi * m:o + (gi + 1) * m] = wl.fill(src, int(g))
+
+    # delivery slabs: per aggregator, sources in global ascending order
+    slab = int(sizes.sum())
+    recv_out = np.zeros(max(G * slab, 1), dtype=np.uint8)
+    src_ofs = np.zeros(n, dtype=np.int64)
+    src_ofs[1:] = np.cumsum(sizes.astype(np.int64))[:-1]
+
+    rep_times = np.zeros((n, max(ntimes, 1)), dtype=np.float64)
+    rc = lib.agg_run_workload_proxy(
+        n, na.nnodes, G, max(ntimes, 1),
+        np.asarray(na.node_of, dtype=np.int32),
+        np.asarray(na.proxies, dtype=np.int32),
+        aggs, sizes, send_msgs, send_block_ofs, recv_out, rep_times)
+    if rc != 0:
+        raise RuntimeError(f"native workload engine failed with rc={rc}")
+
+    recv_by_rank = {}
+    for gi, g in enumerate(aggs):
+        row = recv_out[gi * slab:(gi + 1) * slab]
+        recv_by_rank[int(g)] = [
+            row[int(src_ofs[s]):int(src_ofs[s]) + int(sizes[s])].copy()
+            for s in range(n)]
+    return recv_by_rank, rep_times.max(axis=0).tolist()
 
 
 class NativeBackend:

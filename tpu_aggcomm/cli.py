@@ -93,10 +93,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="local-aggregator selection: 0 even spread, "
                           "1 superset of global aggregators")
     tam.add_argument("--engine",
-                     choices=("proxy", "local_agg", "shared", "benchmark", "jax"),
+                     choices=("proxy", "local_agg", "shared", "benchmark",
+                              "jax", "native"),
                      default="proxy",
                      help="route: collective_write / _2 / _3 / _benchmark "
-                          "oracles, or the compiled two-level mesh program")
+                          "oracles, the compiled two-level mesh program "
+                          "(jax), or the C++ threaded proxy engine (native)")
 
     # sweep — the Theta job scripts (script_theta_*.sh:33-106)
     sw = sub.add_parser(
@@ -155,6 +157,12 @@ def _run_tam(args) -> int:
                                         ntimes=args.ntimes)
         wl.verify_all(recv)
         print(f"| engine = two-level mesh (compiled), reps = {len(times)}, "
+              f"min rep = {min(times):.6f} s")
+    elif args.engine == "native":
+        from tpu_aggcomm.backends.native import run_workload_proxy
+        recv, times = run_workload_proxy(wl, na, ntimes=args.ntimes)
+        wl.verify_all(recv)
+        print(f"| engine = native proxy (C++ threads), reps = {len(times)}, "
               f"min rep = {min(times):.6f} s")
     else:
         times = []

@@ -317,7 +317,275 @@ void run_rank(RankCtx* cx, int ntimes) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// Variable-size workload engine: the collective_write proxy route executed
+// natively. One thread per rank; the five phases of the reference's
+// production engine (intra-node pack+gather to the node proxy, proxy↔proxy
+// per-node runs, local delivery, scatter) are real memcpy walks between
+// thread-shared staging buffers — the hot loops the reference times
+// (pack cursors, run reorder, per-rank re-pack).
+//
+// Buffer layouts (all byte offsets precomputed before threads start):
+//   send_msgs:  per src rank, its G messages in ascending-aggregator order,
+//               each msg_sizes[src] bytes (block size G * msg_sizes[src]).
+//   aggregate:  per node, local ranks' blocks in ascending-rank order.
+//   run b1->b2: for src on b1 ascending, for each aggregator on b2
+//               ascending: the (src -> agg) message.
+//   delivery / recv_out row of aggregator g: for src in GLOBAL ascending
+//               order: the (src -> g) message.
+
+struct WlGeom {
+  int n, nn, G;
+  const int32_t* node_of;
+  const int32_t* proxies;
+  const int32_t* aggs;        // ascending aggregator ranks
+  const int32_t* msg_sizes;   // per src
+  std::vector<std::vector<int>> node_ranks;   // per node, ascending
+  std::vector<std::vector<int>> node_aggs;    // per node, ascending gi
+  std::vector<int> agg_of_rank;               // rank -> gi or -1
+  std::vector<int64_t> block_bytes;           // per src: G * msg_sizes[src]
+  std::vector<int64_t> agg_ofs;               // per node: aggregate offset of
+                                              // each local rank (flattened)
+  std::vector<int64_t> agg_ofs_start;         // per node: index into agg_ofs
+  std::vector<int64_t> agg_total;             // per node: aggregate bytes
+  std::vector<int64_t> run_bytes;             // (b1, b2) run size
+  std::vector<int64_t> src_run_base;          // per src: its base offset in
+                                              // the run node_of[src] -> b2,
+                                              // PER dest node (n * nn)
+  std::vector<int64_t> recv_src_ofs;          // per src: offset of its msg in
+                                              // any delivery slab
+  int64_t slab_bytes = 0;                     // delivery slab size
+
+  WlGeom(int n_, int nn_, int G_, const int32_t* node_of_,
+         const int32_t* proxies_, const int32_t* aggs_,
+         const int32_t* msg_sizes_)
+      : n(n_), nn(nn_), G(G_), node_of(node_of_), proxies(proxies_),
+        aggs(aggs_), msg_sizes(msg_sizes_) {
+    node_ranks.resize(nn);
+    node_aggs.resize(nn);
+    agg_of_rank.assign(n, -1);
+    for (int r = 0; r < n; ++r) node_ranks[node_of[r]].push_back(r);
+    for (int gi = 0; gi < G; ++gi) {
+      agg_of_rank[aggs[gi]] = gi;
+      node_aggs[node_of[aggs[gi]]].push_back(gi);
+    }
+    block_bytes.resize(n);
+    for (int r = 0; r < n; ++r)
+      block_bytes[r] = (int64_t)G * msg_sizes[r];
+    agg_ofs_start.assign(nn + 1, 0);
+    agg_total.assign(nn, 0);
+    for (int b = 0; b < nn; ++b)
+      agg_ofs_start[b + 1] = agg_ofs_start[b] + (int64_t)node_ranks[b].size();
+    agg_ofs.assign(agg_ofs_start[nn], 0);
+    for (int b = 0; b < nn; ++b) {
+      int64_t cur = 0;
+      for (size_t i = 0; i < node_ranks[b].size(); ++i) {
+        agg_ofs[agg_ofs_start[b] + i] = cur;
+        cur += block_bytes[node_ranks[b][i]];
+      }
+      agg_total[b] = cur;
+    }
+    run_bytes.assign((int64_t)nn * nn, 0);
+    src_run_base.assign((int64_t)n * nn, 0);
+    for (int b1 = 0; b1 < nn; ++b1) {
+      for (int b2 = 0; b2 < nn; ++b2) {
+        int64_t cur = 0;
+        for (int src : node_ranks[b1]) {
+          src_run_base[(int64_t)src * nn + b2] = cur;
+          cur += (int64_t)msg_sizes[src] * node_aggs[b2].size();
+        }
+        run_bytes[(int64_t)b1 * nn + b2] = cur;
+      }
+    }
+    recv_src_ofs.assign(n, 0);
+    int64_t cur = 0;
+    for (int src = 0; src < n; ++src) {
+      recv_src_ofs[src] = cur;
+      cur += msg_sizes[src];
+    }
+    slab_bytes = cur;
+  }
+
+  // position of aggregator gi within its node's ascending list
+  int agg_pos_on_node(int gi) const {
+    const auto& v = node_aggs[node_of[aggs[gi]]];
+    for (size_t j = 0; j < v.size(); ++j)
+      if (v[j] == gi) return (int)j;
+    return 0;
+  }
+};
+
+// Eager send: payload stays valid until matched (guaranteed by the
+// end-of-rep barrier); completes at post like the runtime's kIsend.
+void wl_post_send(Runtime& rt, int src, int dst, const uint8_t* data,
+                  int64_t nbytes) {
+  if (nbytes <= 0) return;
+  std::unique_lock<std::mutex> lk(rt.mu);
+  Msg m;
+  m.src_data = data;
+  m.nbytes = (int32_t)nbytes;
+  rt.ch(src, dst).sends.push_back(m);
+  rt.match(src, dst);
+  rt.cv.notify_all();
+}
+
+// Blocking receive into `buf`.
+void wl_recv(Runtime& rt, int src, int dst, uint8_t* buf) {
+  std::unique_lock<std::mutex> lk(rt.mu);
+  std::atomic<bool> done{false};
+  rt.ch(src, dst).recvs.push_back({buf, &done});
+  rt.match(src, dst);
+  rt.cv.notify_all();
+  rt.cv.wait(lk, [&] { return done.load(std::memory_order_acquire); });
+}
+
+struct WlShared {
+  Runtime* rt;
+  const WlGeom* g;
+  const uint8_t* send_msgs;
+  const int64_t* send_block_ofs;   // per src: byte offset of its block
+  uint8_t* recv_out;               // G slabs, slab_bytes each
+  std::vector<std::vector<uint8_t>> aggregate;   // per node
+  std::vector<std::vector<uint8_t>> run_out;     // (b1, b2) packed runs
+  std::vector<std::vector<uint8_t>> run_in;      // (b2, b1) received runs
+  std::vector<std::vector<uint8_t>> deliver;     // per gi staging slab
+};
+
+void wl_run_rank(WlShared* sh, int rank, int ntimes, double* rep_times) {
+  Runtime& rt = *sh->rt;
+  const WlGeom& g = *sh->g;
+  const int b = g.node_of[rank];
+  const bool proxy = (g.proxies[b] == rank);
+  const int gi_self = g.agg_of_rank[rank];
+
+  for (int rep = 0; rep < ntimes; ++rep) {
+    double t0 = now_s();
+    // P2: pack + gather at the node proxy (l_d_t.c:1069-1105)
+    if (!proxy) {
+      wl_post_send(rt, rank, g.proxies[b],
+                   sh->send_msgs + sh->send_block_ofs[rank],
+                   g.block_bytes[rank]);
+    } else {
+      uint8_t* abuf = sh->aggregate[b].data();
+      for (size_t i = 0; i < g.node_ranks[b].size(); ++i) {
+        int lr = g.node_ranks[b][i];
+        int64_t ofs = g.agg_ofs[g.agg_ofs_start[b] + i];
+        if (lr == rank) {
+          std::memcpy(abuf + ofs, sh->send_msgs + sh->send_block_ofs[lr],
+                      g.block_bytes[lr]);
+        } else if (g.block_bytes[lr] > 0) {
+          wl_recv(rt, lr, rank, abuf + ofs);
+        }
+      }
+      // P3: reorder into per-destination-node runs and exchange
+      // (l_d_t.c:1121-1194)
+      for (int b2 = 0; b2 < g.nn; ++b2) {
+        uint8_t* run = sh->run_out[(int64_t)b * g.nn + b2].data();
+        int64_t cur = 0;
+        for (size_t i = 0; i < g.node_ranks[b].size(); ++i) {
+          int src = g.node_ranks[b][i];
+          const uint8_t* blk = abuf + g.agg_ofs[g.agg_ofs_start[b] + i];
+          for (int gi : g.node_aggs[b2]) {
+            std::memcpy(run + cur, blk + (int64_t)gi * g.msg_sizes[src],
+                        g.msg_sizes[src]);
+            cur += g.msg_sizes[src];
+          }
+        }
+        if (b2 == b) {
+          // self-node run: local memcpy (l_d_t.c:1184)
+          std::memcpy(sh->run_in[(int64_t)b * g.nn + b].data(), run, cur);
+        } else {
+          wl_post_send(rt, rank, g.proxies[b2], run, cur);
+        }
+      }
+      for (int b1 = 0; b1 < g.nn; ++b1) {
+        if (b1 == b) continue;
+        if (g.run_bytes[(int64_t)b1 * g.nn + b] == 0) continue;
+        wl_recv(rt, g.proxies[b1], rank,
+                sh->run_in[(int64_t)b * g.nn + b1].data());
+      }
+      // P4: re-pack one delivery slab per local aggregator and deliver
+      // (l_d_t.c:1219-1265)
+      for (int gi : g.node_aggs[b]) {
+        int agg_rank = g.aggs[gi];
+        int pos = g.agg_pos_on_node(gi);
+        uint8_t* slab = (agg_rank == rank)
+                            ? sh->recv_out + (int64_t)gi * g.slab_bytes
+                            : sh->deliver[gi].data();
+        for (int src = 0; src < g.n; ++src) {
+          int b1 = g.node_of[src];
+          const uint8_t* run = sh->run_in[(int64_t)b * g.nn + b1].data();
+          int64_t o = g.src_run_base[(int64_t)src * g.nn + b] +
+                      (int64_t)pos * g.msg_sizes[src];
+          std::memcpy(slab + g.recv_src_ofs[src], run + o, g.msg_sizes[src]);
+        }
+        if (agg_rank != rank) {
+          wl_post_send(rt, rank, agg_rank, slab, g.slab_bytes);
+        }
+      }
+    }
+    // P5: non-proxy aggregators receive their slab straight into recv_out
+    if (gi_self >= 0 && !proxy && g.slab_bytes > 0) {
+      wl_recv(rt, g.proxies[b], rank,
+              sh->recv_out + (int64_t)gi_self * g.slab_bytes);
+    }
+    // end-of-rep rendezvous: staging buffers are reused next rep
+    {
+      std::unique_lock<std::mutex> lk(rt.mu);
+      rt.gen_barrier(lk, rt.barrier_waiting, rt.barrier_gen);
+    }
+    rep_times[rep] = now_s() - t0;
+  }
+}
+
 }  // namespace
+
+extern "C" {
+
+// Execute the collective_write proxy route natively on a variable-size
+// workload. Layouts documented above; rep_times_out is n * ntimes doubles
+// (per-rank wall time per rep). Returns 0 on success.
+int agg_run_workload_proxy(int nprocs, int nnodes, int n_aggs, int ntimes,
+                           const int32_t* node_of, const int32_t* proxies,
+                           const int32_t* aggs, const int32_t* msg_sizes,
+                           const uint8_t* send_msgs,
+                           const int64_t* send_block_ofs,
+                           uint8_t* recv_out, double* rep_times_out) {
+  WlGeom geom(nprocs, nnodes, n_aggs, node_of, proxies, aggs, msg_sizes);
+  Runtime rt(nprocs);
+  WlShared sh;
+  sh.rt = &rt;
+  sh.g = &geom;
+  sh.send_msgs = send_msgs;
+  sh.send_block_ofs = send_block_ofs;
+  sh.recv_out = recv_out;
+  sh.aggregate.resize(nnodes);
+  for (int b = 0; b < nnodes; ++b)
+    sh.aggregate[b].resize(std::max<int64_t>(geom.agg_total[b], 1));
+  sh.run_out.resize((int64_t)nnodes * nnodes);
+  sh.run_in.resize((int64_t)nnodes * nnodes);
+  for (int b1 = 0; b1 < nnodes; ++b1) {
+    for (int b2 = 0; b2 < nnodes; ++b2) {
+      int64_t sz = std::max<int64_t>(geom.run_bytes[(int64_t)b1 * nnodes + b2], 1);
+      sh.run_out[(int64_t)b1 * nnodes + b2].resize(sz);
+      sh.run_in[(int64_t)b2 * nnodes + b1].resize(sz);
+    }
+  }
+  sh.deliver.resize(n_aggs);
+  for (int gi = 0; gi < n_aggs; ++gi)
+    sh.deliver[gi].resize(std::max<int64_t>(geom.slab_bytes, 1));
+
+  std::vector<std::thread> threads;
+  threads.reserve(nprocs);
+  for (int r = 0; r < nprocs; ++r) {
+    threads.emplace_back(wl_run_rank, &sh, r, ntimes,
+                         rep_times_out + (size_t)r * ntimes);
+  }
+  for (auto& th : threads) th.join();
+  return 0;
+}
+
+}  // extern "C"
 
 extern "C" {
 
