@@ -55,3 +55,37 @@ def test_sweep_subcommand_accumulates_csv(tmp_path):
     lines = csv.read_text().strip().splitlines()
     assert len(lines) == 3  # header + one row per grid point
     assert lines[0].startswith("Method,")
+
+
+def test_analyze_subcommand(tmp_path):
+    csv = tmp_path / "results.csv"
+    run_cli(["sweep", "-n", "8", "-a", "2", "-d", "64", "-i", "1", "-m", "1",
+             "--backend", "local", "--comm-sizes", "1,4",
+             "--results-csv", str(csv)])
+    rc, out = run_cli(["analyze", "--results-csv", str(csv)])
+    assert rc == 0
+    assert "config: procs=8 aggregators=2 data_size=64" in out
+    assert "winner: All to many" in out
+
+
+def test_analyze_missing_file(tmp_path):
+    with pytest.raises(SystemExit):
+        run_cli(["analyze", "--results-csv", str(tmp_path / "nope.csv")])
+
+
+def test_analyze_rejects_wrong_schema(tmp_path):
+    bad = tmp_path / "bad.csv"
+    bad.write_text("foo,bar\n1,2\n")
+    with pytest.raises(SystemExit, match="no parseable"):
+        run_cli(["analyze", "--results-csv", str(bad)])
+
+
+def test_analyze_skips_truncated_row(tmp_path):
+    csv = tmp_path / "results.csv"
+    run_cli(["sweep", "-n", "8", "-a", "2", "-d", "64", "-i", "1", "-m", "1",
+             "--backend", "local", "--comm-sizes", "1",
+             "--results-csv", str(csv)])
+    with open(csv, "a") as f:
+        f.write("All to many,8,2,64,4\n")  # killed-mid-append remnant
+    rc, out = run_cli(["analyze", "--results-csv", str(csv)])
+    assert rc == 0 and "winner: All to many" in out

@@ -119,6 +119,12 @@ def build_parser() -> argparse.ArgumentParser:
     sw.add_argument("--comm-sizes", type=str, default=None,
                     help="comma-separated throttle values (default: the "
                          "Theta grid 1,2,4,...,8192,999999999)")
+
+    # analyze — summarize accumulated results.csv rows
+    an = sub.add_parser(
+        "analyze", help="summarize results.csv: per (method, config) the "
+                        "best max-total-time and the throttle that won")
+    an.add_argument("--results-csv", default="results.csv")
     return ap
 
 
@@ -218,6 +224,53 @@ def _run_sweep(args) -> int:
     return 0
 
 
+def _run_analyze(args) -> int:
+    """Winner table from accumulated sweep rows — the question the
+    reference's whole harness exists to answer: which schedule / throttle
+    minimizes max-over-ranks completion time for a pattern."""
+    import csv
+
+    try:
+        with open(args.results_csv, newline="") as f:
+            rows = list(csv.DictReader(f))
+    except FileNotFoundError:
+        raise SystemExit(f"no such file: {args.results_csv} "
+                         f"(run a sweep or benchmark first)")
+    if not rows:
+        raise SystemExit(f"{args.results_csv} has no data rows")
+
+    # config = (procs, aggregators, data size); best row per (config, method)
+    best: dict[tuple, dict] = {}
+    for r in rows:
+        try:
+            # numeric keys: sort naturally AND reject truncated rows (a
+            # sweep killed mid-append leaves None trailing fields)
+            key = (int(r["# of processes"]), int(r["# of aggregators"]),
+                   int(r["data size"]), r["Method"])
+            t = float(r["max total time"])
+        except (KeyError, ValueError, TypeError):
+            continue
+        if key not in best or t < float(best[key]["max total time"]):
+            best[key] = r
+    if not best:
+        raise SystemExit(
+            f"{args.results_csv}: no parseable result rows (expected the "
+            f"summarize_results schema with 'max total time' etc.)")
+    configs = sorted({k[:3] for k in best})
+    for cfg in configs:
+        print(f"config: procs={cfg[0]} aggregators={cfg[1]} "
+              f"data_size={cfg[2]}")
+        ranked = sorted((k for k in best if k[:3] == cfg),
+                        key=lambda k: float(best[k]["max total time"]))
+        for k in ranked:
+            r = best[k]
+            print(f"  {k[3]:34s} best max total = "
+                  f"{float(r['max total time']):.6f} s  "
+                  f"(comm_size = {r['max comm']})")
+        print(f"  winner: {ranked[0][3]}")
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -230,6 +283,8 @@ def main(argv=None) -> int:
         return _run_tam(args)
     if args.command == "sweep":
         return _run_sweep(args)
+    if args.command == "analyze":
+        return _run_analyze(args)
 
     from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
     nprocs = args.nprocs if args.nprocs is not None \
