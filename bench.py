@@ -10,8 +10,11 @@ aggregator slabs × 2048 B) on one TPU chip: the 32 logical ranks live
 on-device as a leading axis (the single-process simulation strategy the
 reference itself uses for topology, SURVEY.md §4.2) and one rep is the slab
 exchange send[rank, slab] → recv[aggregator, source] with the aggregator
-rows ordered by the pattern's actual rank_list placement (so a wrong
-rank→aggregator mapping changes the output and fails verification).
+rows ordered by the pattern's actual rank_list placement. Correctness is
+checked two ways: the device chain is replayed exactly on the host, and the
+first rep's row layout is verified against an independently-derived
+rank→aggregator mapping (``p.agg_index``), so a wrong placement gather
+cannot silently pass.
 
 Measurement method (documented because the TPU here sits behind a network
 tunnel with a ~60-90 ms per-dispatch RPC round trip, which would otherwise
@@ -40,7 +43,6 @@ reference).
 import json
 import statistics
 import sys
-import time
 
 import numpy as np
 
@@ -94,12 +96,23 @@ def main() -> int:
         return jnp.arange(n, dtype=jnp.uint8).reshape(
             PROCS, CB_NODES, DATA_SIZE)
 
-    checksum = jax.jit(lambda v: v.astype(jnp.uint32).sum())
     send0 = make_send()
     send0.block_until_ready()
 
-    # correctness: exact replay of the chain on host, including the
-    # pattern-placement gather
+    # correctness 1: one rep's placement semantics against an independent
+    # mapping — recv row j must hold, for every source r, the slab r
+    # addressed to the j-th aggregator *by rank order* (slab index =
+    # agg_index of that aggregator rank), not merely replay the same
+    # `order` gather
+    send_np = np.asarray(jax.device_get(send0))
+    recv1 = np.asarray(jax.device_get(jax.jit(exchange)(send0)))
+    agg_ranks_sorted = sorted(int(a) for a in p.rank_list)
+    agg_index = np.asarray(p.agg_index)
+    for j, a in enumerate(agg_ranks_sorted):
+        assert np.array_equal(recv1[j], send_np[:, agg_index[a]]), \
+            f"aggregator row {j} (rank {a}) has wrong slabs"
+
+    # correctness 2: exact replay of the whole chain on host
     got = np.asarray(jax.device_get(make_chain(VERIFY_ITERS)(send0)))
     ref = np.arange(got.size, dtype=np.uint8).reshape(got.shape)
     for r in range(VERIFY_ITERS):
@@ -107,23 +120,12 @@ def main() -> int:
                + np.uint8(r))
     assert np.array_equal(got, ref), "chained exchange produced wrong slabs"
 
-    f_small = make_chain(ITERS_SMALL)
-    f_big = make_chain(ITERS_BIG)
+    from tpu_aggcomm.harness.chained import differenced_trials
 
-    def timed(f, windows: int = 5) -> float:
-        int(jax.device_get(checksum(f(send0))))        # compile + warm
-        best = float("inf")
-        for _ in range(windows):
-            t0 = time.perf_counter()
-            int(jax.device_get(checksum(f(send0))))    # forced completion
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    per_reps = []
-    for _ in range(TRIALS):
-        t_small = timed(f_small)
-        t_big = timed(f_big)
-        per_reps.append((t_big - t_small) / (ITERS_BIG - ITERS_SMALL))
+    per_reps = differenced_trials(make_chain, send0,
+                                  iters_small=ITERS_SMALL,
+                                  iters_big=ITERS_BIG,
+                                  trials=TRIALS, windows=5)
     per_rep = statistics.median(per_reps)
 
     dev = jax.devices()[0]

@@ -2,12 +2,17 @@
 
 from __future__ import annotations
 
-__all__ = ["BACKENDS", "DEVICE_FREE_BACKENDS", "get_backend"]
+__all__ = ["BACKENDS", "DEVICE_FREE_BACKENDS", "SINGLE_DEVICE_BACKENDS",
+           "get_backend"]
 
-BACKENDS = ("local", "jax_ici", "pallas_dma", "native")
+BACKENDS = ("local", "jax_ici", "jax_sim", "pallas_dma", "native")
 
 # backends that execute without accelerator devices (pure host runtimes)
 DEVICE_FREE_BACKENDS = ("local", "native")
+
+# backends that carry the whole rank set on ONE device (rank count is free,
+# not bounded by the visible device count)
+SINGLE_DEVICE_BACKENDS = ("jax_sim",)
 
 
 def get_backend(name: str):
@@ -18,6 +23,9 @@ def get_backend(name: str):
         if name == "jax_ici":
             from tpu_aggcomm.backends.jax_ici import JaxIciBackend
             return JaxIciBackend()
+        if name == "jax_sim":
+            from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+            return JaxSimBackend()
         if name == "pallas_dma":
             from tpu_aggcomm.backends.pallas_dma import PallasDmaBackend
             return PallasDmaBackend()

@@ -17,7 +17,8 @@ from __future__ import annotations
 import argparse
 import sys
 
-from tpu_aggcomm.backends.registry import BACKENDS, DEVICE_FREE_BACKENDS
+from tpu_aggcomm.backends.registry import (BACKENDS, DEVICE_FREE_BACKENDS,
+                                           SINGLE_DEVICE_BACKENDS)
 
 __all__ = ["main", "build_parser"]
 
@@ -60,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                             "version of the reference's commented-out checks)")
     bench.add_argument("--profile-rounds", action="store_true",
                        help="jax_ici: time each throttle round separately")
+    bench.add_argument("--chained", action="store_true",
+                       help="jax_sim: serial-chained on-device per-rep "
+                            "measurement (cancels dispatch RPC overhead — "
+                            "the honest mode on a tunneled TPU)")
     bench.add_argument("--results-csv", default="results.csv")
 
     pt = sub.add_parser("pt2pt", help="2-rank latency microbenchmark "
@@ -115,6 +120,8 @@ def build_parser() -> argparse.ArgumentParser:
     sw.add_argument("-t", dest="agg_type", type=int, default=1)
     sw.add_argument("--backend", choices=BACKENDS, default="local")
     sw.add_argument("--verify", action="store_true")
+    sw.add_argument("--chained", action="store_true",
+                    help="jax_sim: serial-chained per-rep measurement")
     sw.add_argument("--results-csv", default="results.csv")
     sw.add_argument("--comm-sizes", type=str, default=None,
                     help="comma-separated throttle values (default: the "
@@ -191,8 +198,9 @@ def _run_tam(args) -> int:
 
 def _default_nprocs(backend: str) -> int:
     """Rank count when -n is omitted: the reference README example's 32 for
-    device-free backends, the visible device count otherwise."""
-    if backend in DEVICE_FREE_BACKENDS:
+    backends that do not need one device per rank, the visible device count
+    otherwise."""
+    if backend in DEVICE_FREE_BACKENDS or backend in SINGLE_DEVICE_BACKENDS:
         return 32
     import jax
     return len(jax.devices())
@@ -219,7 +227,7 @@ def _run_sweep(args) -> int:
             data_size=args.data_size, comm_size=c, iters=args.iters,
             ntimes=args.ntimes, proc_node=args.proc_node,
             agg_type=args.agg_type, backend=args.backend, verify=args.verify,
-            results_csv=args.results_csv)
+            results_csv=args.results_csv, chained=args.chained)
         run_experiment(cfg)
     return 0
 
@@ -295,7 +303,8 @@ def main(argv=None) -> int:
         ntimes=args.ntimes, proc_node=args.proc_node, agg_type=args.agg_type,
         prefix=args.prefix, barrier_type=args.barrier_type,
         backend=args.backend, verify=args.verify,
-        results_csv=args.results_csv, profile_rounds=args.profile_rounds)
+        results_csv=args.results_csv, profile_rounds=args.profile_rounds,
+        chained=args.chained)
     run_experiment(cfg)
     return 0
 

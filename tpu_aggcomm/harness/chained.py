@@ -1,0 +1,69 @@
+"""Serial-chained differenced timing — the measurement scaffold for TPUs
+behind a dispatch tunnel.
+
+A tunneled TPU pays a ~60-90 ms RPC round trip per dispatch, far larger
+than one rep of any pattern here, so naive wall timing measures the tunnel.
+The honest method (used by bench.py and the jax_sim backend):
+
+- chain ``iters`` reps strictly serially inside ONE compiled program (the
+  caller's ``chain_factory(iters)`` must make rep r+1 data-depend on rep r
+  so XLA can neither fuse, hoist, nor elide iterations);
+- force completion by reading back a checksum (block_until_ready alone does
+  not guarantee execution through the tunnel);
+- cancel the fixed dispatch overhead by differencing two chain lengths:
+  ``per_rep = (T(big) - T(small)) / (big - small)``, best-of-``windows``
+  per length, median over ``trials`` (differencing is noise-sensitive).
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+
+__all__ = ["differenced_per_rep", "differenced_trials"]
+
+
+def differenced_trials(chain_factory, send0, *, iters_small: int,
+                       iters_big: int, trials: int = 3,
+                       windows: int = 3) -> list[float]:
+    """Per-trial per-rep seconds from differenced serial-chain timings.
+
+    ``chain_factory(iters)`` returns a jitted ``chain(send0) -> array``
+    running ``iters`` serially-dependent reps; ``send0`` is the on-device
+    initial state. Both chain lengths are built (and therefore compiled)
+    exactly once, then re-timed across trials.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if iters_big <= iters_small:
+        raise ValueError("iters_big must exceed iters_small")
+    checksum = jax.jit(lambda v: v.astype(jnp.uint32).sum())
+
+    def timed(f) -> float:
+        best = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            int(jax.device_get(checksum(f(send0))))  # forced completion
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    f_small = chain_factory(iters_small)
+    f_big = chain_factory(iters_big)
+    int(jax.device_get(checksum(f_small(send0))))    # compile + warm
+    int(jax.device_get(checksum(f_big(send0))))
+    per = []
+    for _ in range(trials):
+        t_s = timed(f_small)
+        t_b = timed(f_big)
+        per.append((t_b - t_s) / (iters_big - iters_small))
+    return per
+
+
+def differenced_per_rep(chain_factory, send0, *, iters_small: int,
+                        iters_big: int, trials: int = 3,
+                        windows: int = 3) -> float:
+    """Median per-rep seconds over ``differenced_trials``."""
+    return statistics.median(differenced_trials(
+        chain_factory, send0, iters_small=iters_small, iters_big=iters_big,
+        trials=trials, windows=windows))

@@ -36,6 +36,7 @@ class ExperimentConfig:
     verify: bool = False         # --verify
     results_csv: str | None = "results.csv"
     profile_rounds: bool = False
+    chained: bool = False        # jax_sim: serial-chained per-rep measurement
 
 
 def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
@@ -45,6 +46,9 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
         raise ValueError("data_size (-d) must be >= 1 "
                          "(the reference's -d 0 default sends empty messages; "
                          "pass an explicit size)")
+    if cfg.chained and cfg.backend != "jax_sim":
+        raise ValueError("--chained requires --backend jax_sim "
+                         "(serial-chained on-device measurement)")
     backend = get_backend(cfg.backend)
     pattern = AggregatorPattern(
         nprocs=cfg.nprocs, cb_nodes=cfg.cb_nodes,
@@ -71,6 +75,8 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             kwargs = {}
             if cfg.profile_rounds and backend.name == "jax_ici":
                 kwargs["profile_rounds"] = True
+            if cfg.chained:
+                kwargs["chained"] = True
             recv, timers = backend.run(sched, ntimes=cfg.ntimes, iter_=i,
                                        verify=cfg.verify, **kwargs)
             max_timer = max_reduce(timers)
