@@ -64,11 +64,23 @@ def test_sim_chained_measurement():
     assert timers[0].total_time != 0
 
 
-def test_sim_rejects_tam():
-    from tpu_aggcomm.tam.engine import gen_tam_schedule
-    p = AggregatorPattern(8, 3, data_size=16, proc_node=2)
-    with pytest.raises(ValueError, match="jax_ici"):
-        JaxSimBackend().run(gen_tam_schedule(p))
+@pytest.mark.parametrize("direction_m,pn", [(15, 2), (15, 4), (16, 2),
+                                            (16, 4)])
+def test_sim_tam_matches_oracle(direction_m, pn):
+    from tpu_aggcomm.tam.engine import gen_tam_schedule, tam_oracle
+    from tpu_aggcomm.core.pattern import Direction
+    d = (Direction.ALL_TO_MANY if direction_m == 15
+         else Direction.MANY_TO_ALL)
+    p = AggregatorPattern(8, 3, data_size=32, proc_node=pn, direction=d)
+    tam = gen_tam_schedule(p)
+    recv_s, timers = JaxSimBackend().run(tam, verify=True, iter_=1)
+    recv_o = tam_oracle(tam, iter_=1)
+    for a, b in zip(recv_s, recv_o):
+        if a is None:
+            assert b is None
+        else:
+            np.testing.assert_array_equal(a, b)
+    assert timers[0].total_time > 0
 
 
 def test_sim_cli_sweep(tmp_path, capsys):

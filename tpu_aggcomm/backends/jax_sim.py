@@ -83,6 +83,61 @@ def _round_tables(schedule: Schedule):
     return rounds, barrier_rounds
 
 
+def _tam_tables(tam):
+    """Static index maps for the single-chip TAM route (the analog of
+    collective_write2's hindexed views, l_d_t.c:848-904: datatype tricks
+    become index maps). Three hops over flattened slab arrays:
+
+    P2 staging:   staged[k]    = send_flat[stage_idx[k]]   (gather at proxy)
+    P3 exchange:  exch[k]      = staged[exch_idx[k]]       (proxy <-> proxy)
+    P4/P5 deliver recv[recv_dst[k], recv_slot[k]] = exch[k]
+
+    Orders mirror tam_oracle's proxy_hold / node_in walks, so the staged
+    layout is the aggregate-buffer layout of the reference engine.
+    """
+    from tpu_aggcomm.tam.engine import TamMethod  # noqa: F401 (typing aid)
+
+    p = tam.pattern
+    na = tam.assignment
+    if p.direction is Direction.ALL_TO_MANY:
+        senders = list(range(p.nprocs))
+        nslots = p.cb_nodes
+        dest_of = lambda s, i: int(p.rank_list[i])           # noqa: E731
+        slot_of = lambda s, i: s                             # noqa: E731
+    else:
+        senders = [int(r) for r in p.rank_list]
+        nslots = p.nprocs
+        dest_of = lambda s, i: i                             # noqa: E731
+        agg_index = p.agg_index
+        slot_of = lambda s, i: int(agg_index[s])             # noqa: E731
+
+    # P2: proxy_hold order — per node, each resident sender's slabs packed
+    stage: list[tuple[int, int]] = []
+    stage_pos: dict[tuple[int, int], int] = {}
+    for node in range(na.nnodes):
+        for s in senders:
+            if int(na.node_of[s]) != node:
+                continue
+            for i in range(nslots):
+                stage_pos[(s, i)] = len(stage)
+                stage.append((s, i))
+    stage_idx = np.array([s * nslots + i for (s, i) in stage], dtype=np.int32)
+
+    # P3: node_in order — per destination node, arrivals in proxy_hold order
+    exch_idx, recv_dst, recv_slot = [], [], []
+    for node in range(na.nnodes):
+        for (s, i) in stage:
+            d = dest_of(s, i)
+            if int(na.node_of[d]) != node:
+                continue
+            exch_idx.append(stage_pos[(s, i)])
+            recv_dst.append(d)
+            recv_slot.append(slot_of(s, i))
+    return (stage_idx, np.array(exch_idx, dtype=np.int32),
+            np.array(recv_dst, dtype=np.int32),
+            np.array(recv_slot, dtype=np.int32))
+
+
 class JaxSimBackend:
     """Executes schedules on one device with ranks as an array axis."""
 
@@ -102,11 +157,35 @@ class JaxSimBackend:
             return p.cb_nodes, p.nprocs       # (send slots, recv slots)
         return p.nprocs, p.cb_nodes
 
-    def _one_rep(self, schedule: Schedule):
+    def _one_rep(self, schedule):
         """Build rep(send) -> recv, a pure jittable function."""
+        from tpu_aggcomm.tam.engine import TamMethod
+
         p = schedule.pattern
         n = p.nprocs
         n_send_slots, n_recv_slots = self._slots(p)
+
+        if isinstance(schedule, TamMethod):
+            # hierarchical route on one chip: three fenced gather hops over
+            # the staged slab arrays — the proxy engine's P2/P3/P4 made
+            # index maps; each hop stays a distinct program step
+            stage_idx, exch_idx, recv_dst, recv_slot = _tam_tables(schedule)
+            stage_j = jnp.asarray(stage_idx)
+            exch_j = jnp.asarray(exch_idx)
+            dst_j = jnp.asarray(recv_dst)
+            slot_j = jnp.asarray(recv_slot)
+
+            def rep(send):
+                flat = send.reshape(n * n_send_slots, p.data_size)
+                staged = jnp.take(flat, stage_j, axis=0)       # P2 gather
+                (staged,) = lax.optimization_barrier((staged,))
+                exch = jnp.take(staged, exch_j, axis=0)        # P3 exchange
+                (exch,) = lax.optimization_barrier((exch,))
+                recv = jnp.zeros((n, n_recv_slots + 1, p.data_size),
+                                 dtype=jnp.uint8)
+                return recv.at[dst_j, slot_j].set(exch)        # P4/P5
+
+            return rep
 
         if schedule.collective:
             # m=5/8: the whole pattern as one dense exchange — dst-major
@@ -164,8 +243,17 @@ class JaxSimBackend:
 
         return rep
 
-    def _key(self, schedule: Schedule):
-        return (schedule.pattern, schedule.method_id, schedule.collective)
+    def _key(self, schedule):
+        # barrier placement is the one schedule-shape input not captured by
+        # (pattern, method_id): m=13's -b modes compile different programs
+        # from the same pattern, and they must not share a cache entry
+        from tpu_aggcomm.core.schedule import OpKind
+        barrier_sig = tuple(
+            op.round for op in (schedule.programs[0] if getattr(
+                schedule, "programs", None) else ())
+            if op.kind is OpKind.BARRIER)
+        return (schedule.pattern, schedule.method_id, schedule.collective,
+                barrier_sig)
 
     def _compiled(self, schedule: Schedule):
         key = self._key(schedule)
@@ -189,11 +277,6 @@ class JaxSimBackend:
 
     def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
             verify: bool = False, chained: bool = False):
-        from tpu_aggcomm.tam.engine import TamMethod
-        if isinstance(schedule, TamMethod):
-            raise ValueError(
-                "TAM methods need the 2-axis mesh engine — use "
-                "--backend jax_ici (tam_two_level_jax)")
         if ntimes < 1:
             raise ValueError("ntimes must be >= 1")
         p = schedule.pattern
