@@ -110,7 +110,7 @@ def test_oracle_delivery_verifies(p, method, iter_):
        iter_=st.integers(0, 2))
 def test_tam_oracle_verifies(p, direction_m, iter_):
     from tpu_aggcomm.harness.verify import verify_recv
-    from tpu_aggcomm.tam.engine import gen_tam_schedule, tam_oracle
+    from tpu_aggcomm.tam.engine import tam_oracle
     sched = compile_method(direction_m, p)
     recv = tam_oracle(sched, iter_=iter_)
     verify_recv(sched.pattern, recv, iter_)

@@ -156,7 +156,8 @@ class JaxIciBackend:
                 tuple(sorted(low.barrier_rounds.items())))
 
     def _mesh(self, nprocs: int) -> Mesh:
-        devs = list(self._devices) if self._devices is not None else jax.devices()
+        from tpu_aggcomm.parallel import host_major_devices
+        devs = host_major_devices(self._devices)
         if len(devs) < nprocs:
             raise ValueError(
                 f"pattern needs {nprocs} devices, only {len(devs)} available "

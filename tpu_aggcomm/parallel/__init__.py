@@ -1,0 +1,140 @@
+"""Multi-host mesh construction — the DCN-scale entry points.
+
+The reference scales by launching MPI ranks across nodes (aprun over 256
+Theta nodes, script_theta_*.sh) and discovering topology with a hostname
+Allgather (lustre_driver_test.c:267-344). The TPU equivalents:
+
+- :func:`distributed_init` — per-process runtime bring-up
+  (``jax.distributed.initialize``), the ``MPI_Init`` analog for multi-host
+  TPU pods: after it, ``jax.devices()`` spans every host's chips and
+  collectives ride ICI within a slice and DCN across hosts.
+- :func:`host_major_devices` — the hostname-sort analog: order devices so
+  ranks on the same host are contiguous; schedules that keep neighbor
+  traffic local (TAM's intra-node phases, contiguous node maps) then hit
+  ICI, not DCN.
+- :func:`hierarchical_mesh` — the 2-axis ``(node, local)`` mesh used by the
+  hierarchical engines: the *node* axis crosses hosts (DCN), the *local*
+  axis stays within a host's ICI slice. On a single host it falls back to a
+  fabricated split (the static_node_assignment strategy) so the same
+  program shape is testable anywhere.
+
+Single-host processes need none of this — every backend works on
+``jax.devices()`` directly; these helpers only pin the placement that makes
+the hierarchy physical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["distributed_init", "host_major_devices", "hierarchical_mesh",
+           "warn_if_node_straddles_hosts"]
+
+
+def distributed_init(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> bool:
+    """Initialize the multi-host JAX runtime (idempotent).
+
+    With no arguments, relies on the environment/cluster auto-detection
+    (the normal TPU-pod path). Returns True if initialization happened,
+    False if it was already initialized or (argless) single-process. A
+    bring-up failure with explicit arguments PROPAGATES — swallowing it
+    would leave every host silently running a disjoint single-host job.
+    """
+    import jax
+
+    explicit = any(v is not None for v in (coordinator_address,
+                                           num_processes, process_id))
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        return True
+    except RuntimeError as e:
+        if "already" in str(e).lower() or "initialize" in str(e).lower():
+            return False   # double-init: harmless, keep idempotent
+        if explicit:
+            raise
+        return False
+    except ValueError:
+        if explicit:
+            raise          # mistyped coordinator/process args: fail fast
+        return False       # argless on a non-cluster: single-process
+
+
+def host_major_devices(devices=None) -> list:
+    """Devices reordered host-major — all of process 0's chips, then
+    process 1's, ... — the hostname-sort of gather_node_information applied
+    to a TPU device list. The sort is stable: within a host, the caller's
+    ordering is preserved."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(np.asarray(devices).reshape(-1))
+    return sorted(devices, key=lambda d: d.process_index)
+
+
+def hierarchical_mesh(devices=None, proc_node: int | None = None):
+    """Build the 2-axis ``(node, local)`` mesh + its NodeAssignment.
+
+    ``proc_node=None``: node = host process (every row of the mesh is one
+    host's ICI slice; the node axis is the DCN boundary; requires every
+    host to contribute the same chip count). Explicit ``proc_node``: that
+    many ranks per logical node, honored on any topology — each host's
+    chip count must then be a multiple of ``proc_node`` so no logical node
+    straddles a host (contiguous blocks in host-major order, mirroring
+    static_node_assignment type 0; on a single host this is the fabricated
+    split testable on the virtual CPU mesh).
+    """
+    from jax.sharding import Mesh
+
+    from tpu_aggcomm.core.topology import (mesh_node_assignment,
+                                           static_node_assignment)
+
+    devs = host_major_devices(devices)
+    n = len(devs)
+    host_na = mesh_node_assignment(devs)
+    if proc_node is None:
+        na = host_na
+        sizes = set(int(s) for s in na.node_sizes)
+        if len(sizes) != 1:
+            raise ValueError(
+                f"hierarchical mesh needs uniform chips per host; got "
+                f"sizes {sorted(sizes)} (pad the device list or pass an "
+                f"explicit dividing proc_node)")
+        L = sizes.pop()
+    else:
+        bad = [int(s) for s in host_na.node_sizes if s % proc_node != 0]
+        if bad or n % proc_node != 0:
+            raise ValueError(
+                f"proc_node={proc_node} must divide every host's chip "
+                f"count (host sizes {sorted(set(int(s) for s in host_na.node_sizes))}) "
+                f"so no logical node straddles the DCN boundary")
+        na = static_node_assignment(n, proc_node, 0)
+        L = proc_node
+    mesh = Mesh(np.array(devs).reshape(na.nnodes, L), ("node", "local"))
+    return mesh, na
+
+
+def warn_if_node_straddles_hosts(devices, L: int, context: str) -> bool:
+    """Warn when a logical node of ``L`` consecutive (host-major ordered)
+    devices spans more than one host process.
+
+    The program stays correct either way — but phases billed as intra-node
+    (ICI) traffic would actually ride DCN, so hierarchical measurements
+    would mismeasure. Returns True if a straddle was found.
+    """
+    import warnings
+
+    procs = [d.process_index for d in list(np.asarray(devices).reshape(-1))]
+    straddle = any(len(set(procs[i:i + L])) > 1
+                   for i in range(0, len(procs) - len(procs) % L, L))
+    if straddle:
+        warnings.warn(
+            f"{context}: a logical node of {L} ranks spans multiple host "
+            f"processes — intra-node phases will ride DCN, not ICI; pick "
+            f"proc_node dividing the chips-per-host to align the hierarchy",
+            RuntimeWarning, stacklevel=3)
+    return straddle

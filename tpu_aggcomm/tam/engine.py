@@ -185,6 +185,15 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
     if len(devices) < n:
         raise ValueError(f"need {n} devices, have {len(devices)}")
 
+    # host-major ordering aligns the logical node boundary with the DCN
+    # boundary when L divides the chips-per-host (no-op on one host);
+    # a straddling split still runs correctly but is flagged because its
+    # intra-node phases would ride DCN
+    from tpu_aggcomm.parallel import (host_major_devices,
+                                      warn_if_node_straddles_hosts)
+    devices = host_major_devices(devices)
+    warn_if_node_straddles_hosts(devices[:n], L, "tam_two_level_jax")
+
     mesh = Mesh(np.array(devices[:n]).reshape(N, L), ("node", "local"))
     agg_index = np.asarray(p.agg_index)
     rank_list = np.asarray(p.rank_list)

@@ -303,6 +303,10 @@ def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
             send_g[r, gi, :m] = wl.fill(r, int(g))
     send_g = send_g.reshape(N, L, G, S)
 
+    from tpu_aggcomm.parallel import (host_major_devices,
+                                      warn_if_node_straddles_hosts)
+    devices = host_major_devices(devices)
+    warn_if_node_straddles_hosts(devices[:n], L, "cw2_local_agg_jax")
     mesh = Mesh(np.array(devices[:n]).reshape(N, L), ("node", "local"))
     sharding = NamedSharding(mesh, P("node", "local"))
     send_dev = jax.device_put(send_g, sharding)
