@@ -89,3 +89,27 @@ def test_analyze_skips_truncated_row(tmp_path):
         f.write("All to many,8,2,64,4\n")  # killed-mid-append remnant
     rc, out = run_cli(["analyze", "--results-csv", str(csv)])
     assert rc == 0 and "winner: All to many" in out
+
+
+def test_sweep_resume_skips_recorded(tmp_path):
+    csv = tmp_path / "results.csv"
+    base = ["sweep", "-n", "8", "-m", "1", "-a", "3", "-d", "32", "-i", "2",
+            "--backend", "local", "--results-csv", str(csv)]
+    run_cli(base + ["--comm-sizes", "2,4"])
+    rc, out = run_cli(base + ["--comm-sizes", "2,4,8", "--resume"])
+    assert rc == 0
+    assert "skipping already-recorded comm sizes [2, 4]" in out
+    assert "RUN_OPTS: -a 3 -d 32 -c 8" in out
+    assert "RUN_OPTS: -a 3 -d 32 -c 2" not in out
+
+
+def test_sweep_resume_partial_iters_reruns(tmp_path):
+    csv = tmp_path / "results.csv"
+    base = ["sweep", "-n", "8", "-m", "1", "-a", "3", "-d", "32",
+            "--backend", "local", "--results-csv", str(csv)]
+    run_cli(base + ["-i", "1", "--comm-sizes", "2"])
+    # asking for more iters than recorded: the config is NOT complete
+    rc, out = run_cli(base + ["-i", "2", "--comm-sizes", "2", "--resume"])
+    assert rc == 0
+    assert "skipping" not in out
+    assert "RUN_OPTS: -a 3 -d 32 -c 2" in out

@@ -122,6 +122,10 @@ def build_parser() -> argparse.ArgumentParser:
     sw.add_argument("--verify", action="store_true")
     sw.add_argument("--chained", action="store_true",
                     help="jax_sim: serial-chained per-rep measurement")
+    sw.add_argument("--resume", action="store_true",
+                    help="skip throttle values already recorded in the "
+                         "results CSV for this config (an interrupted sweep "
+                         "picks up where it stopped)")
     sw.add_argument("--results-csv", default="results.csv")
     sw.add_argument("--comm-sizes", type=str, default=None,
                     help="comma-separated throttle values (default: the "
@@ -206,6 +210,39 @@ def _default_nprocs(backend: str) -> int:
     return len(jax.devices())
 
 
+def _completed_throttles(csv_path: str, nprocs: int, cb_nodes: int,
+                         data_size: int, method: int, iters: int) -> set:
+    """Throttle values already fully recorded for this sweep config: every
+    required method name has >= iters rows at that comm size."""
+    import csv
+    from collections import Counter
+
+    from tpu_aggcomm.core.methods import METHODS, method_ids
+
+    ids = method_ids() if method == 0 else [method]
+    names = {METHODS[m].name for m in ids if m in METHODS}
+    try:
+        with open(csv_path, newline="") as f:
+            rows = list(csv.DictReader(f))
+    except FileNotFoundError:
+        return set()
+    cnt: Counter = Counter()
+    comms = set()
+    for r in rows:
+        try:
+            key = (r["Method"], int(r["# of processes"]),
+                   int(r["# of aggregators"]), int(r["data size"]),
+                   int(r["max comm"]))
+        except (KeyError, ValueError, TypeError):
+            continue
+        if key[1:4] == (nprocs, cb_nodes, data_size):
+            cnt[key] += 1
+            comms.add(key[4])
+    return {c for c in comms
+            if all(cnt[(n, nprocs, cb_nodes, data_size, c)] >= iters
+                   for n in names)}
+
+
 def _run_sweep(args) -> int:
     """One experiment per throttle value over the Theta grid; rows
     accumulate in results.csv exactly like repeated ./test invocations."""
@@ -219,6 +256,13 @@ def _run_sweep(args) -> int:
             raise SystemExit("--comm-sizes: no valid throttle values")
     else:
         grid = list(THETA_COMM_SIZES)
+    if args.resume:
+        done = _completed_throttles(args.results_csv, nprocs, args.cb_nodes,
+                                    args.data_size, args.method, args.iters)
+        skipped = [c for c in grid if c in done]
+        grid = [c for c in grid if c not in done]
+        if skipped:
+            print(f"resume: skipping already-recorded comm sizes {skipped}")
     for c in grid:
         print(f"RUN_OPTS: -a {args.cb_nodes} -d {args.data_size} -c {c} "
               f"-m {args.method} -i {args.iters}")
