@@ -10,31 +10,29 @@ aggregator slabs × 2048 B) on one TPU chip: the 32 logical ranks live
 on-device as a leading axis (the single-process simulation strategy the
 reference itself uses for topology, SURVEY.md §4.2) and one rep is the slab
 exchange send[rank, slab] → recv[aggregator, source] with the aggregator
-rows ordered by the pattern's actual rank_list placement. Correctness is
-checked two ways: the device chain is replayed exactly on the host, and the
-first rep's row layout is verified against an independently-derived
-rank→aggregator mapping (``p.agg_index``), so a wrong placement gather
-cannot silently pass.
+rows ordered by the pattern's actual rank_list placement.
 
-Measurement method (documented because the TPU here sits behind a network
-tunnel with a ~60-90 ms per-dispatch RPC round trip, which would otherwise
-*be* the measurement):
+Execution path: on TPU, the fused Pallas kernel
+(tpu_aggcomm/backends/pallas_local.py) — one VMEM pass per rep doing the
+placement permutation + the chain perturbation on uint32 lanes (byte-exact;
+Mosaic has no i8 ALU). Off-TPU, the plain XLA formulation of the same
+program. Correctness is checked three ways: (1) one rep's row layout
+against an independently-derived rank→aggregator mapping (``p.agg_index``),
+(2) the whole chain replayed exactly on the host in numpy, (3) on TPU, the
+Pallas chain against the independent XLA chain, byte-for-byte.
 
-- Reps are chained STRICTLY SERIALLY inside one compiled program via
-  ``lax.scan`` (unroll=1): rep r+1's send buffer is derived from rep r's
-  recv buffer (reshape + rep-index add), so every rep is a real data pass —
-  while-loop iterations cannot be fused, hoisted, or elided. This mirrors
-  the reference's ``-k ntimes`` window: reps run back-to-back with no
-  resync (mpi_test.c:1764-1815). No batching: the reported value is the
-  serial latency of one whole-pattern exchange, the same metric as the
-  baseline.
-- Completion is forced by reading back a checksum of the final state (the
-  tunnel's ``block_until_ready`` alone does not guarantee execution).
-- The fixed RPC/dispatch overhead is cancelled by differencing two rep
-  counts: per_rep = (T(iters_big) − T(iters_small)) / (iters_big −
-  iters_small). The median of several trials is reported (differencing is
-  noise-sensitive).
-- Correctness: the full chain is replayed in numpy and compared exactly.
+Measurement (the TPU sits behind a network tunnel with a ~60-90 ms
+per-dispatch RPC round trip, which would otherwise *be* the measurement):
+reps are chained STRICTLY SERIALLY inside one compiled program via
+``lax.scan`` (unroll=1) — rep r+1's send buffer is rep r's output, XORed
+with the rep index, so iterations cannot be fused, hoisted, or elided; this
+mirrors the reference's ``-k ntimes`` window (reps back-to-back, no resync,
+mpi_test.c:1764-1815). Completion is forced by a checksum readback, and the
+fixed dispatch overhead cancels by differencing two chain lengths
+(harness/chained.py). At ~2 µs/rep the 100k-rep chain keeps the differenced
+work (~170 ms) well above timer noise. At this size the working set is
+VMEM-resident — the single-chip analog of the reference's cache-resident
+32-rank run.
 
 ``vs_baseline`` = baseline_time / our_time (higher is better; >1 beats the
 reference).
@@ -48,87 +46,63 @@ import numpy as np
 
 BASELINE_S = 0.029803   # reference README.md:64, all-to-many max total time
 PROCS, CB_NODES, DATA_SIZE = 32, 14, 2048
-ITERS_SMALL, ITERS_BIG = 500, 10500
+ITERS_SMALL, ITERS_BIG = 2000, 102000
 TRIALS = 5
 VERIFY_ITERS = 9
 
 
 def main() -> int:
     import jax
-    import jax.numpy as jnp
-    from jax import lax
 
+    from tpu_aggcomm.backends.pallas_local import (fused_exchange_chain,
+                                                   xla_exchange_chain)
     from tpu_aggcomm.core.pattern import AggregatorPattern
+    from tpu_aggcomm.harness.chained import differenced_trials
 
-    # the pattern under test — same config as the reference README run
     p = AggregatorPattern(nprocs=PROCS, cb_nodes=CB_NODES,
                           data_size=DATA_SIZE, comm_size=3)
-    # aggregator-row order = ascending aggregator rank (create_aggregator_list
-    # placement); the exchange below consults this, so the bench output
-    # depends on the pattern's real placement mapping
-    order = np.argsort(np.asarray(p.rank_list)).astype(np.int32)
-    order_j = jnp.asarray(order)
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    W = DATA_SIZE // 4
 
-    def exchange(send):
-        # send: (PROCS, CB_NODES, DS) rank-major slabs; recv: (CB_NODES,
-        # PROCS, DS) — row g collects every rank's slab for the g-th
-        # aggregator by rank order
-        return jnp.take(jnp.transpose(send, (1, 0, 2)), order_j, axis=0)
+    def make_chain(iters):
+        return (fused_exchange_chain(p, iters) if on_tpu
+                else xla_exchange_chain(p, iters))
 
-    def make_chain(iters: int):
-        @jax.jit
-        def chain(send0):
-            def body(send, r):
-                recv = exchange(send)                      # one rep
-                # next rep's send derives from this rep's recv (fresh
-                # fill analog: + rep index) — strict serial dependency
-                nxt = recv.reshape(PROCS, CB_NODES, DATA_SIZE) \
-                    + r.astype(jnp.uint8)
-                return nxt, ()
-            out, _ = lax.scan(body, send0,
-                              jnp.arange(iters, dtype=jnp.int32), unroll=1)
-            return out
-        return chain
-
-    @jax.jit
-    def make_send():
-        n = PROCS * CB_NODES * DATA_SIZE
-        return jnp.arange(n, dtype=jnp.uint8).reshape(
-            PROCS, CB_NODES, DATA_SIZE)
-
-    send0 = make_send()
-    send0.block_until_ready()
+    send0 = jax.device_put(
+        np.arange(PROCS * CB_NODES * W, dtype=np.uint32).reshape(
+            PROCS, CB_NODES, W), dev)
+    send_np = np.asarray(jax.device_get(send0))
 
     # correctness 1: one rep's placement semantics against an independent
-    # mapping — recv row j must hold, for every source r, the slab r
-    # addressed to the j-th aggregator *by rank order* (slab index =
-    # agg_index of that aggregator rank), not merely replay the same
-    # `order` gather
-    send_np = np.asarray(jax.device_get(send0))
-    recv1 = np.asarray(jax.device_get(jax.jit(exchange)(send0)))
-    agg_ranks_sorted = sorted(int(a) for a in p.rank_list)
+    # mapping — after one rep (XOR word 0 = identity), recv row j must
+    # hold, for every source r, the slab addressed to the j-th aggregator
+    # *by rank order* (slab index = agg_index of that aggregator rank)
+    s1 = np.asarray(jax.device_get(make_chain(1)(send0)))
+    recv1 = s1.reshape(CB_NODES, PROCS, W)
     agg_index = np.asarray(p.agg_index)
-    for j, a in enumerate(agg_ranks_sorted):
+    for j, a in enumerate(sorted(int(x) for x in p.rank_list)):
         assert np.array_equal(recv1[j], send_np[:, agg_index[a]]), \
             f"aggregator row {j} (rank {a}) has wrong slabs"
 
     # correctness 2: exact replay of the whole chain on host
+    from tpu_aggcomm.backends.pallas_local import host_replay
+    ref = host_replay(p, send_np, VERIFY_ITERS)
     got = np.asarray(jax.device_get(make_chain(VERIFY_ITERS)(send0)))
-    ref = np.arange(got.size, dtype=np.uint8).reshape(got.shape)
-    for r in range(VERIFY_ITERS):
-        ref = (np.transpose(ref, (1, 0, 2))[order].reshape(got.shape)
-               + np.uint8(r))
     assert np.array_equal(got, ref), "chained exchange produced wrong slabs"
 
-    from tpu_aggcomm.harness.chained import differenced_trials
+    # correctness 3 (TPU): Pallas kernel vs the independent XLA program
+    if on_tpu:
+        got_xla = np.asarray(jax.device_get(
+            xla_exchange_chain(p, VERIFY_ITERS)(send0)))
+        assert np.array_equal(got, got_xla), "pallas chain != xla chain"
 
     per_reps = differenced_trials(make_chain, send0,
                                   iters_small=ITERS_SMALL,
                                   iters_big=ITERS_BIG,
-                                  trials=TRIALS, windows=5)
+                                  trials=TRIALS, windows=3)
     per_rep = statistics.median(per_reps)
 
-    dev = jax.devices()[0]
     gbps = PROCS * CB_NODES * DATA_SIZE / per_rep / 1e9
     print(json.dumps({
         "metric": f"all_to_many max total time per rep (n={PROCS} "
@@ -138,8 +112,9 @@ def main() -> int:
         "vs_baseline": BASELINE_S / per_rep,
     }))
     print(f"# effective bandwidth: {gbps:.2f} GB/s pattern-bytes "
-          f"on {dev.device_kind}; trials(us/rep)="
-          f"{[round(t * 1e6, 3) for t in per_reps]}", file=sys.stderr)
+          f"on {dev.device_kind}; path={'pallas' if on_tpu else 'xla'}; "
+          f"trials(us/rep)={[round(t * 1e6, 3) for t in per_reps]}",
+          file=sys.stderr)
     return 0
 
 

@@ -113,3 +113,30 @@ def test_sweep_resume_partial_iters_reruns(tmp_path):
     assert rc == 0
     assert "skipping" not in out
     assert "RUN_OPTS: -a 3 -d 32 -c 2" in out
+
+
+def test_sweep_resume_respects_ntimes_and_placement(tmp_path):
+    csv = tmp_path / "results.csv"
+    base = ["sweep", "-n", "8", "-m", "1", "-a", "3", "-d", "32", "-i", "1",
+            "--backend", "local", "--results-csv", str(csv)]
+    run_cli(base + ["--comm-sizes", "2", "-k", "1", "-t", "1"])
+    # different -k: not complete, must rerun
+    rc, out = run_cli(base + ["--comm-sizes", "2", "-k", "5", "-t", "1",
+                              "--resume"])
+    assert "skipping" not in out
+    # different -t: not complete, must rerun
+    rc, out = run_cli(base + ["--comm-sizes", "2", "-k", "1", "-t", "0",
+                              "--resume"])
+    assert "skipping" not in out
+    # identical parameters: skipped
+    rc, out = run_cli(base + ["--comm-sizes", "2", "-k", "1", "-t", "1",
+                              "--resume"])
+    assert "skipping already-recorded comm sizes [2]" in out
+
+
+def test_sweep_resume_rejects_unknown_method(tmp_path):
+    csv = tmp_path / "results.csv"
+    with pytest.raises(SystemExit, match="unknown method id 99"):
+        run_cli(["sweep", "-n", "8", "-m", "99", "-a", "3", "-d", "32",
+                 "--backend", "local", "--results-csv", str(csv),
+                 "--comm-sizes", "2", "--resume"])

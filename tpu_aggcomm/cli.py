@@ -211,36 +211,43 @@ def _default_nprocs(backend: str) -> int:
 
 
 def _completed_throttles(csv_path: str, nprocs: int, cb_nodes: int,
-                         data_size: int, method: int, iters: int) -> set:
+                         data_size: int, method: int, iters: int,
+                         ntimes: int, agg_type: int) -> set:
     """Throttle values already fully recorded for this sweep config: every
-    required method name has >= iters rows at that comm size."""
+    required method name has >= iters rows at that comm size with the SAME
+    measurement parameters (ntimes, aggregator placement) — rows from a
+    differently-parameterized sweep must not satisfy this one."""
     import csv
     from collections import Counter
 
     from tpu_aggcomm.core.methods import METHODS, method_ids
 
     ids = method_ids() if method == 0 else [method]
-    names = {METHODS[m].name for m in ids if m in METHODS}
+    unknown = [m for m in ids if m not in METHODS]
+    if unknown:
+        raise SystemExit(f"unknown method id {unknown[0]}; valid ids: "
+                         f"{sorted(METHODS)}")
+    names = {METHODS[m].name for m in ids}
     try:
         with open(csv_path, newline="") as f:
             rows = list(csv.DictReader(f))
     except FileNotFoundError:
         return set()
+    cfg = (nprocs, cb_nodes, data_size, ntimes, agg_type)
     cnt: Counter = Counter()
     comms = set()
     for r in rows:
         try:
-            key = (r["Method"], int(r["# of processes"]),
-                   int(r["# of aggregators"]), int(r["data size"]),
-                   int(r["max comm"]))
+            row_cfg = (int(r["# of processes"]), int(r["# of aggregators"]),
+                       int(r["data size"]), int(r["ntimes"]),
+                       int(r["aggregator type"]))
+            name, comm = r["Method"], int(r["max comm"])
         except (KeyError, ValueError, TypeError):
             continue
-        if key[1:4] == (nprocs, cb_nodes, data_size):
-            cnt[key] += 1
-            comms.add(key[4])
-    return {c for c in comms
-            if all(cnt[(n, nprocs, cb_nodes, data_size, c)] >= iters
-                   for n in names)}
+        if row_cfg == cfg:
+            cnt[(name, comm)] += 1
+            comms.add(comm)
+    return {c for c in comms if all(cnt[(n, c)] >= iters for n in names)}
 
 
 def _run_sweep(args) -> int:
@@ -258,7 +265,8 @@ def _run_sweep(args) -> int:
         grid = list(THETA_COMM_SIZES)
     if args.resume:
         done = _completed_throttles(args.results_csv, nprocs, args.cb_nodes,
-                                    args.data_size, args.method, args.iters)
+                                    args.data_size, args.method, args.iters,
+                                    args.ntimes, args.agg_type)
         skipped = [c for c in grid if c in done]
         grid = [c for c in grid if c not in done]
         if skipped:
