@@ -45,3 +45,13 @@ def test_rejects_unaligned_data_size():
     p = AggregatorPattern(8, 3, data_size=30)
     with pytest.raises(ValueError, match="multiple of 4"):
         fused_exchange_chain(p, 1, interpret=True)
+
+
+@pytest.mark.parametrize("entry", ["xla", "replay"])
+def test_all_entry_points_reject_unaligned(entry):
+    p = AggregatorPattern(8, 3, data_size=30)
+    with pytest.raises(ValueError, match="multiple of 4"):
+        if entry == "xla":
+            xla_exchange_chain(p, 1)
+        else:
+            host_replay(p, np.zeros((8, 3, 7), np.uint32), 1)

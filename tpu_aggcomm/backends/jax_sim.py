@@ -157,6 +157,18 @@ class JaxSimBackend:
             return p.cb_nodes, p.nprocs       # (send slots, recv slots)
         return p.nprocs, p.cb_nodes
 
+    @staticmethod
+    def _words(p: AggregatorPattern):
+        """On-device lane layout: byte payloads ride uint32 lanes when the
+        slab size allows (TPU handles u8 layouts 4-5x slower, and Mosaic
+        has no i8 ALU at all — see backends/pallas_local.py). Row-level
+        gathers/scatters are dtype-agnostic, so only the lane view changes;
+        the host-side byte semantics (fills, verification) are untouched.
+        Returns (numpy dtype, jnp dtype, words per slab)."""
+        if p.data_size % 4 == 0:
+            return np.uint32, jnp.uint32, p.data_size // 4
+        return np.uint8, jnp.uint8, p.data_size
+
     def _one_rep(self, schedule):
         """Build rep(send) -> recv, a pure jittable function."""
         from tpu_aggcomm.tam.engine import TamMethod
@@ -175,14 +187,15 @@ class JaxSimBackend:
             dst_j = jnp.asarray(recv_dst)
             slot_j = jnp.asarray(recv_slot)
 
+            _, jdt, w = self._words(p)
+
             def rep(send):
-                flat = send.reshape(n * n_send_slots, p.data_size)
+                flat = send.reshape(n * n_send_slots, w)
                 staged = jnp.take(flat, stage_j, axis=0)       # P2 gather
                 (staged,) = lax.optimization_barrier((staged,))
                 exch = jnp.take(staged, exch_j, axis=0)        # P3 exchange
                 (exch,) = lax.optimization_barrier((exch,))
-                recv = jnp.zeros((n, n_recv_slots + 1, p.data_size),
-                                 dtype=jnp.uint8)
+                recv = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
                 return recv.at[dst_j, slot_j].set(exch)        # P4/P5
 
             return rep
@@ -197,17 +210,17 @@ class JaxSimBackend:
                 sslot_of, rslot_of = agg_index, np.arange(n)
             else:
                 sslot_of, rslot_of = np.arange(n), agg_index
+            ndt, jdt, w = self._words(p)
             sslot_c = jnp.asarray(np.maximum(sslot_of, 0), dtype=jnp.int32)
-            smask = jnp.asarray((sslot_of >= 0).astype(np.uint8))[None, :, None]
+            smask = jnp.asarray((sslot_of >= 0).astype(ndt))[None, :, None]
             rslot_c = jnp.asarray(
                 np.where(rslot_of >= 0, rslot_of, n_recv_slots),
                 dtype=jnp.int32)
 
             def rep(send):
-                rows = jnp.take(send, sslot_c, axis=1) * smask  # (n, n, ds)
+                rows = jnp.take(send, sslot_c, axis=1) * smask  # (n, n, w)
                 got = jnp.transpose(rows, (1, 0, 2))            # got[d, s]
-                recv = jnp.zeros((n, n_recv_slots + 1, p.data_size),
-                                 dtype=jnp.uint8)
+                recv = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
                 return recv.at[:, rslot_c].set(got)
 
             return rep
@@ -218,19 +231,19 @@ class JaxSimBackend:
                 for (_r, srcs, ss, dsts, ds_) in rounds]
         round_ids = [r for (r, *_rest) in rounds]
 
+        _, jdt, w = self._words(p)
+
         def rep(send):
-            recv = jnp.zeros((n, n_recv_slots + 1, p.data_size),
-                             dtype=jnp.uint8)
+            recv = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
 
             def emit_barriers(recv, rnd):
                 # a barrier's observable effect is an ordering dependency on
-                # everyone's state: reduce live recv bytes into the trash
+                # everyone's state: reduce live recv lanes into the trash
                 # row so the fence can neither fold nor be DCE'd
                 for _ in range(barrier_rounds.get(rnd, 0)):
                     tok = jnp.sum(recv[:, :n_recv_slots, 0]
                                   .astype(jnp.int32))
-                    recv = recv.at[:, n_recv_slots, 0].set(
-                        (tok % 256).astype(jnp.uint8))
+                    recv = recv.at[:, n_recv_slots, 0].set(tok.astype(jdt))
                 return recv
 
             for k, (srcs, ss, dsts, ds_) in enumerate(tabs):
@@ -263,13 +276,21 @@ class JaxSimBackend:
 
     # ------------------------------------------------------------------
     def _global_send(self, p: AggregatorPattern, iter_: int) -> np.ndarray:
+        """Byte fills viewed in the device lane layout (_words)."""
         n_send_slots, _ = self._slots(p)
         slabs = make_send_slabs(p, iter_)
         out = np.zeros((p.nprocs, n_send_slots, p.data_size), dtype=np.uint8)
         for r, s in enumerate(slabs):
             if s is not None:
                 out[r, :s.shape[0]] = s
-        return out
+        ndt, _, w = self._words(p)
+        return out.view(ndt).reshape(p.nprocs, n_send_slots, w)
+
+    def _to_bytes(self, p: AggregatorPattern, arr: np.ndarray) -> np.ndarray:
+        """Device lane layout back to the byte layout the verifier speaks."""
+        arr = np.ascontiguousarray(arr)
+        return arr.view(np.uint8).reshape(arr.shape[0], arr.shape[1],
+                                          p.data_size)
 
     def _split_recv(self, p: AggregatorPattern, recv_np: np.ndarray):
         counts = recv_slot_counts(p)
@@ -308,7 +329,8 @@ class JaxSimBackend:
                     [Timer(total_time=dt) for _ in range(p.nprocs)])
 
         _, n_recv_slots = self._slots(p)
-        recv_np = np.asarray(jax.device_get(out))[:, :n_recv_slots, :]
+        recv_words = np.asarray(jax.device_get(out))[:, :n_recv_slots, :]
+        recv_np = self._to_bytes(p, recv_words)
         recv_bufs = self._split_recv(p, recv_np)
         if verify:
             from tpu_aggcomm.harness.verify import verify_recv
@@ -336,6 +358,7 @@ class JaxSimBackend:
         dev = self._dev()
         rep = self._one_rep(schedule)
         _, n_recv_slots = self._slots(p)
+        _, jdt, _w = self._words(p)
 
         def make_chain(iters: int):
             @jax.jit
@@ -344,7 +367,13 @@ class JaxSimBackend:
                     recv = rep(send)
                     tok = (jnp.sum(recv[:, :n_recv_slots, 0]
                                    .astype(jnp.int32)) + r) % 251
-                    return send + tok.astype(jnp.uint8), ()
+                    # byte-wise perturbation in the lane dtype: XOR with the
+                    # token replicated into every byte (carry-free, so the
+                    # u32-lane and u8 paths perturb identical byte streams)
+                    from tpu_aggcomm.backends.pallas_local import rep_word
+                    word = (rep_word(tok) if jdt == jnp.uint32
+                            else tok.astype(jnp.uint8))
+                    return send ^ word, ()
                 out, _ = lax.scan(body, send0,
                                   jnp.arange(iters, dtype=jnp.int32),
                                   unroll=1)

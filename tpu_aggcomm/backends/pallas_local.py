@@ -45,6 +45,16 @@ def _order(p: AggregatorPattern) -> list[int]:
     return [int(x) for x in np.argsort(np.asarray(p.rank_list))]
 
 
+def _lane_width(p: AggregatorPattern) -> int:
+    """Words per slab on the uint32-lane layout; every entry point shares
+    this check so the fallback/replay cannot accept (and truncate) inputs
+    the kernel rejects."""
+    if p.data_size % 4:
+        raise ValueError("data_size must be a multiple of 4 for the "
+                         "uint32-lane kernel")
+    return p.data_size // 4
+
+
 def rep_word(r):
     """The rep-index perturbation word: index byte replicated in every lane
     byte, so XOR-ing it equals a per-byte XOR."""
@@ -60,10 +70,7 @@ def fused_exchange_chain(p: AggregatorPattern, iters: int, *,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    if p.data_size % 4:
-        raise ValueError("data_size must be a multiple of 4 for the "
-                         "uint32-lane kernel")
-    n, cb, w = p.nprocs, p.cb_nodes, p.data_size // 4
+    n, cb, w = p.nprocs, p.cb_nodes, _lane_width(p)
     order = _order(p)
 
     def kernel(r_ref, in_ref, out_ref):
@@ -100,7 +107,7 @@ def host_replay(p: AggregatorPattern, send0: np.ndarray,
     formulations are checked against. One definition, shared by bench.py
     and the tests, so the perturbation semantics cannot drift."""
     order = np.argsort(np.asarray(p.rank_list))
-    n, cb, w = p.nprocs, p.cb_nodes, p.data_size // 4
+    n, cb, w = p.nprocs, p.cb_nodes, _lane_width(p)
     ref = np.asarray(send0)
     for r in range(iters):
         recv = np.transpose(ref, (1, 0, 2))[order]
@@ -111,7 +118,7 @@ def host_replay(p: AggregatorPattern, send0: np.ndarray,
 def xla_exchange_chain(p: AggregatorPattern, iters: int):
     """The same chain expressed in plain XLA (transpose + gather + xor) —
     the off-TPU path and the independent cross-check for the kernel."""
-    n, cb, w = p.nprocs, p.cb_nodes, p.data_size // 4
+    n, cb, w = p.nprocs, p.cb_nodes, _lane_width(p)
     order_j = jnp.asarray(np.asarray(_order(p), dtype=np.int32))
 
     @jax.jit
