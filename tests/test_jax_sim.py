@@ -93,3 +93,31 @@ def test_sim_cli_sweep(tmp_path, capsys):
     assert csv.exists()
     out = capsys.readouterr().out
     assert "RUN_OPTS" in out
+
+
+def test_sim_profile_rounds():
+    p = AggregatorPattern(8, 3, data_size=16, comm_size=2)
+    sched = compile_method(1, p)
+    b = JaxSimBackend()
+    recv, timers = b.run(sched, verify=True, profile_rounds=True)
+    assert timers[0].recv_wait_all_time > 0
+    assert len(b.last_round_times) == 1
+    from tpu_aggcomm.backends.jax_sim import _round_tables
+    n_rounds = len(_round_tables(sched)[0])
+    assert len(b.last_round_times[0]) == n_rounds > 1
+
+
+def test_sim_profile_rounds_dense_single_segment():
+    p = AggregatorPattern(8, 3, data_size=16)
+    b = JaxSimBackend()
+    recv, timers = b.run(compile_method(8, p), verify=True,
+                         profile_rounds=True)
+    assert len(b.last_round_times[0]) == 1
+    assert timers[0].recv_wait_all_time == 0
+
+
+def test_sim_profile_rounds_excludes_chained():
+    p = AggregatorPattern(8, 3, data_size=16)
+    with pytest.raises(ValueError, match="exclusive"):
+        JaxSimBackend().run(compile_method(1, p), chained=True,
+                            profile_rounds=True)

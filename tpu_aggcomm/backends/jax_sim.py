@@ -138,6 +138,22 @@ def _tam_tables(tam):
             np.array(recv_slot, dtype=np.int32))
 
 
+def _apply_round(send, recv, srcs, ss, dsts, ds_, nbar: int,
+                 n_recv_slots: int, jdt):
+    """One throttle round: gather the round's messages, land them in their
+    recv slots, then emit its barriers. A barrier's observable effect is an
+    ordering dependency on everyone's state: reduce live recv lanes into
+    the trash row so the fence can neither fold nor be DCE'd. Shared by the
+    whole-rep program and the profile_rounds segments so the profiled
+    decomposition cannot drift from the program it decomposes."""
+    vals = send[jnp.asarray(srcs), jnp.asarray(ss)]
+    recv = recv.at[jnp.asarray(dsts), jnp.asarray(ds_)].set(vals)
+    for _ in range(nbar):
+        tok = jnp.sum(recv[:, :n_recv_slots, 0].astype(jnp.int32))
+        recv = recv.at[:, n_recv_slots, 0].set(tok.astype(jdt))
+    return recv
+
+
 class JaxSimBackend:
     """Executes schedules on one device with ranks as an array axis."""
 
@@ -226,8 +242,7 @@ class JaxSimBackend:
             return rep
 
         rounds, barrier_rounds = _round_tables(schedule)
-        tabs = [(jnp.asarray(srcs), jnp.asarray(ss),
-                 jnp.asarray(dsts), jnp.asarray(ds_))
+        tabs = [(srcs, ss, dsts, ds_)
                 for (_r, srcs, ss, dsts, ds_) in rounds]
         round_ids = [r for (r, *_rest) in rounds]
 
@@ -235,21 +250,10 @@ class JaxSimBackend:
 
         def rep(send):
             recv = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
-
-            def emit_barriers(recv, rnd):
-                # a barrier's observable effect is an ordering dependency on
-                # everyone's state: reduce live recv lanes into the trash
-                # row so the fence can neither fold nor be DCE'd
-                for _ in range(barrier_rounds.get(rnd, 0)):
-                    tok = jnp.sum(recv[:, :n_recv_slots, 0]
-                                  .astype(jnp.int32))
-                    recv = recv.at[:, n_recv_slots, 0].set(tok.astype(jdt))
-                return recv
-
             for k, (srcs, ss, dsts, ds_) in enumerate(tabs):
-                vals = send[srcs, ss]                  # gather round's msgs
-                recv = recv.at[dsts, ds_].set(vals)    # land them
-                recv = emit_barriers(recv, round_ids[k])
+                recv = _apply_round(send, recv, srcs, ss, dsts, ds_,
+                                    barrier_rounds.get(round_ids[k], 0),
+                                    n_recv_slots, jdt)
                 if k + 1 < len(tabs):
                     send, recv = lax.optimization_barrier((send, recv))
             return recv
@@ -297,19 +301,29 @@ class JaxSimBackend:
         return [recv_np[r] if counts[r] else None for r in range(p.nprocs)]
 
     def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
-            verify: bool = False, chained: bool = False):
+            verify: bool = False, chained: bool = False,
+            profile_rounds: bool = False):
         if ntimes < 1:
             raise ValueError("ntimes must be >= 1")
+        if chained and profile_rounds:
+            raise ValueError("chained and profile_rounds are exclusive "
+                             "(one program vs per-round programs)")
         p = schedule.pattern
         dev = self._dev()
-        fn = self._compiled(schedule)
-
         send_dev = jax.device_put(self._global_send(p, iter_), dev)
-        out = fn(send_dev)
-        out.block_until_ready()            # warm-up compile
+        # profile_rounds with a round structure never runs the monolithic
+        # program — don't compile it (22 wasted compiles on a method sweep)
+        profiled_segs = (self._round_segments(schedule) if profile_rounds
+                         else None)
+        out = None
+        if not (profile_rounds and profiled_segs is not None):
+            fn = self._compiled(schedule)
+            out = fn(send_dev)
+            out.block_until_ready()        # warm-up compile
 
         timers = [Timer() for _ in range(p.nprocs)]
         self.last_rep_timers = []
+        self.last_round_times = []         # [rep] -> [per-round seconds]
         if chained:
             per_rep = self.measure_per_rep(schedule)
             for t in timers:
@@ -317,6 +331,8 @@ class JaxSimBackend:
             self.last_rep_timers = [
                 [Timer(total_time=per_rep) for _ in range(p.nprocs)]
                 for _ in range(ntimes)]
+        elif profile_rounds:
+            out = self._run_profiled(schedule, send_dev, ntimes, timers)
         else:
             for _ in range(ntimes):
                 t0 = time.perf_counter()
@@ -336,6 +352,89 @@ class JaxSimBackend:
             from tpu_aggcomm.harness.verify import verify_recv
             verify_recv(p, recv_bufs, iter_)
         return recv_bufs, timers
+
+    # ------------------------------------------------------------------
+    def _round_segments(self, schedule):
+        """Per-round jitted (send, recv) -> recv programs, for profiling.
+        None when the schedule has no round structure to split (dense
+        collective methods and the 3-hop TAM route)."""
+        from tpu_aggcomm.tam.engine import TamMethod
+        if isinstance(schedule, TamMethod) or schedule.collective:
+            return None
+        key = (self._key(schedule), "segments")
+        if key in self._cache:
+            return self._cache[key]
+        p = schedule.pattern
+        _, n_recv_slots = self._slots(p)
+        _, jdt, _w = self._words(p)
+        rounds, barrier_rounds = _round_tables(schedule)
+
+        def make_seg(srcs, ss, dsts, ds_, nbar):
+            @jax.jit
+            def seg(send, recv):
+                return _apply_round(send, recv, srcs, ss, dsts, ds_, nbar,
+                                    n_recv_slots, jdt)
+
+            return seg
+
+        segs = [make_seg(srcs, ss, dsts, ds_, barrier_rounds.get(r, 0))
+                for (r, srcs, ss, dsts, ds_) in rounds]
+        self._cache[key] = segs
+        return segs
+
+    def _run_profiled(self, schedule, send_dev, ntimes: int, timers):
+        """profile_rounds execution: one dispatch per throttle round, each
+        synced and timed — schedule-shape analysis, not headline numbers
+        (per-dispatch sync overhead is included, as on jax_ici). Per-round
+        times land in ``last_round_times``; their sum is charged to
+        recv_wait_all_time, mirroring the jax_ici convention."""
+        p = schedule.pattern
+        dev = self._dev()
+        segs = self._round_segments(schedule)
+        _, n_recv_slots = self._slots(p)
+        _, jdt, w = self._words(p)
+
+        if segs is None:
+            segs_run = None
+        else:
+            # warm-up compile every segment
+            recv_w = jnp.zeros((p.nprocs, n_recv_slots + 1, w), dtype=jdt)
+            recv_w = jax.device_put(recv_w, dev)
+            for seg in segs:
+                recv_w = seg(send_dev, recv_w)
+            recv_w.block_until_ready()
+            segs_run = segs
+
+        out = None
+        for _ in range(ntimes):
+            if segs_run is None:
+                fn = self._compiled(schedule)
+                t0 = time.perf_counter()
+                out = fn(send_dev)
+                out.block_until_ready()
+                dt = time.perf_counter() - t0
+                self.last_round_times.append([dt])
+            else:
+                recv = jax.device_put(
+                    jnp.zeros((p.nprocs, n_recv_slots + 1, w), dtype=jdt),
+                    dev)
+                round_times = []
+                t0 = time.perf_counter()
+                for seg in segs_run:
+                    ts = time.perf_counter()
+                    recv = seg(send_dev, recv)
+                    recv.block_until_ready()
+                    round_times.append(time.perf_counter() - ts)
+                dt = time.perf_counter() - t0
+                out = recv
+                self.last_round_times.append(round_times)
+            for t in timers:
+                t.total_time += dt
+                if segs_run is not None and len(segs_run) > 1:
+                    t.recv_wait_all_time += sum(self.last_round_times[-1])
+            self.last_rep_timers.append(
+                [Timer(total_time=dt) for _ in range(p.nprocs)])
+        return out
 
     # ------------------------------------------------------------------
     def measure_per_rep(self, schedule, *, iters_small: int = 50,

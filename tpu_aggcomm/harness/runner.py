@@ -49,6 +49,9 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
     if cfg.chained and cfg.backend != "jax_sim":
         raise ValueError("--chained requires --backend jax_sim "
                          "(serial-chained on-device measurement)")
+    if cfg.chained and cfg.profile_rounds:
+        raise ValueError("--chained and --profile-rounds are exclusive "
+                         "(one program vs per-round programs)")
     backend = get_backend(cfg.backend)
     pattern = AggregatorPattern(
         nprocs=cfg.nprocs, cb_nodes=cfg.cb_nodes,
@@ -73,7 +76,7 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             spec = METHODS[m]
             sched = compiled[m]
             kwargs = {}
-            if cfg.profile_rounds and backend.name == "jax_ici":
+            if cfg.profile_rounds and backend.name in ("jax_ici", "jax_sim"):
                 kwargs["profile_rounds"] = True
             if cfg.chained:
                 kwargs["chained"] = True
