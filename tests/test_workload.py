@@ -192,3 +192,28 @@ def test_cw2_jax_rejects_bad_topology():
     meta = aggregator_meta_information(na, wl.aggregators, 1, 0)
     with pytest.raises(ValueError):
         cw2_local_agg_jax(wl, na, meta, jax.devices())
+
+
+@pytest.mark.parametrize("stripe", list(StripeType))
+@pytest.mark.parametrize("kind,per_node", [(0, 4), (0, 2), (1, 4), (0, 8)])
+def test_cw_proxy_sim_matches_oracle(stripe, kind, per_node):
+    from tpu_aggcomm.tam.workload_engines import cw_proxy_sim
+    na, wl = _mk(nprocs=8, per_node=per_node, blocklen=5, stripe=stripe,
+                 kind=kind)
+    recv_sim, times = cw_proxy_sim(wl, na, ntimes=2)
+    wl.verify_all(recv_sim)
+    recv_o, _ = cw_proxy(wl, na)
+    for dst in recv_o:
+        for src in range(wl.nprocs):
+            np.testing.assert_array_equal(recv_sim[dst][src],
+                                          recv_o[dst][src])
+    assert len(times) == 2
+
+
+def test_cw_proxy_sim_uneven_last_node():
+    # nprocs not divisible by per_node: last node smaller
+    from tpu_aggcomm.tam.workload_engines import cw_proxy_sim
+    na = static_node_assignment(7, 3, 0)
+    wl = initialize_setting(na, 4, StripeType.GREATER)
+    recv, _ = cw_proxy_sim(wl, na)
+    wl.verify_all(recv)

@@ -332,7 +332,8 @@ class JaxSimBackend:
                 [Timer(total_time=per_rep) for _ in range(p.nprocs)]
                 for _ in range(ntimes)]
         elif profile_rounds:
-            out = self._run_profiled(schedule, send_dev, ntimes, timers)
+            out = self._run_profiled(schedule, send_dev, ntimes, timers,
+                                     profiled_segs)
         else:
             for _ in range(ntimes):
                 t0 = time.perf_counter()
@@ -382,7 +383,7 @@ class JaxSimBackend:
         self._cache[key] = segs
         return segs
 
-    def _run_profiled(self, schedule, send_dev, ntimes: int, timers):
+    def _run_profiled(self, schedule, send_dev, ntimes: int, timers, segs):
         """profile_rounds execution: one dispatch per throttle round, each
         synced and timed — schedule-shape analysis, not headline numbers
         (per-dispatch sync overhead is included, as on jax_ici). Per-round
@@ -390,7 +391,6 @@ class JaxSimBackend:
         recv_wait_all_time, mirroring the jax_ici convention."""
         p = schedule.pattern
         dev = self._dev()
-        segs = self._round_segments(schedule)
         _, n_recv_slots = self._slots(p)
         _, jdt, w = self._words(p)
 

@@ -99,11 +99,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "1 superset of global aggregators")
     tam.add_argument("--engine",
                      choices=("proxy", "local_agg", "shared", "benchmark",
-                              "jax", "native"),
+                              "jax", "sim", "native"),
                      default="proxy",
                      help="route: collective_write / _2 / _3 / _benchmark "
                           "oracles, the compiled two-level mesh program "
-                          "(jax), or the C++ threaded proxy engine (native)")
+                          "(jax), the compiled single-chip proxy route "
+                          "(sim — runs on one real TPU), or the C++ "
+                          "threaded proxy engine (native)")
 
     # sweep — the Theta job scripts (script_theta_*.sh:33-106)
     sw = sub.add_parser(
@@ -175,6 +177,12 @@ def _run_tam(args) -> int:
         wl.verify_all(recv)
         print(f"| engine = two-level mesh (compiled), reps = {len(times)}, "
               f"min rep = {min(times):.6f} s")
+    elif args.engine == "sim":
+        from tpu_aggcomm.tam.workload_engines import cw_proxy_sim
+        recv, times = cw_proxy_sim(wl, na, ntimes=args.ntimes)
+        wl.verify_all(recv)
+        print(f"| engine = single-chip proxy route (compiled), "
+              f"reps = {len(times)}, min rep = {min(times):.6f} s")
     elif args.engine == "native":
         from tpu_aggcomm.backends.native import run_workload_proxy
         recv, times = run_workload_proxy(wl, na, ntimes=args.ntimes)

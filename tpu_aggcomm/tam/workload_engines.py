@@ -43,7 +43,8 @@ from tpu_aggcomm.core.workload import Workload
 __all__ = [
     "RouteStats", "recv_index_map",
     "cw_benchmark", "cw_proxy", "cw2_local_agg", "cw3_shared",
-    "cw2_local_agg_jax", "WORKLOAD_ENGINES", "run_workload_engine",
+    "cw2_local_agg_jax", "cw_proxy_sim", "WORKLOAD_ENGINES",
+    "run_workload_engine",
 ]
 
 
@@ -375,6 +376,115 @@ def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
                            for src in range(n)]
     assert all(is_dst[g] for g in recv_by_rank)
     return recv_by_rank, rep_times
+
+
+# ---------------------------------------------------------------------------
+# collective_write on ONE chip: the proxy route as compiled byte-permutation
+# hops (variable sizes -> byte-granular index maps)
+
+def cw_proxy_sim(wl: Workload, na: NodeAssignment, *, ntimes: int = 1,
+                 device=None):
+    """The 5-phase proxy route compiled for a single device.
+
+    Message sizes vary per sender (1 + src % blocklen), so the static index
+    maps are *byte*-granular: the whole exchange is three permutations of
+    one flat byte array — P2 staging in proxy-hold order, P3 reorder into
+    destination-node runs, P4/P5 delivery into the recv layout — each hop a
+    fenced gather, mirroring cw_proxy's walk order exactly (the reference's
+    runtime size handshake, l_d_t.c:996-1041, is compile-time here). This is
+    the route the ``tam`` subcommand runs compiled on a real TPU chip.
+
+    Returns (recv dict like the oracle engines, per-rep wall seconds).
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = wl.nprocs
+    sizes = wl.msg_size
+    aggs = [int(a) for a in wl.aggregators]
+
+    # flat send stream: src-major, dst in aggregator order (pack layout)
+    msg_off: dict[tuple[int, int], int] = {}
+    off = 0
+    send_parts = []
+    for src in range(n):
+        for dst in aggs:
+            msg_off[(src, dst)] = off
+            off += int(sizes[src])
+            send_parts.append(wl.fill(src, dst))
+    total = off
+    send_flat = np.concatenate(send_parts) if send_parts else \
+        np.zeros(0, np.uint8)
+    assert send_flat.size == total
+
+    def byte_range(start: int, size: int) -> range:
+        return range(start, start + size)
+
+    # P2: proxy-hold order (cw_proxy holdings walk, l_d_t.c:1069-1105)
+    stage_perm: list[int] = []
+    stage_off: dict[tuple[int, int], int] = {}
+    stage_order: list[tuple[int, int]] = []
+    for node in range(na.nnodes):
+        for src in na.local_ranks(node):
+            for dst in aggs:
+                key = (int(src), dst)
+                stage_off[key] = len(stage_perm)
+                stage_order.append(key)
+                stage_perm.extend(byte_range(msg_off[key], int(sizes[src])))
+
+    # P3: destination-node runs in proxy-hold order (l_d_t.c:1121-1194)
+    exch_perm: list[int] = []
+    exch_off: dict[tuple[int, int], int] = {}
+    for node in range(na.nnodes):
+        for (src, dst) in stage_order:
+            if int(na.node_of[dst]) != node:
+                continue
+            exch_off[(src, dst)] = len(exch_perm)
+            exch_perm.extend(byte_range(stage_off[(src, dst)],
+                                        int(sizes[src])))
+
+    # P4/P5: recv layout — per aggregator (sorted), per source, its message
+    recv_perm: list[int] = []
+    for dst in aggs:
+        for src in range(n):
+            recv_perm.extend(byte_range(exch_off[(src, dst)],
+                                        int(sizes[src])))
+
+    p1 = jnp.asarray(np.asarray(stage_perm, dtype=np.int32))
+    p2 = jnp.asarray(np.asarray(exch_perm, dtype=np.int32))
+    p3 = jnp.asarray(np.asarray(recv_perm, dtype=np.int32))
+
+    @jax.jit
+    def route(x):
+        x = jnp.take(x, p1)                    # P2 gather at proxies
+        (x,) = lax.optimization_barrier((x,))
+        x = jnp.take(x, p2)                    # P3 proxy <-> proxy
+        (x,) = lax.optimization_barrier((x,))
+        return jnp.take(x, p3)                 # P4/P5 delivery
+
+    dev = device if device is not None else jax.devices()[0]
+    x0 = jax.device_put(jnp.asarray(send_flat), dev)
+    route(x0).block_until_ready()              # warm-up compile
+    times = []
+    out = None
+    for _ in range(max(ntimes, 1)):
+        t0 = time.perf_counter()
+        out = route(x0)
+        out.block_until_ready()
+        times.append(time.perf_counter() - t0)
+
+    flat = np.asarray(jax.device_get(out))
+    recv = _empty_recv(wl)
+    pos = 0
+    for dst in aggs:
+        for src in range(n):
+            sz = int(sizes[src])
+            recv[dst][src][:] = flat[pos:pos + sz]
+            pos += sz
+    return recv, times
 
 
 # ---------------------------------------------------------------------------
