@@ -1,0 +1,50 @@
+"""Differenced serial-chain timing scaffold."""
+
+import numpy as np
+import pytest
+
+from tpu_aggcomm.harness.chained import differenced_per_rep, differenced_trials
+
+
+def _factory():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def chain_factory(iters):
+        @jax.jit
+        def chain(x):
+            def body(c, r):
+                return c + r.astype(jnp.uint32), ()
+            out, _ = lax.scan(body, x, jnp.arange(iters, dtype=jnp.int32))
+            return out
+        return chain
+    return chain_factory
+
+
+def test_differenced_positive_and_finite():
+    import jax
+    x0 = jax.device_put(np.zeros((8, 8), np.uint32))
+    v = differenced_per_rep(_factory(), x0, iters_small=2, iters_big=500,
+                            trials=2, windows=2)
+    assert np.isfinite(v) and v > 0
+
+
+def test_differenced_rejects_bad_lengths():
+    import jax
+    x0 = jax.device_put(np.zeros((4, 4), np.uint32))
+    with pytest.raises(ValueError, match="exceed"):
+        differenced_trials(_factory(), x0, iters_small=5, iters_big=5)
+
+
+def test_differenced_raises_when_unstable(monkeypatch):
+    # force every diff non-positive by monkeypatching the clock to run
+    # backwards a fixed step per call
+    import tpu_aggcomm.harness.chained as ch
+    import jax
+    ticks = iter(range(10_000, 0, -1))
+    monkeypatch.setattr(ch.time, "perf_counter", lambda: next(ticks) * 1e-3)
+    x0 = jax.device_put(np.zeros((4, 4), np.uint32))
+    with pytest.raises(RuntimeError, match="unstable"):
+        differenced_trials(_factory(), x0, iters_small=2, iters_big=50,
+                           trials=2, windows=1)

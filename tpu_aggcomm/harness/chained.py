@@ -53,10 +53,20 @@ def differenced_trials(chain_factory, send0, *, iters_small: int,
     int(jax.device_get(checksum(f_small(send0))))    # compile + warm
     int(jax.device_get(checksum(f_big(send0))))
     per = []
-    for _ in range(trials):
+    retries = trials  # noise budget: a jittery link can invert one diff
+    while len(per) < trials:
         t_s = timed(f_small)
         t_b = timed(f_big)
-        per.append((t_b - t_s) / (iters_big - iters_small))
+        v = (t_b - t_s) / (iters_big - iters_small)
+        if v > 0:
+            per.append(v)
+        elif retries > 0:
+            retries -= 1   # non-positive diff = pure noise artifact; redo
+        else:
+            raise RuntimeError(
+                f"differenced timing unstable: T({iters_big})={t_b:.6f}s <= "
+                f"T({iters_small})={t_s:.6f}s repeatedly — increase "
+                f"iters_big or reduce link noise")
     return per
 
 
