@@ -24,8 +24,9 @@ def _factory():
 
 def test_differenced_positive_and_finite():
     import jax
-    x0 = jax.device_put(np.zeros((8, 8), np.uint32))
-    v = differenced_per_rep(_factory(), x0, iters_small=2, iters_big=500,
+    # a heavy enough chain that T(big) - T(small) is reliably positive
+    x0 = jax.device_put(np.zeros((256, 1024), np.uint32))
+    v = differenced_per_rep(_factory(), x0, iters_small=5, iters_big=2005,
                             trials=2, windows=2)
     assert np.isfinite(v) and v > 0
 

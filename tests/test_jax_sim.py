@@ -56,8 +56,8 @@ def test_sim_chained_measurement():
     p = AggregatorPattern(8, 3, data_size=16, comm_size=3)
     sched = compile_method(1, p)
     b = JaxSimBackend()
-    per_rep = b.measure_per_rep(sched, iters_small=2, iters_big=12,
-                                trials=1, windows=1)
+    per_rep = b.measure_per_rep(sched, iters_small=5, iters_big=505,
+                                trials=1, windows=2)
     assert np.isfinite(per_rep)
     # run(chained=True) synthesizes timers from the chained measurement
     recv, timers = b.run(sched, ntimes=2, verify=True, chained=True)

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_aggcomm.backends.lanes import lane_layout, lanes_to_bytes, to_lanes
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import OpKind, Schedule
 from tpu_aggcomm.harness.timer import Timer
@@ -208,11 +209,11 @@ class JaxIciBackend:
 
         send_g = self._global_send(p, iter_, n_send_slots)
         send_dev = jax.device_put(send_g, sharding)
+        ndt, _, w = lane_layout(p.data_size)
 
         def fresh_recv():
             return jax.device_put(
-                np.zeros((n, n_recv_slots + 1, p.data_size), dtype=np.uint8),
-                sharding)
+                np.zeros((n, n_recv_slots + 1, w), dtype=ndt), sharding)
 
         # warm-up: compile every segment outside the timed region
         warm = fresh_recv()
@@ -239,7 +240,8 @@ class JaxIciBackend:
             self.last_rep_timers.append(
                 [Timer(total_time=dt) for _ in range(n)])
 
-        recv_np = np.asarray(jax.device_get(recv_dev))[:, :n_recv_slots, :]
+        recv_w = np.asarray(jax.device_get(recv_dev))[:, :n_recv_slots, :]
+        recv_np = lanes_to_bytes(recv_w, p.data_size)
         recv_bufs = self._split_recv(p, recv_np)
         if verify:
             from tpu_aggcomm.harness.verify import verify_recv
@@ -254,7 +256,7 @@ class JaxIciBackend:
         for r, s in enumerate(slabs):
             if s is not None:
                 out[r, :s.shape[0]] = s
-        return out
+        return to_lanes(out, p.data_size)
 
     def _split_recv(self, p: AggregatorPattern, recv_np: np.ndarray):
         out = []
@@ -271,7 +273,8 @@ class JaxIciBackend:
                         low: _Lowered, split_rounds: bool):
         """One jitted shard_map program per segment; a segment covers the
         whole rep (default) or one throttle round (profile mode)."""
-        n, ds = p.nprocs, p.data_size
+        n = p.nprocs
+        _, jdt, w = lane_layout(p.data_size)
 
         seg_bounds: list[tuple[int, int]] = []
         if split_rounds and low.perms:
@@ -289,10 +292,10 @@ class JaxIciBackend:
 
         def make_segment(c0: int, c1: int):
             def local_fn(send, recv, sslot, rslot):
-                # send: (1, S, ds)  recv: (1, R+1, ds)  sslot/rslot: (1, C)
+                # send: (1, S, w)  recv: (1, R+1, w)  sslot/rslot: (1, C)
                 send = send[0]
                 recv = recv[0]
-                zero = jnp.zeros((ds,), dtype=jnp.uint8)
+                zero = jnp.zeros((w,), dtype=jdt)
 
                 def emit_barriers(recv, rnd):
                     # real barriers of this round (m=17 in-round, m=13/-b
@@ -304,7 +307,7 @@ class JaxIciBackend:
                     for _ in range(low.barrier_rounds.get(rnd, 0)):
                         tok = lax.psum(recv[0, 0].astype(jnp.int32), AXIS)
                         recv = recv.at[low.n_recv_slots, 0].set(
-                            (tok % 256).astype(jnp.uint8))
+                            tok.astype(jdt))
                     return recv
 
                 prev_round = None
@@ -348,7 +351,8 @@ class JaxIciBackend:
         matrix from its slabs; all_to_all exchanges row d of device s to
         row s of device d; receivers scatter rows into recv slots. The slot
         maps are direction-static (the sdispls/rdispls analog)."""
-        n, ds = p.nprocs, p.data_size
+        n = p.nprocs
+        ndt, _, _w = lane_layout(p.data_size)
         agg_index = np.asarray(p.agg_index)
         if p.direction is Direction.ALL_TO_MANY:
             n_recv_slots = n
@@ -359,14 +363,14 @@ class JaxIciBackend:
             sslot_of = np.arange(n)
             rslot_of = agg_index
         sslot_c = jnp.asarray(np.maximum(sslot_of, 0), dtype=jnp.int32)
-        smask = jnp.asarray((sslot_of >= 0).astype(np.uint8))[:, None]
+        smask = jnp.asarray((sslot_of >= 0).astype(ndt))[:, None]
         rslot_c = jnp.asarray(
             np.where(rslot_of >= 0, rslot_of, n_recv_slots), dtype=jnp.int32)
 
         def local_fn(send, recv):
-            send = send[0]          # (S, ds)
-            recv = recv[0]          # (R+1, ds)
-            rows = jnp.take(send, sslot_c, axis=0) * smask   # (n, ds) dst-major
+            send = send[0]          # (S, w)
+            recv = recv[0]          # (R+1, w)
+            rows = jnp.take(send, sslot_c, axis=0) * smask   # (n, w) dst-major
             got = lax.all_to_all(rows, AXIS, split_axis=0, concat_axis=0)
             recv = recv.at[rslot_c].set(got)
             return recv[None]

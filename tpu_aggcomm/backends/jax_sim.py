@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tpu_aggcomm.backends.lanes import (lane_layout, lanes_to_bytes,
+                                        to_lanes)
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import Schedule
 from tpu_aggcomm.harness.chained import differenced_per_rep
@@ -175,15 +177,8 @@ class JaxSimBackend:
 
     @staticmethod
     def _words(p: AggregatorPattern):
-        """On-device lane layout: byte payloads ride uint32 lanes when the
-        slab size allows (TPU handles u8 layouts 4-5x slower, and Mosaic
-        has no i8 ALU at all — see backends/pallas_local.py). Row-level
-        gathers/scatters are dtype-agnostic, so only the lane view changes;
-        the host-side byte semantics (fills, verification) are untouched.
-        Returns (numpy dtype, jnp dtype, words per slab)."""
-        if p.data_size % 4 == 0:
-            return np.uint32, jnp.uint32, p.data_size // 4
-        return np.uint8, jnp.uint8, p.data_size
+        """Lane layout for this pattern's slabs (backends/lanes.py)."""
+        return lane_layout(p.data_size)
 
     def _one_rep(self, schedule):
         """Build rep(send) -> recv, a pure jittable function."""
@@ -287,14 +282,11 @@ class JaxSimBackend:
         for r, s in enumerate(slabs):
             if s is not None:
                 out[r, :s.shape[0]] = s
-        ndt, _, w = self._words(p)
-        return out.view(ndt).reshape(p.nprocs, n_send_slots, w)
+        return to_lanes(out, p.data_size)
 
     def _to_bytes(self, p: AggregatorPattern, arr: np.ndarray) -> np.ndarray:
         """Device lane layout back to the byte layout the verifier speaks."""
-        arr = np.ascontiguousarray(arr)
-        return arr.view(np.uint8).reshape(arr.shape[0], arr.shape[1],
-                                          p.data_size)
+        return lanes_to_bytes(arr, p.data_size)
 
     def _split_recv(self, p: AggregatorPattern, recv_np: np.ndarray):
         counts = recv_slot_counts(p)

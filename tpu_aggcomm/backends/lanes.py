@@ -1,0 +1,37 @@
+"""Device lane layout for byte payloads.
+
+TPU handles uint8 array layouts 4-5x slower than uint32 views, and Mosaic
+has no i8 vector ALU (see backends/pallas_local.py), so every compiled
+backend carries slab payloads as uint32 lanes whenever the slab size is
+4-aligned. Row-level gathers/scatters/permutes are dtype-agnostic, so only
+the lane view changes; the host-side byte semantics (deterministic fills,
+verification) are untouched — conversion happens at the host boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["lane_layout", "to_lanes", "lanes_to_bytes"]
+
+
+def lane_layout(data_size: int):
+    """(numpy dtype, jnp dtype, words per slab) for a slab of data_size
+    bytes."""
+    import jax.numpy as jnp
+
+    if data_size % 4 == 0:
+        return np.uint32, jnp.uint32, data_size // 4
+    return np.uint8, jnp.uint8, data_size
+
+
+def to_lanes(arr: np.ndarray, data_size: int) -> np.ndarray:
+    """View a (..., data_size) uint8 array in the lane layout."""
+    ndt, _, w = lane_layout(data_size)
+    return np.ascontiguousarray(arr).view(ndt).reshape(*arr.shape[:-1], w)
+
+
+def lanes_to_bytes(arr: np.ndarray, data_size: int) -> np.ndarray:
+    """Inverse of :func:`to_lanes` for a (..., w) lane array."""
+    arr = np.ascontiguousarray(arr)
+    return arr.view(np.uint8).reshape(*arr.shape[:-1], data_size)

@@ -212,6 +212,9 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
     local_of_aggslot = np.where(
         aggs_of_node >= 0, agg_local[np.maximum(aggs_of_node, 0)], -1)
 
+    from tpu_aggcomm.backends.lanes import (lane_layout, lanes_to_bytes,
+                                            to_lanes)
+    _, jdt, w = lane_layout(ds)
     slabs = make_send_slabs(p, iter_)
     send_g = np.zeros(
         (n, (p.cb_nodes if p.direction is Direction.ALL_TO_MANY else n), ds),
@@ -219,7 +222,7 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
     for r, s in enumerate(slabs):
         if s is not None:
             send_g[r, :s.shape[0]] = s
-    send_g = send_g.reshape(N, L, -1, ds)
+    send_g = to_lanes(send_g, ds).reshape(N, L, -1, w)
 
     sharding = NamedSharding(mesh, P("node", "local"))
     send_dev = jax.device_put(send_g, sharding)
@@ -230,59 +233,59 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
     if p.direction is Direction.ALL_TO_MANY:
 
         def local_fn(send):
-            # send: (1, 1, cb, ds) — my slab for each global aggregator
+            # send: (1, 1, cb, w) — my slab for each global aggregator
             x = send[0, 0]
             # hop 1 (DCN/node axis): group my slabs by destination node:
             # row b = my slabs for node b's aggregators (K-padded)
             sel = jnp.maximum(aggs_of_node_j, 0)              # (N, K)
-            mask = (aggs_of_node_j >= 0).astype(jnp.uint8)[..., None]
-            bynode = jnp.take(x, sel.reshape(-1), axis=0).reshape(N, K, ds) * mask
-            got1 = lax.all_to_all(bynode, "node", 0, 0)        # (N, K, ds)
+            mask = (aggs_of_node_j >= 0).astype(jdt)[..., None]
+            bynode = jnp.take(x, sel.reshape(-1), axis=0).reshape(N, K, w) * mask
+            got1 = lax.all_to_all(bynode, "node", 0, 0)        # (N, K, w)
             # got1[a, j] = slab from source (a, my_local) for my node's agg j
             # hop 2 (ICI/local axis): deliver each agg column j to the local
             # coordinate that hosts that aggregator.
             dst_local = jnp.where(local_of_aggslot_j >= 0, local_of_aggslot_j, L)
             mynode = lax.axis_index("node")
             dl = jnp.take(dst_local, mynode, axis=0)           # (K,)
-            # build (L+1, N, ds) buffer: row l' = columns j with dl[j] == l'
+            # build (L+1, N, w) buffer: row l' = columns j with dl[j] == l'
             # K may exceed 1 per local only if two aggs share a local slot,
             # which cannot happen (distinct ranks -> distinct locals per node)
-            buf = jnp.zeros((L + 1, N, ds), jnp.uint8)
+            buf = jnp.zeros((L + 1, N, w), jdt)
             buf = buf.at[dl].set(jnp.transpose(got1, (1, 0, 2)))
             buf = buf[:L]
-            got2 = lax.all_to_all(buf, "local", 0, 0)          # (L, N, ds)
+            got2 = lax.all_to_all(buf, "local", 0, 0)          # (L, N, w)
             # got2[l', a] = slab from source rank a*L + l' (zeros if I'm not
             # an aggregator). recv[src] ordering: src = a*L + l'.
-            recv = jnp.transpose(got2, (1, 0, 2)).reshape(n, ds)
+            recv = jnp.transpose(got2, (1, 0, 2)).reshape(n, w)
             return recv[None, None]
 
         out_rows = n
     else:
 
         def local_fn(send):
-            # send: (1, 1, n, ds) — aggregator's slab for each dest rank
+            # send: (1, 1, n, w) — aggregator's slab for each dest rank
             x = send[0, 0]
             # hop 1 (ICI/local axis): split my slabs by destination local.
             # row l' = my slabs for ranks (a, l'), a in [0, N)
-            bylocal = x.reshape(N, L, ds).transpose(1, 0, 2)   # (L, N, ds)
-            got1 = lax.all_to_all(bylocal, "local", 0, 0)      # (L, N, ds)
+            bylocal = x.reshape(N, L, w).transpose(1, 0, 2)    # (L, N, w)
+            got1 = lax.all_to_all(bylocal, "local", 0, 0)      # (L, N, w)
             # got1[lg, a] = slab from (my_node, lg) for rank (a, my_local).
             # keep only rows where (my_node, lg) is an aggregator; tag by
             # its per-node agg slot j so hop 2 can address it statically.
             mynode = lax.axis_index("node")
             ls = jnp.take(local_of_aggslot_j, mynode, axis=0)  # (K,) locals
             sel = jnp.minimum(jnp.maximum(ls, 0), L - 1)
-            mask = (ls >= 0).astype(jnp.uint8)[..., None, None]
-            byslot = jnp.take(got1, sel, axis=0) * mask        # (K, N, ds)
+            mask = (ls >= 0).astype(jdt)[..., None, None]
+            byslot = jnp.take(got1, sel, axis=0) * mask        # (K, N, w)
             # hop 2 (DCN/node axis): send column a to node a
             got2 = lax.all_to_all(jnp.transpose(byslot, (1, 0, 2)),
-                                  "node", 0, 0)                # (N, K, ds)
+                                  "node", 0, 0)                # (N, K, w)
             # got2[b, j] = slab from node b's agg j for me -> recv slot =
             # global agg index aggs_of_node[b, j]
             flat_idx = jnp.where(aggs_of_node_j >= 0, aggs_of_node_j,
                                  p.cb_nodes).reshape(-1)       # (N*K,)
-            recv = jnp.zeros((p.cb_nodes + 1, ds), jnp.uint8)
-            recv = recv.at[flat_idx].set(got2.reshape(-1, ds))
+            recv = jnp.zeros((p.cb_nodes + 1, w), jdt)
+            recv = recv.at[flat_idx].set(got2.reshape(-1, w))
             return recv[:p.cb_nodes][None, None]
 
         out_rows = p.cb_nodes
@@ -300,7 +303,8 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
         out_dev = fn(send_dev)
         out_dev.block_until_ready()
         rep_times.append(_time.perf_counter() - t0)
-    out = np.asarray(jax.device_get(out_dev)).reshape(n, out_rows, ds)
+    out = lanes_to_bytes(
+        np.asarray(jax.device_get(out_dev)).reshape(n, out_rows, w), ds)
 
     recv_bufs = []
     for rank in range(n):
