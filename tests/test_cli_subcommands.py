@@ -140,3 +140,23 @@ def test_sweep_resume_rejects_unknown_method(tmp_path):
         run_cli(["sweep", "-n", "8", "-m", "99", "-a", "3", "-d", "32",
                  "--backend", "local", "--results-csv", str(csv),
                  "--comm-sizes", "2", "--resume"])
+
+
+def test_inspect_round_structured():
+    rc, out = run_cli(["inspect", "-m", "1", "-n", "32", "-a", "14",
+                       "-c", "3"])
+    assert rc == 0
+    assert "448 messages over 11 rounds" in out
+    assert "round   0:    42 msgs" in out
+
+
+def test_inspect_dense_and_tam_and_barriers():
+    rc, out = run_cli(["inspect", "-m", "8", "-n", "8", "-a", "3"])
+    assert "dense vendor collective" in out and "24 messages" in out
+    rc, out = run_cli(["inspect", "-m", "15", "-n", "8", "-a", "3",
+                       "-p", "2"])
+    assert "hierarchical engine over 4 nodes" in out
+    assert "inter_exchange" in out
+    rc, out = run_cli(["inspect", "-m", "17", "-n", "8", "-a", "3",
+                       "-c", "2"])
+    assert "1 barrier(s)" in out

@@ -133,6 +133,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="comma-separated throttle values (default: the "
                          "Theta grid 1,2,4,...,8192,999999999)")
 
+    # inspect — print a compiled schedule's round structure
+    ins = sub.add_parser(
+        "inspect", help="show how a method compiles for a pattern: rounds, "
+                        "edges and ppermute colors per round, bytes moved, "
+                        "barriers, rendezvous mode")
+    ins.add_argument("-n", "--nprocs", type=int, default=32)
+    ins.add_argument("-m", dest="method", type=int, required=True)
+    ins.add_argument("-a", dest="cb_nodes", type=int, default=1)
+    ins.add_argument("-d", dest="data_size", type=int, default=2048)
+    ins.add_argument("-c", dest="comm_size", type=int, default=200_000_000)
+    ins.add_argument("-p", dest="proc_node", type=int, default=1)
+    ins.add_argument("-t", dest="agg_type", type=int, default=1)
+    ins.add_argument("-b", dest="barrier_type", type=int, default=0)
+
     # analyze — summarize accumulated results.csv rows
     an = sub.add_parser(
         "analyze", help="summarize results.csv: per (method, config) the "
@@ -292,6 +306,59 @@ def _run_sweep(args) -> int:
     return 0
 
 
+def _run_inspect(args) -> int:
+    """Schedule-shape report: what the -c/-m/-t choices actually compile
+    to. This is the question the per-phase timers approximate at runtime,
+    answered statically."""
+    from tpu_aggcomm.core.methods import METHODS, compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+
+    p = AggregatorPattern(
+        nprocs=args.nprocs, cb_nodes=args.cb_nodes,
+        data_size=args.data_size, placement=args.agg_type,
+        proc_node=args.proc_node, comm_size=args.comm_size)
+    sched = compile_method(args.method, p, barrier_type=args.barrier_type)
+    spec = METHODS[args.method]
+    print(f"method {args.method} ({spec.name}), direction = "
+          f"{spec.direction.value}, nprocs = {args.nprocs}, "
+          f"cb_nodes = {args.cb_nodes}, comm_size = {args.comm_size}")
+
+    from tpu_aggcomm.tam.engine import TamMethod
+    if isinstance(sched, TamMethod):
+        from tpu_aggcomm.tam.engine import tam_phase_bytes
+        vols = tam_phase_bytes(sched.pattern, sched.assignment)
+        print(f"hierarchical engine over {sched.assignment.nnodes} nodes "
+              f"({args.proc_node} ranks/node); phase bytes:")
+        for k, v in vols.items():
+            print(f"  {k:16s} {v} B")
+        return 0
+
+    if sched.collective:
+        e = len(p.senders) * len(p.receivers)
+        print(f"dense vendor collective (alltoallw analog): "
+              f"{e} messages x {p.data_size} B in ONE call")
+        return 0
+
+    from tpu_aggcomm.backends.jax_ici import lower_schedule
+    low = lower_schedule(sched)
+    edges = sched.data_edges()
+    print(f"rendezvous sends: {sched.uses_rendezvous}; "
+          f"{len(edges)} messages over "
+          f"{int(edges[:, 4].max()) + 1 if len(edges) else 0} rounds, "
+          f"{low.n_colors} ppermute color steps")
+    n_rounds = int(edges[:, 4].max()) + 1 if len(edges) else 0
+    for r in range(n_rounds):
+        sel = edges[edges[:, 4] == r]
+        if len(sel) == 0:
+            continue
+        colors = sum(1 for c in low.round_of_color if c == r)
+        nbar = low.barrier_rounds.get(r, 0)
+        bar = f", {nbar} barrier(s)" if nbar else ""
+        print(f"  round {r:3d}: {len(sel):5d} msgs, {colors:3d} colors, "
+              f"{len(sel) * p.data_size:9d} B{bar}")
+    return 0
+
+
 def _run_analyze(args) -> int:
     """Winner table from accumulated sweep rows — the question the
     reference's whole harness exists to answer: which schedule / throttle
@@ -351,6 +418,8 @@ def main(argv=None) -> int:
         return _run_tam(args)
     if args.command == "sweep":
         return _run_sweep(args)
+    if args.command == "inspect":
+        return _run_inspect(args)
     if args.command == "analyze":
         return _run_analyze(args)
 
