@@ -1,0 +1,36 @@
+"""Lane layout round-trips (backends/lanes.py)."""
+
+import numpy as np
+import pytest
+
+from tpu_aggcomm.backends.lanes import lane_layout, lanes_to_bytes, to_lanes
+
+
+@pytest.mark.parametrize("ds", [4, 8, 2048])
+def test_aligned_uses_u32(ds):
+    ndt, _, w = lane_layout(ds)
+    assert ndt == np.uint32 and w == ds // 4
+
+
+@pytest.mark.parametrize("ds", [1, 2, 3, 5, 30])
+def test_unaligned_stays_u8(ds):
+    ndt, _, w = lane_layout(ds)
+    assert ndt == np.uint8 and w == ds
+
+
+@pytest.mark.parametrize("ds", [1, 3, 4, 12, 2048])
+def test_round_trip_is_identity(ds):
+    rng = np.random.default_rng(ds)
+    a = rng.integers(0, 256, size=(3, 5, ds), dtype=np.uint8)
+    lanes = to_lanes(a, ds)
+    back = lanes_to_bytes(lanes, ds)
+    np.testing.assert_array_equal(a, back)
+    _, _, w = lane_layout(ds)
+    assert lanes.shape == (3, 5, w)
+
+
+def test_to_lanes_handles_noncontiguous():
+    a = np.arange(2 * 4 * 16, dtype=np.uint8).reshape(2, 4, 16)
+    view = a[:, ::2, :]  # non-contiguous
+    lanes = to_lanes(view, 16)
+    np.testing.assert_array_equal(lanes_to_bytes(lanes, 16), view)
