@@ -102,3 +102,39 @@ def test_native_workload_proxy_degenerate_shapes():
         wl = initialize_setting(na, 10, StripeType.ALL)
         recv, _ = run_workload_proxy(wl, na)
         wl.verify_all(recv)
+
+
+@pytest.mark.parametrize("stripe", [0, 1, 2, 3])
+@pytest.mark.parametrize("co,mode", [(1, 0), (2, 0), (2, 1)])
+def test_native_cw2_matches_oracle(stripe, co, mode):
+    from tpu_aggcomm.backends.native import run_workload_cw2
+    from tpu_aggcomm.core.meta import aggregator_meta_information
+    from tpu_aggcomm.core.topology import static_node_assignment
+    from tpu_aggcomm.core.workload import StripeType, initialize_setting
+    from tpu_aggcomm.tam.workload_engines import cw2_local_agg
+
+    na = static_node_assignment(8, 4, 0)
+    wl = initialize_setting(na, 5, StripeType(stripe))
+    meta = aggregator_meta_information(na, wl.aggregators, co, mode)
+    recv_n, times = run_workload_cw2(wl, meta, ntimes=2)
+    wl.verify_all(recv_n)
+    recv_o, _ = cw2_local_agg(wl, na, meta)
+    for dst in recv_o:
+        for src in range(wl.nprocs):
+            np.testing.assert_array_equal(recv_n[dst][src],
+                                          recv_o[dst][src])
+    assert len(times) == 2
+
+
+def test_native_cw2_uneven_and_robin():
+    from tpu_aggcomm.backends.native import run_workload_cw2
+    from tpu_aggcomm.core.meta import aggregator_meta_information
+    from tpu_aggcomm.core.topology import static_node_assignment
+    from tpu_aggcomm.core.workload import StripeType, initialize_setting
+
+    for nprocs, pn, kind in [(7, 3, 0), (8, 2, 1), (9, 4, 0)]:
+        na = static_node_assignment(nprocs, pn, kind)
+        wl = initialize_setting(na, 4, StripeType.GREATER)
+        meta = aggregator_meta_information(na, wl.aggregators, 2, 1)
+        recv, _ = run_workload_cw2(wl, meta)
+        wl.verify_all(recv)

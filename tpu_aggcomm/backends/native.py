@@ -93,6 +93,18 @@ def _load():
             np.ctypeslib.ndpointer(np.uint8),     # recv_out
             np.ctypeslib.ndpointer(np.float64),   # rep_times_out
         ]
+        lib.agg_run_workload_cw2.restype = ctypes.c_int
+        lib.agg_run_workload_cw2.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int32),     # aggs
+            np.ctypeslib.ndpointer(np.int32),     # msg_sizes
+            np.ctypeslib.ndpointer(np.int32),     # owner_of
+            np.ctypeslib.ndpointer(np.int32),     # laggs
+            np.ctypeslib.ndpointer(np.uint8),     # send_msgs
+            np.ctypeslib.ndpointer(np.int64),     # send_block_ofs
+            np.ctypeslib.ndpointer(np.uint8),     # recv_out
+            np.ctypeslib.ndpointer(np.float64),   # rep_times_out
+        ]
         lib.agg_run_schedule.restype = ctypes.c_int
         lib.agg_run_schedule.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -136,6 +148,43 @@ def _flatten(schedule: Schedule):
             np.asarray(wait_tokens or [0], dtype=np.int32), max_token)
 
 
+def _pack_blocks(wl):
+    """Per-src send blocks (G messages in ascending-aggregator order) as
+    one flat byte arena + per-src offsets — the layout both native
+    workload engines consume."""
+    n = wl.nprocs
+    sizes = np.asarray(wl.msg_size, dtype=np.int32)
+    aggs = np.asarray(wl.aggregators, dtype=np.int32)
+    G = len(aggs)
+    block_bytes = (sizes.astype(np.int64)) * G
+    send_block_ofs = np.zeros(n, dtype=np.int64)
+    send_block_ofs[1:] = np.cumsum(block_bytes)[:-1]
+    send_msgs = np.zeros(max(int(block_bytes.sum()), 1), dtype=np.uint8)
+    for src in range(n):
+        o = int(send_block_ofs[src])
+        m = int(sizes[src])
+        for gi, g in enumerate(aggs):
+            send_msgs[o + gi * m:o + (gi + 1) * m] = wl.fill(src, int(g))
+    return sizes, aggs, send_msgs, send_block_ofs
+
+
+def _unpack_recv(wl, recv_out):
+    """Delivery slabs (per aggregator, sources ascending) back to the
+    oracle-shaped per-aggregator lists."""
+    n = wl.nprocs
+    sizes = np.asarray(wl.msg_size, dtype=np.int64)
+    slab = int(sizes.sum())
+    src_ofs = np.zeros(n, dtype=np.int64)
+    src_ofs[1:] = np.cumsum(sizes)[:-1]
+    recv_by_rank = {}
+    for gi, g in enumerate(wl.aggregators):
+        row = recv_out[gi * slab:(gi + 1) * slab]
+        recv_by_rank[int(g)] = [
+            row[int(src_ofs[s]):int(src_ofs[s]) + int(sizes[s])].copy()
+            for s in range(n)]
+    return recv_by_rank
+
+
 def run_workload_proxy(wl, na, ntimes: int = 1):
     """Run a variable-size workload through the native collective_write
     proxy engine (``agg_run_workload_proxy``): real threads, real pack /
@@ -147,27 +196,10 @@ def run_workload_proxy(wl, na, ntimes: int = 1):
     """
     lib = _load()
     n = wl.nprocs
-    sizes = np.asarray(wl.msg_size, dtype=np.int32)
-    aggs = np.asarray(wl.aggregators, dtype=np.int32)
+    sizes, aggs, send_msgs, send_block_ofs = _pack_blocks(wl)
     G = len(aggs)
-
-    # per-src blocks: G messages in ascending-aggregator order
-    block_bytes = (sizes.astype(np.int64)) * G
-    send_block_ofs = np.zeros(n, dtype=np.int64)
-    send_block_ofs[1:] = np.cumsum(block_bytes)[:-1]
-    send_msgs = np.zeros(max(int(block_bytes.sum()), 1), dtype=np.uint8)
-    for src in range(n):
-        o = int(send_block_ofs[src])
-        m = int(sizes[src])
-        for gi, g in enumerate(aggs):
-            send_msgs[o + gi * m:o + (gi + 1) * m] = wl.fill(src, int(g))
-
-    # delivery slabs: per aggregator, sources in global ascending order
     slab = int(sizes.sum())
     recv_out = np.zeros(max(G * slab, 1), dtype=np.uint8)
-    src_ofs = np.zeros(n, dtype=np.int64)
-    src_ofs[1:] = np.cumsum(sizes.astype(np.int64))[:-1]
-
     rep_times = np.zeros((n, max(ntimes, 1)), dtype=np.float64)
     rc = lib.agg_run_workload_proxy(
         n, na.nnodes, G, max(ntimes, 1),
@@ -176,14 +208,34 @@ def run_workload_proxy(wl, na, ntimes: int = 1):
         aggs, sizes, send_msgs, send_block_ofs, recv_out, rep_times)
     if rc != 0:
         raise RuntimeError(f"native workload engine failed with rc={rc}")
+    return _unpack_recv(wl, recv_out), rep_times.max(axis=0).tolist()
 
-    recv_by_rank = {}
-    for gi, g in enumerate(aggs):
-        row = recv_out[gi * slab:(gi + 1) * slab]
-        recv_by_rank[int(g)] = [
-            row[int(src_ofs[s]):int(src_ofs[s]) + int(sizes[s])].copy()
-            for s in range(n)]
-    return recv_by_rank, rep_times.max(axis=0).tolist()
+
+def run_workload_cw2(wl, meta, ntimes: int = 1):
+    """Run a variable-size workload through the native collective_write2
+    two-level engine (``agg_run_workload_cw2``): members pack-send to
+    their local aggregator, local aggregators exchange per-destination
+    segments with the global aggregators (l_d_t.c:754-926).
+
+    ``meta`` is the two-level structure from aggregator_meta_information.
+    Return shape matches :func:`run_workload_proxy`.
+    """
+    lib = _load()
+    n = wl.nprocs
+    sizes, aggs, send_msgs, send_block_ofs = _pack_blocks(wl)
+    G = len(aggs)
+    slab = int(sizes.sum())
+    recv_out = np.zeros(max(G * slab, 1), dtype=np.uint8)
+    laggs = np.asarray(meta.local_aggregators, dtype=np.int32)
+    rep_times = np.zeros((n, max(ntimes, 1)), dtype=np.float64)
+    rc = lib.agg_run_workload_cw2(
+        n, G, len(laggs), max(ntimes, 1),
+        aggs, sizes, np.asarray(meta.owner_of, dtype=np.int32),
+        laggs, send_msgs, send_block_ofs, recv_out, rep_times)
+    if rc != 0:
+        raise RuntimeError(f"native cw2 engine failed with rc={rc} "
+                           f"(is every rank bound to a local aggregator?)")
+    return _unpack_recv(wl, recv_out), rep_times.max(axis=0).tolist()
 
 
 class NativeBackend:

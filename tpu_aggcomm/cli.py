@@ -99,13 +99,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "1 superset of global aggregators")
     tam.add_argument("--engine",
                      choices=("proxy", "local_agg", "shared", "benchmark",
-                              "jax", "sim", "native"),
+                              "jax", "sim", "native", "native2"),
                      default="proxy",
                      help="route: collective_write / _2 / _3 / _benchmark "
                           "oracles, the compiled two-level mesh program "
                           "(jax), the compiled single-chip proxy route "
                           "(sim — runs on one real TPU), or the C++ "
-                          "threaded proxy engine (native)")
+                          "threaded engines (native = proxy route, "
+                          "native2 = two-level local-aggregator route)")
 
     # sweep — the Theta job scripts (script_theta_*.sh:33-106)
     sw = sub.add_parser(
@@ -203,6 +204,12 @@ def _run_tam(args) -> int:
         wl.verify_all(recv)
         print(f"| engine = native proxy (C++ threads), reps = {len(times)}, "
               f"min rep = {min(times):.6f} s")
+    elif args.engine == "native2":
+        from tpu_aggcomm.backends.native import run_workload_cw2
+        recv, times = run_workload_cw2(wl, meta, ntimes=args.ntimes)
+        wl.verify_all(recv)
+        print(f"| engine = native two-level (C++ threads), "
+              f"reps = {len(times)}, min rep = {min(times):.6f} s")
     else:
         times = []
         stats = None

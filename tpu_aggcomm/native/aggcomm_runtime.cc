@@ -538,7 +538,200 @@ void wl_run_rank(WlShared* sh, int rank, int ntimes, double* rep_times) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// collective_write2 (l_d_t.c:754-926): two-level local-aggregator route.
+//
+// Layouts:
+//   send block of src:  G messages in ascending-aggregator order
+//   group j (laggs[j]): members = ranks with owner_of[r] == laggs[j],
+//                       ascending (the local aggregator owns itself)
+//   group staging:      members' blocks back-to-back, member-ascending
+//   segment (j -> gi):  for member src ascending, the (src -> gi) message
+//                       (the inclusive-prefix-sum pack of l_d_t.c:881-904)
+//   delivery slab gi:   for src in GLOBAL ascending order, its message
+//                       (the hindexed recv view, create_recv_type 1332-1361,
+//                       realized as an explicit scatter after the receive)
+
+struct Cw2Shared {
+  Runtime* rt;
+  int n, G, nl;
+  const int32_t* aggs;
+  const int32_t* msg_sizes;
+  const int32_t* owner_of;
+  const int32_t* laggs;
+  const uint8_t* send_msgs;
+  const int64_t* send_block_ofs;
+  uint8_t* recv_out;
+  std::vector<std::vector<int>> members;        // per group, ascending
+  std::vector<int> group_of_rank;               // rank -> group or -1
+  std::vector<int> agg_of_rank;                 // rank -> gi or -1
+  std::vector<int64_t> block_bytes;             // per src
+  std::vector<int64_t> seg_total;               // per group
+  std::vector<int64_t> recv_src_ofs;            // per src
+  int64_t slab_bytes = 0;
+  std::vector<std::vector<uint8_t>> stage;      // per group
+  std::vector<std::vector<int64_t>> stage_ofs;  // per group: member offsets
+  std::vector<std::vector<uint8_t>> seg_out;    // per group: G segments
+  std::vector<std::vector<uint8_t>> seg_in;     // per gi: staging
+};
+
+void cw2_run_rank(Cw2Shared* sh, int rank, int ntimes, double* rep_times) {
+  Runtime& rt = *sh->rt;
+  const int j_self = sh->group_of_rank[rank];
+  const int gi_self = sh->agg_of_rank[rank];
+  const int owner = sh->owner_of[rank];
+  for (int rep = 0; rep < ntimes; ++rep) {
+    double t0 = now_s();
+    // hop 1: member -> its local aggregator (packed send, l_d_t.c:848-856)
+    if (owner != rank && sh->block_bytes[rank] > 0) {
+      wl_post_send(rt, rank, owner,
+                   sh->send_msgs + sh->send_block_ofs[rank],
+                   sh->block_bytes[rank]);
+    }
+    if (j_self >= 0) {
+      auto& st = sh->stage[j_self];
+      for (size_t i = 0; i < sh->members[j_self].size(); ++i) {
+        int m = sh->members[j_self][i];
+        uint8_t* dstp = st.data() + sh->stage_ofs[j_self][i];
+        if (m == rank) {
+          std::memcpy(dstp, sh->send_msgs + sh->send_block_ofs[m],
+                      sh->block_bytes[m]);
+        } else if (sh->block_bytes[m] > 0) {
+          wl_recv(rt, m, rank, dstp);
+        }
+      }
+      // hop 2: one packed segment per global destination
+      auto& so = sh->seg_out[j_self];
+      const int64_t segsz = sh->seg_total[j_self];
+      for (int gi = 0; gi < sh->G; ++gi) {
+        uint8_t* seg = so.data() + (int64_t)gi * segsz;
+        int64_t cur = 0;
+        for (size_t i = 0; i < sh->members[j_self].size(); ++i) {
+          int src = sh->members[j_self][i];
+          const uint8_t* blk = st.data() + sh->stage_ofs[j_self][i];
+          std::memcpy(seg + cur, blk + (int64_t)gi * sh->msg_sizes[src],
+                      sh->msg_sizes[src]);
+          cur += sh->msg_sizes[src];
+        }
+        int dst = sh->aggs[gi];
+        if (dst == rank) {
+          // self segment: direct scatter (the memcpy arm)
+          uint8_t* slab = sh->recv_out + (int64_t)gi * sh->slab_bytes;
+          int64_t o = 0;
+          for (int src : sh->members[j_self]) {
+            std::memcpy(slab + sh->recv_src_ofs[src], seg + o,
+                        sh->msg_sizes[src]);
+            o += sh->msg_sizes[src];
+          }
+        } else if (segsz > 0) {
+          wl_post_send(rt, rank, dst, seg, segsz);
+        }
+      }
+    }
+    // destination: one segment per group, scattered via the recv index map
+    if (gi_self >= 0) {
+      uint8_t* slab = sh->recv_out + (int64_t)gi_self * sh->slab_bytes;
+      auto& in = sh->seg_in[gi_self];
+      for (int j = 0; j < sh->nl; ++j) {
+        if (sh->laggs[j] == rank) continue;  // own group handled above
+        if (sh->seg_total[j] <= 0) continue;
+        wl_recv(rt, sh->laggs[j], rank, in.data());
+        int64_t o = 0;
+        for (int src : sh->members[j]) {
+          std::memcpy(slab + sh->recv_src_ofs[src], in.data() + o,
+                      sh->msg_sizes[src]);
+          o += sh->msg_sizes[src];
+        }
+      }
+    }
+    {
+      std::unique_lock<std::mutex> lk(rt.mu);
+      rt.gen_barrier(lk, rt.barrier_waiting, rt.barrier_gen);
+    }
+    rep_times[rep] = now_s() - t0;
+  }
+}
+
 }  // namespace
+
+extern "C" {
+
+// Execute the collective_write2 two-level route natively. laggs is the
+// group order (meta.local_aggregators); owner_of binds each rank to its
+// local aggregator. Other layouts match agg_run_workload_proxy.
+int agg_run_workload_cw2(int nprocs, int n_aggs, int n_laggs, int ntimes,
+                         const int32_t* aggs, const int32_t* msg_sizes,
+                         const int32_t* owner_of, const int32_t* laggs,
+                         const uint8_t* send_msgs,
+                         const int64_t* send_block_ofs,
+                         uint8_t* recv_out, double* rep_times_out) {
+  Cw2Shared sh;
+  Runtime rt(nprocs);
+  sh.rt = &rt;
+  sh.n = nprocs;
+  sh.G = n_aggs;
+  sh.nl = n_laggs;
+  sh.aggs = aggs;
+  sh.msg_sizes = msg_sizes;
+  sh.owner_of = owner_of;
+  sh.laggs = laggs;
+  sh.send_msgs = send_msgs;
+  sh.send_block_ofs = send_block_ofs;
+  sh.recv_out = recv_out;
+
+  sh.group_of_rank.assign(nprocs, -1);
+  for (int j = 0; j < n_laggs; ++j) sh.group_of_rank[laggs[j]] = j;
+  sh.agg_of_rank.assign(nprocs, -1);
+  for (int gi = 0; gi < n_aggs; ++gi) sh.agg_of_rank[aggs[gi]] = gi;
+  sh.members.resize(n_laggs);
+  for (int r = 0; r < nprocs; ++r) {
+    if (owner_of[r] < 0 || owner_of[r] >= nprocs) return 1;  // unbound rank
+    int j = sh.group_of_rank[owner_of[r]];
+    if (j < 0) return 1;  // binding points at a non-local-aggregator
+    sh.members[j].push_back(r);
+  }
+  sh.block_bytes.resize(nprocs);
+  for (int r = 0; r < nprocs; ++r)
+    sh.block_bytes[r] = (int64_t)n_aggs * msg_sizes[r];
+  sh.recv_src_ofs.assign(nprocs, 0);
+  int64_t cur = 0;
+  for (int src = 0; src < nprocs; ++src) {
+    sh.recv_src_ofs[src] = cur;
+    cur += msg_sizes[src];
+  }
+  sh.slab_bytes = cur;
+  sh.stage.resize(n_laggs);
+  sh.stage_ofs.resize(n_laggs);
+  sh.seg_total.assign(n_laggs, 0);
+  sh.seg_out.resize(n_laggs);
+  for (int j = 0; j < n_laggs; ++j) {
+    int64_t o = 0;
+    for (int m : sh.members[j]) {
+      sh.stage_ofs[j].push_back(o);
+      o += sh.block_bytes[m];
+      sh.seg_total[j] += msg_sizes[m];
+    }
+    sh.stage[j].resize(std::max<int64_t>(o, 1));
+    sh.seg_out[j].resize(
+        std::max<int64_t>((int64_t)n_aggs * sh.seg_total[j], 1));
+  }
+  sh.seg_in.resize(n_aggs);
+  int64_t max_seg = 1;
+  for (int j = 0; j < n_laggs; ++j)
+    max_seg = std::max(max_seg, sh.seg_total[j]);
+  for (int gi = 0; gi < n_aggs; ++gi) sh.seg_in[gi].resize(max_seg);
+
+  std::vector<std::thread> threads;
+  threads.reserve(nprocs);
+  for (int r = 0; r < nprocs; ++r) {
+    threads.emplace_back(cw2_run_rank, &sh, r, ntimes,
+                         rep_times_out + (size_t)r * ntimes);
+  }
+  for (auto& th : threads) th.join();
+  return 0;
+}
+
+}  // extern "C"
 
 extern "C" {
 
