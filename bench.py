@@ -36,10 +36,22 @@ VMEM-resident — the single-chip analog of the reference's cache-resident
 
 ``vs_baseline`` = baseline_time / our_time (higher is better; >1 beats the
 reference).
+
+Robustness (VERDICT r1 item 1a): the tunneled TPU can hang *forever* at
+``jax.devices()`` or fail with UNAVAILABLE when the tunnel is down, so the
+parent process NEVER imports jax. All jax work happens in child processes
+with hard timeouts: a cheap device probe (retried), then the measurement.
+If the TPU is unreachable the measurement falls back to a scrubbed-env CPU
+child so a real number is still produced (annotated with ``platform`` and
+``tpu_error``). Whatever happens, stdout carries exactly one JSON line —
+on total failure it is ``{"metric": ..., "error": ...}`` — never a bare
+traceback, never a hang.
 """
 
 import json
+import os
 import statistics
+import subprocess
 import sys
 
 import numpy as np
@@ -47,11 +59,49 @@ import numpy as np
 BASELINE_S = 0.029803   # reference README.md:64, all-to-many max total time
 PROCS, CB_NODES, DATA_SIZE = 32, 14, 2048
 ITERS_SMALL, ITERS_BIG = 2000, 102000
+ITERS_BIG_CPU = 22000   # CPU reps are ~10x slower; keep the child bounded
 TRIALS = 5
 VERIFY_ITERS = 9
 
+PROBE_TIMEOUT_S = 120
+PROBE_RETRIES = 2
+MEASURE_TIMEOUT_S = 720
+CPU_TIMEOUT_S = 600
+RC_CORRECTNESS = 3   # child exit code: the exchange produced wrong bytes
+METRIC = (f"all_to_many max total time per rep "
+          f"(n={PROCS} a={CB_NODES} d={DATA_SIZE})")
 
-def main() -> int:
+
+class CorrectnessError(Exception):
+    """The exchange produced wrong bytes (immune to ``python -O``)."""
+
+
+def _check(ok: bool, msg: str) -> None:
+    if not ok:
+        raise CorrectnessError(msg)
+
+
+def measure() -> int:
+    """Child mode: run the measurement on whatever platform jax gives us.
+
+    Exits ``RC_CORRECTNESS`` (with a JSON error line on stdout) when a
+    correctness check fails, so the supervisor can tell a real Pallas/XLA
+    bug apart from tunnel trouble — a correctness failure must surface,
+    never be papered over by the CPU fallback.
+    """
+    try:
+        return _measure_inner()
+    except CorrectnessError as e:
+        print(json.dumps({
+            "metric": METRIC,
+            "value": None,
+            "unit": "s",
+            "error": f"correctness: {e}",
+        }))
+        return RC_CORRECTNESS
+
+
+def _measure_inner() -> int:
     import jax
 
     from tpu_aggcomm.backends.pallas_local import (fused_exchange_chain,
@@ -82,40 +132,143 @@ def main() -> int:
     recv1 = s1.reshape(CB_NODES, PROCS, W)
     agg_index = np.asarray(p.agg_index)
     for j, a in enumerate(sorted(int(x) for x in p.rank_list)):
-        assert np.array_equal(recv1[j], send_np[:, agg_index[a]]), \
-            f"aggregator row {j} (rank {a}) has wrong slabs"
+        _check(np.array_equal(recv1[j], send_np[:, agg_index[a]]),
+               f"aggregator row {j} (rank {a}) has wrong slabs")
 
     # correctness 2: exact replay of the whole chain on host
     from tpu_aggcomm.backends.pallas_local import host_replay
     ref = host_replay(p, send_np, VERIFY_ITERS)
     got = np.asarray(jax.device_get(make_chain(VERIFY_ITERS)(send0)))
-    assert np.array_equal(got, ref), "chained exchange produced wrong slabs"
+    _check(np.array_equal(got, ref), "chained exchange produced wrong slabs")
 
     # correctness 3 (TPU): Pallas kernel vs the independent XLA program
     if on_tpu:
         got_xla = np.asarray(jax.device_get(
             xla_exchange_chain(p, VERIFY_ITERS)(send0)))
-        assert np.array_equal(got, got_xla), "pallas chain != xla chain"
+        _check(np.array_equal(got, got_xla), "pallas chain != xla chain")
 
+    iters_big = ITERS_BIG if on_tpu else ITERS_BIG_CPU
     per_reps = differenced_trials(make_chain, send0,
                                   iters_small=ITERS_SMALL,
-                                  iters_big=ITERS_BIG,
+                                  iters_big=iters_big,
                                   trials=TRIALS, windows=3)
     per_rep = statistics.median(per_reps)
 
     gbps = PROCS * CB_NODES * DATA_SIZE / per_rep / 1e9
     print(json.dumps({
-        "metric": f"all_to_many max total time per rep (n={PROCS} "
-                  f"a={CB_NODES} d={DATA_SIZE}, {dev.platform})",
+        "metric": METRIC,
         "value": per_rep,
         "unit": "s",
         "vs_baseline": BASELINE_S / per_rep,
+        "platform": dev.platform,
     }))
     print(f"# effective bandwidth: {gbps:.2f} GB/s pattern-bytes "
           f"on {dev.device_kind}; path={'pallas' if on_tpu else 'xla'}; "
           f"trials(us/rep)={[round(t * 1e6, 3) for t in per_reps]}",
           file=sys.stderr)
     return 0
+
+
+def probe() -> int:
+    """Child mode: list devices and print the platform — nothing else."""
+    import jax
+    print(jax.devices()[0].platform)
+    return 0
+
+
+def _run_child(mode: str, timeout_s: float, env=None):
+    """Run ``bench.py <mode>`` bounded; return (rc, stdout, note)."""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), mode],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        sys.stderr.write(r.stderr[-2000:])
+        return r.returncode, r.stdout, ""
+    except subprocess.TimeoutExpired as e:
+        err = (e.stderr or b"")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        sys.stderr.write(err[-2000:])
+        return -1, "", f"timeout after {timeout_s:.0f}s"
+
+
+def supervise() -> int:
+    """Parent mode: jax-free orchestration with hard timeouts everywhere."""
+    from tpu_aggcomm.harness.hostenv import scrubbed_cpu_env
+
+    # A deliberate CPU run (CLAUDE.md recipe pins JAX_PLATFORMS=cpu and
+    # disarms the pool var) goes straight to the CPU measurement — no
+    # probe, no tpu_error annotation.
+    if (os.environ.get("JAX_PLATFORMS") == "cpu"
+            and not os.environ.get("PALLAS_AXON_POOL_IPS")):
+        rc, out, note = _run_child("--measure", CPU_TIMEOUT_S)
+        if out.strip():
+            sys.stdout.write(out)
+            return 0 if rc == 0 else 1
+        print(json.dumps({
+            "metric": METRIC, "value": None, "unit": "s",
+            "error": f"cpu measurement: {note or f'rc={rc}'}",
+        }))
+        return 1
+
+    tpu_error = ""
+    tpu_ok = False
+    for attempt in range(PROBE_RETRIES):
+        rc, out, note = _run_child("--probe", PROBE_TIMEOUT_S)
+        if rc == 0 and out.strip():
+            print(f"# probe {attempt + 1}: platform={out.strip()}",
+                  file=sys.stderr)
+            tpu_ok = out.strip() == "tpu"
+            if not tpu_ok:
+                tpu_error = f"probe returned platform={out.strip()}"
+            break
+        tpu_error = note or f"probe exited rc={rc}"
+        print(f"# probe {attempt + 1}/{PROBE_RETRIES} failed: {tpu_error}",
+              file=sys.stderr)
+
+    if tpu_ok:
+        rc, out, note = _run_child("--measure", MEASURE_TIMEOUT_S)
+        if rc == 0 and out.strip():
+            sys.stdout.write(out)
+            return 0
+        if rc == RC_CORRECTNESS:
+            # a real bug on the TPU path — surface it, do NOT fall back
+            sys.stdout.write(out)
+            return 1
+        tpu_error = note or f"measure exited rc={rc}"
+        print(f"# tpu measurement failed: {tpu_error}", file=sys.stderr)
+
+    # TPU unreachable or its measurement failed on infra — produce a real
+    # number on CPU, annotated so the outage stays visible
+    print(f"# falling back to cpu (tpu: {tpu_error})", file=sys.stderr)
+    rc, out, note = _run_child("--measure", CPU_TIMEOUT_S,
+                               env=scrubbed_cpu_env())
+    if rc == 0 and out.strip():
+        line = json.loads(out.strip().splitlines()[-1])
+        line["tpu_error"] = tpu_error
+        print(json.dumps(line))
+        return 0
+    if rc == RC_CORRECTNESS and out.strip():
+        sys.stdout.write(out)
+        return 1
+
+    print(json.dumps({
+        "metric": METRIC,
+        "value": None,
+        "unit": "s",
+        "error": f"tpu: {tpu_error}; cpu fallback: "
+                 f"{note or f'rc={rc}'}",
+    }))
+    return 1
+
+
+def main() -> int:
+    if "--measure" in sys.argv:
+        return measure()
+    if "--probe" in sys.argv:
+        return probe()
+    return supervise()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,40 @@
+"""Host-process environment scrubbing for CPU-pinned jax children.
+
+The execution environment arms a TPU tunnel through a sitecustomize that
+registers the axon PJRT platform at interpreter start whenever
+``PALLAS_AXON_POOL_IPS`` is set — ``JAX_PLATFORMS=cpu`` alone is ignored
+(CLAUDE.md gotcha), and a dead tunnel hangs ``jax.devices()`` forever.
+The one safe way to pin a child process to the CPU backend is to scrub
+every arming variable from its environment *before* Python starts. This
+module is stdlib-only so supervising parents can import it without
+touching jax or numpy.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["scrubbed_cpu_env"]
+
+_ARMING_PREFIXES = ("PALLAS_AXON", "AXON_", "TPU_")
+
+
+def scrubbed_cpu_env(n_devices: int | None = None) -> dict:
+    """A child environment pinned to the CPU backend.
+
+    Drops every tunnel-arming variable by prefix (the round-1 lesson:
+    popping just ``PALLAS_AXON_POOL_IPS`` is not enough to future-proof
+    against other arming vars), sets ``JAX_PLATFORMS=cpu``, and — when
+    ``n_devices`` is given — forces a virtual ``n_devices``-device host
+    mesh via ``XLA_FLAGS``; otherwise XLA_FLAGS is removed so a stale
+    device-count from the caller can't leak in.
+    """
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(_ARMING_PREFIXES)}
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is None:
+        env.pop("XLA_FLAGS", None)
+    else:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={n_devices}")
+    return env
