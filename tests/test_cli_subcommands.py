@@ -160,3 +160,44 @@ def test_inspect_dense_and_tam_and_barriers():
     rc, out = run_cli(["inspect", "-m", "17", "-n", "8", "-a", "3",
                        "-c", "2"])
     assert "1 barrier(s)" in out
+
+
+def test_sweep_resume_distinguishes_proc_node(tmp_path):
+    """ADVICE r1: rows from a sweep with a different -p (or backend) must
+    not satisfy --resume. The reference CSV cannot record proc_node, so
+    completion is tracked in the sweep sidecar."""
+    csv = tmp_path / "results.csv"
+    base = ["sweep", "-n", "8", "-m", "1", "-a", "3", "-d", "32", "-i", "1",
+            "--backend", "local", "--results-csv", str(csv)]
+    run_cli(base + ["--comm-sizes", "2", "-p", "1"])
+    assert (tmp_path / "results.csv.sweep.jsonl").exists()
+    # different -p: same CSV rows, but NOT complete for this config
+    rc, out = run_cli(base + ["--comm-sizes", "2", "-p", "2", "--resume"])
+    assert rc == 0 and "skipping" not in out
+    # identical -p: skipped
+    rc, out = run_cli(base + ["--comm-sizes", "2", "-p", "1", "--resume"])
+    assert "skipping already-recorded comm sizes [2]" in out
+
+
+def test_sweep_resume_pre_sidecar_fallback(tmp_path):
+    """CSV-only heuristic still works for sweeps recorded before the
+    sidecar existed — even when a DIFFERENT config has since written
+    sidecar lines into the same results.csv."""
+    import os
+    csv = tmp_path / "results.csv"
+    base = ["sweep", "-n", "8", "-m", "1", "-a", "3", "-d", "32", "-i", "1",
+            "--backend", "local", "--results-csv", str(csv)]
+    run_cli(base + ["--comm-sizes", "2"])
+    os.remove(str(csv) + ".sweep.jsonl")   # simulate a pre-sidecar sweep
+    rc, out = run_cli(base + ["--comm-sizes", "2,4", "--resume"])
+    assert rc == 0
+    assert "skipping already-recorded comm sizes [2]" in out
+    # another config (-a 2) writes the sidecar; config A's pre-sidecar
+    # completions must still be honored through the CSV fallback
+    os.remove(str(csv) + ".sweep.jsonl")
+    run_cli(["sweep", "-n", "8", "-m", "1", "-a", "2", "-d", "32", "-i", "1",
+             "--backend", "local", "--results-csv", str(csv),
+             "--comm-sizes", "2"])
+    rc, out = run_cli(base + ["--comm-sizes", "2,4", "--resume"])
+    assert rc == 0
+    assert "skipping already-recorded comm sizes [2, 4]" in out

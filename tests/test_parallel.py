@@ -71,3 +71,55 @@ def test_tam_engine_runs_on_hierarchical_order():
     devs = list(jax.devices())
     recv, _ = tam_two_level_jax(tam, devs, ntimes=1)
     verify_recv(p, recv, 0)
+
+
+class TestDistributedInitIdempotency:
+    """ADVICE r1 (medium): only a genuine double-init may be swallowed;
+    every other explicit-arg bring-up failure must propagate, even when
+    its message happens to contain the word 'initialize'."""
+
+    def _reset(self):
+        import tpu_aggcomm.parallel as par
+        par._distributed_up = False
+        return par
+
+    def test_explicit_failure_mentioning_initialize_propagates(
+            self, monkeypatch):
+        par = self._reset()
+        import jax
+
+        def boom(**kw):
+            raise RuntimeError(
+                "Unable to initialize backend: coordinator unreachable")
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        with pytest.raises(RuntimeError, match="coordinator unreachable"):
+            par.distributed_init("1.2.3.4:1234", 2, 0)
+        self._reset()
+
+    def test_already_initialized_is_swallowed_and_latched(self, monkeypatch):
+        par = self._reset()
+        import jax
+
+        calls = []
+
+        def dup(**kw):
+            calls.append(1)
+            # jax 0.9's real double-init message
+            raise RuntimeError(
+                "distributed.initialize should only be called once.")
+        monkeypatch.setattr(jax.distributed, "initialize", dup)
+        assert par.distributed_init("1.2.3.4:1234", 2, 0) is False
+        # latched: the second call never re-enters jax
+        assert par.distributed_init("1.2.3.4:1234", 2, 0) is False
+        assert len(calls) == 1
+        self._reset()
+
+    def test_argless_failure_is_single_process_fallback(self, monkeypatch):
+        par = self._reset()
+        import jax
+
+        def boom(**kw):
+            raise RuntimeError("cluster auto-detect failed to initialize")
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        assert par.distributed_init() is False
+        self._reset()

@@ -217,3 +217,19 @@ def test_cw_proxy_sim_uneven_last_node():
     wl = initialize_setting(na, 4, StripeType.GREATER)
     recv, _ = cw_proxy_sim(wl, na)
     wl.verify_all(recv)
+
+
+def test_cw_proxy_sim_chained_matches_oracle():
+    """ADVICE r1: the sim engine's chained differenced mode — delivery
+    stays byte-exact and every rep time is the differenced per-rep figure."""
+    from tpu_aggcomm.core.topology import static_node_assignment
+    from tpu_aggcomm.core.workload import StripeType, initialize_setting
+    from tpu_aggcomm.tam.workload_engines import cw_proxy_sim
+
+    na = static_node_assignment(8, 4, 0)
+    wl = initialize_setting(na, 5, StripeType.SAME)
+    recv, times = cw_proxy_sim(wl, na, ntimes=3, chained=True)
+    wl.verify_all(recv)
+    assert len(times) == 3
+    assert all(t > 0 for t in times)
+    assert times[0] == times[1] == times[2]

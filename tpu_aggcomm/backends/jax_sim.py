@@ -82,6 +82,16 @@ def _round_tables(schedule: Schedule):
         for op in schedule.programs[0]:  # SPMD-symmetric barrier structure
             if op.kind is OpKind.BARRIER:
                 barrier_rounds[op.round] = barrier_rounds.get(op.round, 0) + 1
+    # every METHODS generator attaches barriers to rounds that also move
+    # data; a barrier-only round would be silently dropped by the data-edge
+    # loop above and its fence lost — fail loudly instead (ADVICE r1)
+    kept = {r for r, *_ in rounds}
+    orphans = set(barrier_rounds) - kept
+    if orphans:
+        raise ValueError(
+            f"schedule {schedule.name!r} has barrier-only rounds "
+            f"{sorted(orphans)} with no data edges; the jax_sim round "
+            f"lowering cannot represent a standalone fence")
     return rounds, barrier_rounds
 
 

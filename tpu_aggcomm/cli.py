@@ -107,6 +107,9 @@ def build_parser() -> argparse.ArgumentParser:
                           "(sim — runs on one real TPU), or the C++ "
                           "threaded engines (native = proxy route, "
                           "native2 = two-level local-aggregator route)")
+    tam.add_argument("--chained", action="store_true",
+                     help="engine sim only: serial-chained differenced "
+                          "per-rep timing (honest through the TPU tunnel)")
 
     # sweep — the Theta job scripts (script_theta_*.sh:33-106)
     sw = sub.add_parser(
@@ -194,9 +197,11 @@ def _run_tam(args) -> int:
               f"min rep = {min(times):.6f} s")
     elif args.engine == "sim":
         from tpu_aggcomm.tam.workload_engines import cw_proxy_sim
-        recv, times = cw_proxy_sim(wl, na, ntimes=args.ntimes)
+        recv, times = cw_proxy_sim(wl, na, ntimes=args.ntimes,
+                                   chained=args.chained)
         wl.verify_all(recv)
-        print(f"| engine = single-chip proxy route (compiled), "
+        kind = "chained differenced" if args.chained else "per-dispatch"
+        print(f"| engine = single-chip proxy route (compiled, {kind}), "
               f"reps = {len(times)}, min rep = {min(times):.6f} s")
     elif args.engine == "native":
         from tpu_aggcomm.backends.native import run_workload_proxy
@@ -239,14 +244,38 @@ def _default_nprocs(backend: str) -> int:
     return len(jax.devices())
 
 
+def _sweep_sidecar(csv_path: str) -> str:
+    return csv_path + ".sweep.jsonl"
+
+
+def _sweep_key(nprocs, cb_nodes, data_size, method, iters, ntimes, agg_type,
+               proc_node, backend, chained) -> dict:
+    return {"nprocs": nprocs, "cb_nodes": cb_nodes, "data_size": data_size,
+            "method": method, "iters": iters, "ntimes": ntimes,
+            "agg_type": agg_type, "proc_node": proc_node,
+            "backend": backend, "chained": bool(chained)}
+
+
 def _completed_throttles(csv_path: str, nprocs: int, cb_nodes: int,
                          data_size: int, method: int, iters: int,
-                         ntimes: int, agg_type: int) -> set:
-    """Throttle values already fully recorded for this sweep config: every
-    required method name has >= iters rows at that comm size with the SAME
-    measurement parameters (ntimes, aggregator placement) — rows from a
-    differently-parameterized sweep must not satisfy this one."""
+                         ntimes: int, agg_type: int, proc_node: int = 1,
+                         backend: str = "local",
+                         chained: bool = False) -> set:
+    """Throttle values already fully recorded for this sweep config.
+
+    Primary source: the sweep sidecar (``<results_csv>.sweep.jsonl``, one
+    JSON line per completed throttle carrying the FULL run config —
+    including proc_node, backend and chained, which the reference CSV
+    format cannot record; ADVICE r1). When the sidecar exists, only its
+    exact-config matches count. Fallback for pre-sidecar CSVs: every
+    required method name has >= iters rows at that comm size matching the
+    parameters the reference CSV does carry (nprocs, cb_nodes, data_size,
+    ntimes, agg_type) — rows from a sweep differing only in proc_node,
+    backend, or chained are indistinguishable there, which is exactly why
+    the sidecar is written."""
     import csv
+    import json
+    import os
     from collections import Counter
 
     from tpu_aggcomm.core.methods import METHODS, method_ids
@@ -256,6 +285,39 @@ def _completed_throttles(csv_path: str, nprocs: int, cb_nodes: int,
     if unknown:
         raise SystemExit(f"unknown method id {unknown[0]}; valid ids: "
                          f"{sorted(METHODS)}")
+
+    sidecar = _sweep_sidecar(csv_path)
+    if os.path.exists(sidecar):
+        key = _sweep_key(nprocs, cb_nodes, data_size, method, iters, ntimes,
+                         agg_type, proc_node, backend, chained)
+        family = (nprocs, cb_nodes, data_size, ntimes, agg_type)
+        family_seen = False
+        done = set()
+        with open(sidecar) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                comm = rec.pop("comm", None)
+                if comm is None:
+                    continue
+                try:
+                    rec_family = (rec["nprocs"], rec["cb_nodes"],
+                                  rec["data_size"], rec["ntimes"],
+                                  rec["agg_type"])
+                except KeyError:
+                    continue
+                family_seen = family_seen or rec_family == family
+                if rec == key:
+                    done.add(int(comm))
+        # the sidecar is authoritative only for configs it has seen: a
+        # sweep recorded before the sidecar existed lives only in the CSV,
+        # and another config's sidecar lines must not erase it — fall
+        # through to the CSV heuristic in that case
+        if family_seen:
+            return done
+
     names = {METHODS[m].name for m in ids}
     try:
         with open(csv_path, newline="") as f:
@@ -295,11 +357,14 @@ def _run_sweep(args) -> int:
     if args.resume:
         done = _completed_throttles(args.results_csv, nprocs, args.cb_nodes,
                                     args.data_size, args.method, args.iters,
-                                    args.ntimes, args.agg_type)
+                                    args.ntimes, args.agg_type,
+                                    args.proc_node, args.backend,
+                                    args.chained)
         skipped = [c for c in grid if c in done]
         grid = [c for c in grid if c not in done]
         if skipped:
             print(f"resume: skipping already-recorded comm sizes {skipped}")
+    import json
     for c in grid:
         print(f"RUN_OPTS: -a {args.cb_nodes} -d {args.data_size} -c {c} "
               f"-m {args.method} -i {args.iters}")
@@ -310,6 +375,15 @@ def _run_sweep(args) -> int:
             agg_type=args.agg_type, backend=args.backend, verify=args.verify,
             results_csv=args.results_csv, chained=args.chained)
         run_experiment(cfg)
+        if args.results_csv:
+            # checkpoint: record the completed throttle with its FULL config
+            rec = _sweep_key(nprocs, args.cb_nodes, args.data_size,
+                             args.method, args.iters, args.ntimes,
+                             args.agg_type, args.proc_node, args.backend,
+                             args.chained)
+            rec["comm"] = c
+            with open(_sweep_sidecar(args.results_csv), "a") as f:
+                f.write(json.dumps(rec) + "\n")
     return 0
 
 

@@ -31,6 +31,9 @@ __all__ = ["distributed_init", "host_major_devices", "hierarchical_mesh",
            "warn_if_node_straddles_hosts"]
 
 
+_distributed_up = False
+
+
 def distributed_init(coordinator_address: str | None = None,
                      num_processes: int | None = None,
                      process_id: int | None = None) -> bool:
@@ -41,22 +44,38 @@ def distributed_init(coordinator_address: str | None = None,
     False if it was already initialized or (argless) single-process. A
     bring-up failure with explicit arguments PROPAGATES — swallowing it
     would leave every host silently running a disjoint single-host job.
+
+    Double-init is recognized by a module-level flag plus the precise
+    "already initialized" message — NOT by loose substring matching:
+    nearly every bring-up failure from ``jax.distributed.initialize``
+    mentions "initialize" somewhere, and treating those as benign is
+    exactly the silent-disjoint-job failure this wrapper exists to
+    prevent (ADVICE r1, medium).
     """
+    global _distributed_up
     import jax
 
+    if _distributed_up:
+        return False
     explicit = any(v is not None for v in (coordinator_address,
                                            num_processes, process_id))
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
+        _distributed_up = True
         return True
     except RuntimeError as e:
-        if "already" in str(e).lower() or "initialize" in str(e).lower():
-            return False   # double-init: harmless, keep idempotent
+        msg = str(e).lower()
+        # jax's actual double-init messages: "distributed.initialize should
+        # only be called once" (jax 0.9); older builds said "already
+        # initialized". Nothing else is treated as benign.
+        if "only be called once" in msg or "already initialized" in msg:
+            _distributed_up = True
+            return False   # double-init (e.g. by the launcher): harmless
         if explicit:
-            raise
-        return False
+            raise          # real bring-up failure: never swallow
+        return False       # argless on a non-cluster: single-process
     except ValueError:
         if explicit:
             raise          # mistyped coordinator/process args: fail fast

@@ -383,7 +383,7 @@ def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
 # hops (variable sizes -> byte-granular index maps)
 
 def cw_proxy_sim(wl: Workload, na: NodeAssignment, *, ntimes: int = 1,
-                 device=None):
+                 device=None, chained: bool = False):
     """The 5-phase proxy route compiled for a single device.
 
     Message sizes vary per sender (1 + src % blocklen), so the static index
@@ -393,6 +393,12 @@ def cw_proxy_sim(wl: Workload, na: NodeAssignment, *, ntimes: int = 1,
     fenced gather, mirroring cw_proxy's walk order exactly (the reference's
     runtime size handshake, l_d_t.c:996-1041, is compile-time here). This is
     the route the ``tam`` subcommand runs compiled on a real TPU chip.
+
+    ``chained=True`` replaces the per-dispatch wall times with the
+    serial-chained differenced measurement (harness/chained.py): through
+    the TPU tunnel a single dispatch measures the ~60-90 ms RPC, not the
+    route (ADVICE r1) — every returned rep time is then the differenced
+    per-rep figure.
 
     Returns (recv dict like the oracle engines, per-rep wall seconds).
     """
@@ -468,13 +474,40 @@ def cw_proxy_sim(wl: Workload, na: NodeAssignment, *, ntimes: int = 1,
     dev = device if device is not None else jax.devices()[0]
     x0 = jax.device_put(jnp.asarray(send_flat), dev)
     route(x0).block_until_ready()              # warm-up compile
-    times = []
-    out = None
-    for _ in range(max(ntimes, 1)):
-        t0 = time.perf_counter()
+    if chained:
+        from tpu_aggcomm.harness.chained import differenced_per_rep
+
+        def make_chain(iters: int):
+            @jax.jit
+            def chain(x):
+                def body(x, r):
+                    y = jnp.take(x, p1)
+                    (y,) = lax.optimization_barrier((y,))
+                    y = jnp.take(y, p2)
+                    (y,) = lax.optimization_barrier((y,))
+                    y = jnp.take(y, p3)
+                    # serial dependence: rep r+1 reads rep r's delivery,
+                    # XOR-perturbed so iterations cannot fuse or hoist
+                    return y ^ r, ()
+
+                xs = (jnp.arange(iters, dtype=jnp.int32)
+                      % 251).astype(jnp.uint8)
+                x, _ = lax.scan(body, x, xs, unroll=1)
+                return x
+            return chain
+
+        per_rep = differenced_per_rep(make_chain, x0, iters_small=50,
+                                      iters_big=1050)
+        times = [per_rep] * max(ntimes, 1)
         out = route(x0)
-        out.block_until_ready()
-        times.append(time.perf_counter() - t0)
+    else:
+        times = []
+        out = None
+        for _ in range(max(ntimes, 1)):
+            t0 = time.perf_counter()
+            out = route(x0)
+            out.block_until_ready()
+            times.append(time.perf_counter() - t0)
 
     flat = np.asarray(jax.device_get(out))
     recv = _empty_recv(wl)
