@@ -141,3 +141,39 @@ def test_native_cw2_uneven_and_robin():
         meta = aggregator_meta_information(na, wl.aggregators, 2, 1)
         recv, _ = run_workload_cw2(wl, meta)
         wl.verify_all(recv)
+
+
+@pytest.mark.parametrize("stripe", [0, 1, 2, 3])
+def test_native_workload_cw3_matches_oracle(stripe):
+    """The native shared-window engine (threads share the per-node window
+    for real) delivers byte-for-byte what the cw3_shared oracle computes."""
+    from tpu_aggcomm.backends.native import run_workload_cw3
+    from tpu_aggcomm.core.meta import aggregator_meta_information
+    from tpu_aggcomm.core.topology import static_node_assignment
+    from tpu_aggcomm.core.workload import StripeType, initialize_setting
+    from tpu_aggcomm.tam.workload_engines import cw3_shared
+
+    na = static_node_assignment(8, 4, 0)
+    wl = initialize_setting(na, 5, StripeType(stripe))
+    meta = aggregator_meta_information(na, wl.aggregators, 4, 1)
+    recv_o, _stats = cw3_shared(wl, na, meta)
+    recv_n, times = run_workload_cw3(wl, na, meta, ntimes=3)
+    wl.verify_all(recv_n)
+    assert set(recv_n) == set(recv_o)
+    for g in recv_o:
+        for s in range(wl.nprocs):
+            assert np.array_equal(recv_o[g][s], recv_n[g][s]), (g, s)
+    assert len(times) == 3 and all(t > 0 for t in times)
+
+
+def test_native_workload_cw3_rejects_mode0_meta():
+    from tpu_aggcomm.backends.native import run_workload_cw3
+    from tpu_aggcomm.core.meta import aggregator_meta_information
+    from tpu_aggcomm.core.topology import static_node_assignment
+    from tpu_aggcomm.core.workload import StripeType, initialize_setting
+
+    na = static_node_assignment(8, 4, 0)
+    wl = initialize_setting(na, 5, StripeType.LESS)
+    meta = aggregator_meta_information(na, wl.aggregators, 1, 0)
+    with pytest.raises(ValueError, match="local aggregators"):
+        run_workload_cw3(wl, na, meta)

@@ -233,3 +233,41 @@ def test_cw_proxy_sim_chained_matches_oracle():
     assert len(times) == 3
     assert all(t > 0 for t in times)
     assert times[0] == times[1] == times[2]
+
+
+# ---------------------------------------------------------------------------
+# collective_write3 executable realizations (VERDICT r1 item 4)
+
+@pytest.mark.parametrize("stripe", [StripeType.SAME, StripeType.GREATER,
+                                    StripeType.LESS, StripeType.ALL])
+def test_cw3_shared_jax_matches_oracle(stripe):
+    """The compiled shared-window route (in-slice all_gather staging +
+    outer-axis hindexed exchange) delivers byte-for-byte what the
+    cw3_shared oracle accounts for, on every stripe workload."""
+    import jax
+
+    from tpu_aggcomm.tam.workload_engines import cw3_shared, cw3_shared_jax
+
+    na = static_node_assignment(8, 4, 0)
+    wl = initialize_setting(na, 5, stripe)
+    meta = aggregator_meta_information(na, wl.aggregators, 4, 1)
+    recv_o, _stats = cw3_shared(wl, na, meta)
+    recv_j, times = cw3_shared_jax(wl, na, meta, jax.devices(), ntimes=2)
+    wl.verify_all(recv_j)
+    assert set(recv_j) == set(recv_o)
+    for g in recv_o:
+        for s in range(wl.nprocs):
+            assert np.array_equal(recv_o[g][s], recv_j[g][s]), (g, s)
+    assert len(times) == 2
+
+
+def test_cw3_shared_jax_rejects_non_local_destination():
+    import jax
+
+    from tpu_aggcomm.tam.workload_engines import cw3_shared_jax
+
+    na = static_node_assignment(8, 4, 0)
+    wl = initialize_setting(na, 5, StripeType.LESS)
+    meta = aggregator_meta_information(na, wl.aggregators, 1, 0)  # mode 0
+    with pytest.raises(ValueError, match="local aggregators"):
+        cw3_shared_jax(wl, na, meta, jax.devices())

@@ -105,6 +105,20 @@ def _load():
             np.ctypeslib.ndpointer(np.uint8),     # recv_out
             np.ctypeslib.ndpointer(np.float64),   # rep_times_out
         ]
+        lib.agg_run_workload_cw3.restype = ctypes.c_int
+        lib.agg_run_workload_cw3.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int,
+            np.ctypeslib.ndpointer(np.int32),     # node_of
+            np.ctypeslib.ndpointer(np.int32),     # aggs
+            np.ctypeslib.ndpointer(np.int32),     # msg_sizes
+            np.ctypeslib.ndpointer(np.int32),     # owner_of
+            np.ctypeslib.ndpointer(np.int32),     # laggs
+            np.ctypeslib.ndpointer(np.uint8),     # send_msgs
+            np.ctypeslib.ndpointer(np.int64),     # send_block_ofs
+            np.ctypeslib.ndpointer(np.uint8),     # recv_out
+            np.ctypeslib.ndpointer(np.float64),   # rep_times_out
+        ]
         lib.agg_run_schedule.restype = ctypes.c_int
         lib.agg_run_schedule.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
@@ -235,6 +249,43 @@ def run_workload_cw2(wl, meta, ntimes: int = 1):
     if rc != 0:
         raise RuntimeError(f"native cw2 engine failed with rc={rc} "
                            f"(is every rank bound to a local aggregator?)")
+    return _unpack_recv(wl, recv_out), rep_times.max(axis=0).tolist()
+
+
+def run_workload_cw3(wl, na, meta, ntimes: int = 1):
+    """Run a variable-size workload through the native collective_write3
+    shared-window engine (``agg_run_workload_cw3``): group members fill a
+    per-node shared staging buffer (the MPI_Win_allocate_shared analog,
+    l_d_t.c:647-663 — threads genuinely share the memory), a fence
+    publishes it, local aggregators read members' staging zero-copy
+    (shared_query, 667-671) and exchange hindexed segments directly with
+    the destination aggregators (705-711).
+
+    Requires meta mode 1 (destinations must be local aggregators) and
+    node-local groups. Return shape matches :func:`run_workload_proxy`.
+    """
+    lib = _load()
+    n = wl.nprocs
+    sizes, aggs, send_msgs, send_block_ofs = _pack_blocks(wl)
+    G = len(aggs)
+    slab = int(sizes.sum())
+    recv_out = np.zeros(max(G * slab, 1), dtype=np.uint8)
+    laggs = np.asarray(meta.local_aggregators, dtype=np.int32)
+    rep_times = np.zeros((n, max(ntimes, 1)), dtype=np.float64)
+    rc = lib.agg_run_workload_cw3(
+        n, G, len(laggs), na.nnodes, max(ntimes, 1),
+        np.asarray(na.node_of, dtype=np.int32),
+        aggs, sizes, np.asarray(meta.owner_of, dtype=np.int32),
+        laggs, send_msgs, send_block_ofs, recv_out, rep_times)
+    if rc == 2:
+        raise ValueError(
+            "collective_write3 route requires destinations to be local "
+            "aggregators (meta mode 1)")
+    if rc == 3:
+        raise ValueError("a local-aggregator group spans nodes; "
+                         "shared window invalid")
+    if rc != 0:
+        raise RuntimeError(f"native cw3 engine failed with rc={rc}")
     return _unpack_recv(wl, recv_out), rep_times.max(axis=0).tolist()
 
 

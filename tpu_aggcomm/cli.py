@@ -99,14 +99,18 @@ def build_parser() -> argparse.ArgumentParser:
                           "1 superset of global aggregators")
     tam.add_argument("--engine",
                      choices=("proxy", "local_agg", "shared", "benchmark",
-                              "jax", "sim", "native", "native2"),
+                              "jax", "shared_jax", "sim", "native",
+                              "native2", "native3"),
                      default="proxy",
                      help="route: collective_write / _2 / _3 / _benchmark "
-                          "oracles, the compiled two-level mesh program "
-                          "(jax), the compiled single-chip proxy route "
-                          "(sim — runs on one real TPU), or the C++ "
-                          "threaded engines (native = proxy route, "
-                          "native2 = two-level local-aggregator route)")
+                          "oracles, the compiled mesh programs (jax = "
+                          "two-level, shared_jax = shared-window staging "
+                          "via in-slice all_gather), the compiled "
+                          "single-chip proxy route (sim — runs on one "
+                          "real TPU), or the C++ threaded engines "
+                          "(native = proxy route, native2 = two-level "
+                          "local-aggregator route, native3 = shared-"
+                          "window route)")
     tam.add_argument("--chained", action="store_true",
                      help="engine sim only: serial-chained differenced "
                           "per-rep timing (honest through the TPU tunnel)")
@@ -195,6 +199,22 @@ def _run_tam(args) -> int:
         wl.verify_all(recv)
         print(f"| engine = two-level mesh (compiled), reps = {len(times)}, "
               f"min rep = {min(times):.6f} s")
+    elif args.engine == "shared_jax":
+        import jax
+
+        from tpu_aggcomm.tam.workload_engines import cw3_shared_jax
+        recv, times = cw3_shared_jax(wl, na, meta, jax.devices(),
+                                     ntimes=args.ntimes)
+        wl.verify_all(recv)
+        print(f"| engine = shared-window mesh (compiled, in-slice "
+              f"all_gather staging), reps = {len(times)}, "
+              f"min rep = {min(times):.6f} s")
+    elif args.engine == "native3":
+        from tpu_aggcomm.backends.native import run_workload_cw3
+        recv, times = run_workload_cw3(wl, na, meta, ntimes=args.ntimes)
+        wl.verify_all(recv)
+        print(f"| engine = native shared-window (C++ threads), "
+              f"reps = {len(times)}, min rep = {min(times):.6f} s")
     elif args.engine == "sim":
         from tpu_aggcomm.tam.workload_engines import cw_proxy_sim
         recv, times = cw_proxy_sim(wl, na, ntimes=args.ntimes,

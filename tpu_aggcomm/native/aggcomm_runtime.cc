@@ -733,6 +733,207 @@ int agg_run_workload_cw2(int nprocs, int n_aggs, int n_laggs, int ntimes,
 
 }  // extern "C"
 
+// ---------------------------------------------------------------------------
+// collective_write3 (l_d_t.c:604-728): shared-window intra hop.
+//
+// The reference allocates an MPI-3 shared window per node (647-663): every
+// group member *fills* its staging region, a fence publishes it, and the
+// local aggregator *reads* all members' staging zero-copy via
+// MPI_Win_shared_query (667-671) before exchanging hindexed segments
+// directly with the destination aggregators (705-711). Threads of one
+// process genuinely share memory, so the analog is exact here: the window
+// is a plain per-node buffer, the fence is the generation barrier, and the
+// aggregator's group pack reads members' staging with NO channel traffic —
+// the intra-node hop costs zero messages, unlike cw2's member sends.
+
+namespace {
+
+struct Cw3Shared {
+  Runtime* rt;
+  int G, nl;
+  const int32_t* node_of;
+  const int32_t* aggs;
+  const int32_t* msg_sizes;
+  const int32_t* laggs;
+  const uint8_t* send_msgs;
+  const int64_t* send_block_ofs;
+  uint8_t* recv_out;
+  std::vector<std::vector<int>> members;        // per group, ascending
+  std::vector<int> group_of_rank;               // rank -> group or -1
+  std::vector<int> agg_of_rank;                 // rank -> gi or -1
+  std::vector<int64_t> block_bytes;             // per src
+  std::vector<int64_t> seg_total;               // per group
+  std::vector<int64_t> recv_src_ofs;            // per src
+  int64_t slab_bytes = 0;
+  std::vector<std::vector<uint8_t>> window;     // per node: shared staging
+  std::vector<int64_t> win_ofs;                 // per rank: offset in window
+  std::vector<std::vector<uint8_t>> seg_out;    // per group: G segments
+  std::vector<std::vector<uint8_t>> seg_in;     // per gi: staging
+};
+
+void cw3_run_rank(Cw3Shared* sh, int rank, int ntimes, double* rep_times) {
+  Runtime& rt = *sh->rt;
+  const int b = sh->node_of[rank];
+  const int j_self = sh->group_of_rank[rank];
+  const int gi_self = sh->agg_of_rank[rank];
+  for (int rep = 0; rep < ntimes; ++rep) {
+    double t0 = now_s();
+    // window fill (l_d_t.c:647-663): my packed block into the node window
+    if (sh->block_bytes[rank] > 0) {
+      std::memcpy(sh->window[b].data() + sh->win_ofs[rank],
+                  sh->send_msgs + sh->send_block_ofs[rank],
+                  sh->block_bytes[rank]);
+    }
+    // the fence (MPI_Win_fence): staging visible node-wide after this
+    {
+      std::unique_lock<std::mutex> lk(rt.mu);
+      rt.gen_barrier(lk, rt.barrier_waiting, rt.barrier_gen);
+    }
+    if (j_self >= 0) {
+      // zero-copy group read (shared_query, 667-671) + hindexed segment
+      // exchange with every destination aggregator (705-711)
+      auto& so = sh->seg_out[j_self];
+      const int64_t segsz = sh->seg_total[j_self];
+      for (int gi = 0; gi < sh->G; ++gi) {
+        uint8_t* seg = so.data() + (int64_t)gi * segsz;
+        int64_t cur = 0;
+        for (int src : sh->members[j_self]) {
+          const uint8_t* blk =
+              sh->window[sh->node_of[src]].data() + sh->win_ofs[src];
+          std::memcpy(seg + cur, blk + (int64_t)gi * sh->msg_sizes[src],
+                      sh->msg_sizes[src]);
+          cur += sh->msg_sizes[src];
+        }
+        int dst = sh->aggs[gi];
+        if (dst == rank) {
+          uint8_t* slab = sh->recv_out + (int64_t)gi * sh->slab_bytes;
+          int64_t o = 0;
+          for (int src : sh->members[j_self]) {
+            std::memcpy(slab + sh->recv_src_ofs[src], seg + o,
+                        sh->msg_sizes[src]);
+            o += sh->msg_sizes[src];
+          }
+        } else if (segsz > 0) {
+          wl_post_send(rt, rank, dst, seg, segsz);
+        }
+      }
+    }
+    if (gi_self >= 0) {
+      uint8_t* slab = sh->recv_out + (int64_t)gi_self * sh->slab_bytes;
+      auto& in = sh->seg_in[gi_self];
+      for (int j = 0; j < sh->nl; ++j) {
+        if (sh->laggs[j] == rank) continue;  // own group handled above
+        if (sh->seg_total[j] <= 0) continue;
+        wl_recv(rt, sh->laggs[j], rank, in.data());
+        int64_t o = 0;
+        for (int src : sh->members[j]) {
+          std::memcpy(slab + sh->recv_src_ofs[src], in.data() + o,
+                      sh->msg_sizes[src]);
+          o += sh->msg_sizes[src];
+        }
+      }
+    }
+    // end-of-rep rendezvous: window + segment buffers reused next rep
+    {
+      std::unique_lock<std::mutex> lk(rt.mu);
+      rt.gen_barrier(lk, rt.barrier_waiting, rt.barrier_gen);
+    }
+    rep_times[rep] = now_s() - t0;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Execute the collective_write3 shared-window route natively. Every
+// destination must be a local aggregator (rc=2 otherwise — the reference
+// sends only to local_aggregators; use meta mode 1) and no group may span
+// nodes (rc=3: a shared window lives on one node).
+int agg_run_workload_cw3(int nprocs, int n_aggs, int n_laggs, int nnodes,
+                         int ntimes, const int32_t* node_of,
+                         const int32_t* aggs, const int32_t* msg_sizes,
+                         const int32_t* owner_of, const int32_t* laggs,
+                         const uint8_t* send_msgs,
+                         const int64_t* send_block_ofs,
+                         uint8_t* recv_out, double* rep_times_out) {
+  Cw3Shared sh;
+  Runtime rt(nprocs);
+  sh.rt = &rt;
+  sh.G = n_aggs;
+  sh.nl = n_laggs;
+  sh.node_of = node_of;
+  sh.aggs = aggs;
+  sh.msg_sizes = msg_sizes;
+  sh.laggs = laggs;
+  sh.send_msgs = send_msgs;
+  sh.send_block_ofs = send_block_ofs;
+  sh.recv_out = recv_out;
+
+  sh.group_of_rank.assign(nprocs, -1);
+  for (int j = 0; j < n_laggs; ++j) sh.group_of_rank[laggs[j]] = j;
+  sh.agg_of_rank.assign(nprocs, -1);
+  for (int gi = 0; gi < n_aggs; ++gi) {
+    sh.agg_of_rank[aggs[gi]] = gi;
+    if (sh.group_of_rank[aggs[gi]] < 0) return 2;  // dst not a local agg
+  }
+  sh.members.resize(n_laggs);
+  for (int r = 0; r < nprocs; ++r) {
+    if (owner_of[r] < 0 || owner_of[r] >= nprocs) return 1;
+    int j = sh.group_of_rank[owner_of[r]];
+    if (j < 0) return 1;
+    if (node_of[owner_of[r]] != node_of[r]) return 3;  // group spans nodes
+    sh.members[j].push_back(r);
+  }
+  sh.block_bytes.resize(nprocs);
+  for (int r = 0; r < nprocs; ++r)
+    sh.block_bytes[r] = (int64_t)n_aggs * msg_sizes[r];
+  sh.recv_src_ofs.assign(nprocs, 0);
+  int64_t cur = 0;
+  for (int src = 0; src < nprocs; ++src) {
+    sh.recv_src_ofs[src] = cur;
+    cur += msg_sizes[src];
+  }
+  sh.slab_bytes = cur;
+  // per-node shared window: node ranks' blocks back-to-back (rank-ascending)
+  sh.window.resize(nnodes);
+  sh.win_ofs.assign(nprocs, 0);
+  {
+    std::vector<int64_t> node_cur(nnodes, 0);
+    for (int r = 0; r < nprocs; ++r) {
+      int b = node_of[r];
+      if (b < 0 || b >= nnodes) return 1;
+      sh.win_ofs[r] = node_cur[b];
+      node_cur[b] += sh.block_bytes[r];
+    }
+    for (int b = 0; b < nnodes; ++b)
+      sh.window[b].resize(std::max<int64_t>(node_cur[b], 1));
+  }
+  sh.seg_total.assign(n_laggs, 0);
+  sh.seg_out.resize(n_laggs);
+  for (int j = 0; j < n_laggs; ++j) {
+    for (int m : sh.members[j]) sh.seg_total[j] += msg_sizes[m];
+    sh.seg_out[j].resize(
+        std::max<int64_t>((int64_t)n_aggs * sh.seg_total[j], 1));
+  }
+  sh.seg_in.resize(n_aggs);
+  int64_t max_seg = 1;
+  for (int j = 0; j < n_laggs; ++j)
+    max_seg = std::max(max_seg, sh.seg_total[j]);
+  for (int gi = 0; gi < n_aggs; ++gi) sh.seg_in[gi].resize(max_seg);
+
+  std::vector<std::thread> threads;
+  threads.reserve(nprocs);
+  for (int r = 0; r < nprocs; ++r) {
+    threads.emplace_back(cw3_run_rank, &sh, r, ntimes,
+                         rep_times_out + (size_t)r * ntimes);
+  }
+  for (auto& th : threads) th.join();
+  return 0;
+}
+
+}  // extern "C"
+
 extern "C" {
 
 // Execute the collective_write proxy route natively on a variable-size

@@ -43,8 +43,8 @@ from tpu_aggcomm.core.workload import Workload
 __all__ = [
     "RouteStats", "recv_index_map",
     "cw_benchmark", "cw_proxy", "cw2_local_agg", "cw3_shared",
-    "cw2_local_agg_jax", "cw_proxy_sim", "WORKLOAD_ENGINES",
-    "run_workload_engine",
+    "cw2_local_agg_jax", "cw3_shared_jax", "cw_proxy_sim",
+    "WORKLOAD_ENGINES", "run_workload_engine",
 ]
 
 
@@ -236,22 +236,31 @@ def cw3_shared(wl: Workload, na: NodeAssignment, meta: AggregatorMeta):
 # ---------------------------------------------------------------------------
 # JAX mesh engine for the two-level route
 
-def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
-                      devices, ntimes: int = 1):
-    """Run the collective_write2 route on a ``(node, local)`` mesh.
+def _two_level_mesh_exchange(wl: Workload, na: NodeAssignment,
+                             meta: AggregatorMeta, devices, ntimes: int,
+                             staging: str, caller: str):
+    """Shared body of the two compiled two-level engines.
 
-    Rank ``r`` lives at coordinate ``(r // L, r % L)`` (contiguous node
-    map).  Three compiled hops, all static shapes (messages padded to the
-    workload's max size ``S`` and masked):
+    Rank ``r`` lives at coordinate ``(r // L, r % L)`` on a
+    ``(node, local)`` mesh (contiguous node map). Blocks are padded to the
+    workload's max size and carried as uint32 lanes on device (CLAUDE.md:
+    uint8 paths are 4-5x slower on TPU); the byte view is restored at the
+    host boundary. The engines differ ONLY in how a local aggregator comes
+    to hold its group members' blocks — the ``staging`` hop:
 
-    1. inner-axis ``all_to_all``: every rank's padded send block ``(G, S)``
-       lands at its local aggregator (the hindexed gather, l_d_t.c:848-856);
-    2. outer-axis ``all_to_all``: local aggregators forward per-destination
-       segments toward each destination's node (the MPI_BOTTOM Issend per
-       global aggregator, l_d_t.c:899-902);
-    3. inner-axis ``all_to_all``: segments hop to the destination's local
-       coordinate and scatter into per-source recv rows (recv_types,
-       l_d_t.c:1332-1361).
+    - ``"targeted"`` (collective_write2): one-hot scatter + inner-axis
+      ``all_to_all`` — each member's block is *sent* to its owner's
+      coordinate (the hindexed gather, l_d_t.c:848-856).
+    - ``"shared"`` (collective_write3): inner-axis ``all_gather`` — the
+      node's staging is replicated in-slice (the shared window + fence,
+      l_d_t.c:647-671) and each owner *reads* the blocks of the ranks it
+      owns (shared_query semantics: a read, not a targeted message).
+
+    After that, both run the identical aggregator↔aggregator hindexed
+    exchange (l_d_t.c:899-902 / 705-711): outer-axis ``all_to_all`` of
+    per-destination-node segments, then an inner-axis hop delivering each
+    slot to its destination's local coordinate with recv_index_map
+    scattering (recv_types, l_d_t.c:1332-1361).
 
     Returns ``(recv_by_rank, rep_times)``; recv rows are unpadded to the
     true per-source sizes before being handed back.
@@ -263,23 +272,27 @@ def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
     from jax import lax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from tpu_aggcomm.backends.lanes import (lane_layout, lanes_to_bytes,
+                                            to_lanes)
+
     n = wl.nprocs
     if na.nnodes < 1 or n % na.nnodes:
-        raise ValueError("cw2_local_agg_jax needs equal-size nodes")
+        raise ValueError(f"{caller} needs equal-size nodes")
     L = n // na.nnodes
     N = na.nnodes
     if not np.array_equal(na.node_of, np.arange(n) // L):
-        raise ValueError("cw2_local_agg_jax needs the contiguous node map "
-                         "(static_node_assignment kind 0)")
+        raise ValueError(f"{caller} needs the contiguous node map "
+                         f"(static_node_assignment kind 0)")
     if len(devices) < n:
         raise ValueError(
             f"need {n} devices, have {len(devices)} (hint: JAX_PLATFORMS=cpu "
             f"XLA_FLAGS=--xla_force_host_platform_device_count={n})")
 
-    S = wl.max_msg_size
     aggs = np.asarray(wl.aggregators, dtype=np.int64)
     G = len(aggs)
     sizes = np.asarray(wl.msg_size)
+    S = -(-wl.max_msg_size // 4) * 4     # pad to a whole uint32 lane count
+    _, jdt, W = lane_layout(S)
 
     # destination geometry: node + local coordinate of each destination,
     # grouped per node with K = max destinations on one node
@@ -296,18 +309,18 @@ def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
 
     owner_local = (np.asarray(meta.owner_of) % L).astype(np.int64)  # per rank
 
-    # host-side payload: (N, L, G, S) padded send blocks
+    # host-side payload: (N, L, G, W) padded send blocks in lane layout
     send_g = np.zeros((n, G, S), dtype=np.uint8)
     for r in range(n):
         m = int(sizes[r])
         for gi, g in enumerate(aggs):
             send_g[r, gi, :m] = wl.fill(r, int(g))
-    send_g = send_g.reshape(N, L, G, S)
+    send_g = to_lanes(send_g, S).reshape(N, L, G, W)
 
     from tpu_aggcomm.parallel import (host_major_devices,
                                       warn_if_node_straddles_hosts)
     devices = host_major_devices(devices)
-    warn_if_node_straddles_hosts(devices[:n], L, "cw2_local_agg_jax")
+    warn_if_node_straddles_hosts(devices[:n], L, caller)
     mesh = Mesh(np.array(devices[:n]).reshape(N, L), ("node", "local"))
     sharding = NamedSharding(mesh, P("node", "local"))
     send_dev = jax.device_put(send_g, sharding)
@@ -317,42 +330,51 @@ def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
     local_of_slot_j = jnp.asarray(local_of_slot)
 
     def local_fn(send):
-        x = send[0, 0]                                   # (G, S) my block
+        x = send[0, 0]                                   # (G, W) my block
         mynode = lax.axis_index("node")
         mylocal = lax.axis_index("local")
 
-        # hop 1 (inner axis): block -> my local aggregator's coordinate
-        my_owner = owner_local_j[mynode, mylocal]        # scalar
-        buf1 = jnp.zeros((L + 1, G, S), jnp.uint8).at[my_owner].set(x)[:L]
-        held = lax.all_to_all(buf1, "local", 0, 0)       # (L, G, S)
-        # held[l'] = block of source (mynode, l') iff I am its owner
+        # staging hop (inner axis): owners end up holding their group
+        if staging == "targeted":
+            # block -> my local aggregator's coordinate (targeted send)
+            my_owner = owner_local_j[mynode, mylocal]    # scalar
+            buf1 = jnp.zeros((L + 1, G, W), jdt).at[my_owner].set(x)[:L]
+            held = lax.all_to_all(buf1, "local", 0, 0)   # (L, G, W)
+            # held[l'] = block of source (mynode, l') iff I am its owner
+        else:
+            # shared window: the node's staging replicated in-slice; the
+            # fence is implicit in the collective, and I *read* exactly
+            # the blocks of the ranks I own
+            staged = lax.all_gather(x, "local")          # (L, G, W)
+            owned = (owner_local_j[mynode] == mylocal)   # (L,)
+            held = staged * owned[:, None, None].astype(jdt)
 
-        # hop 2 (outer axis): per-destination-node segments
+        # exchange hop (outer axis): per-destination-node segments
         # buf2[b', j, l'] = held[l', slot j of node b']
         sel = jnp.maximum(aggs_of_node_j, 0)             # (N, K)
-        mask = (aggs_of_node_j >= 0).astype(jnp.uint8)[..., None, None]
-        byslot = jnp.take(held, sel.reshape(-1), axis=1)  # (L, N*K, S)
-        byslot = byslot.reshape(L, N, K, S).transpose(1, 2, 0, 3) * mask
-        got2 = lax.all_to_all(byslot, "node", 0, 0)      # (N, K, L, S)
+        mask = (aggs_of_node_j >= 0).astype(jdt)[..., None, None]
+        byslot = jnp.take(held, sel.reshape(-1), axis=1)  # (L, N*K, W)
+        byslot = byslot.reshape(L, N, K, W).transpose(1, 2, 0, 3) * mask
+        got2 = lax.all_to_all(byslot, "node", 0, 0)      # (N, K, L, W)
         # got2[b_src, j, l_src] = message (b_src·L+l_src -> my-node slot j)
         # held at the source-side owner's local coordinate (= my coordinate)
 
-        # hop 3 (inner axis): slot j -> the destination's local coordinate
+        # delivery hop (inner axis): slot j -> destination's local coord
         dl = jnp.where(local_of_slot_j[mynode] >= 0,
                        local_of_slot_j[mynode], L)       # (K,)
-        buf3 = jnp.zeros((L + 1, K, N, L, S), jnp.uint8)
+        buf3 = jnp.zeros((L + 1, K, N, L, W), jdt)
         buf3 = buf3.at[dl].set(got2.transpose(1, 0, 2, 3))[:L]
-        got3 = lax.all_to_all(buf3, "local", 0, 0)       # (L, K, N, L, S)
+        got3 = lax.all_to_all(buf3, "local", 0, 0)       # (L, K, N, L, W)
         # got3[l_holder, j, b_src, l_src]: nonzero only at the destination
         # coordinate of slot j, from the holder that owned (b_src, l_src).
         # Disjoint owners => sum collapses the holder axis losslessly.
-        merged = got3.sum(axis=0, dtype=jnp.uint8)       # (K, N, L, S)
+        merged = got3.sum(axis=0, dtype=jdt)             # (K, N, L, W)
 
         # select my slot (at most one destination per (node, local) coord)
         is_mine = (local_of_slot_j[mynode] == mylocal)   # (K,)
         recv = jnp.where(is_mine[:, None, None, None], merged, 0
-                         ).sum(axis=0, dtype=jnp.uint8)  # (N, L, S)
-        return recv.reshape(n, S)[None, None]
+                         ).sum(axis=0, dtype=jdt)        # (N, L, W)
+        return recv.reshape(n, W)[None, None]
 
     fn = jax.jit(jax.shard_map(local_fn, mesh=mesh,
                                in_specs=P("node", "local"),
@@ -366,7 +388,8 @@ def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
         out_dev = fn(send_dev)
         out_dev.block_until_ready()
         rep_times.append(_time.perf_counter() - t0)
-    out = np.asarray(jax.device_get(out_dev)).reshape(n, n, S)
+    out = lanes_to_bytes(
+        np.asarray(jax.device_get(out_dev)).reshape(n, n, W), S)
 
     is_dst = wl.is_aggregator
     recv_by_rank: dict[int, list[np.ndarray | None]] = {}
@@ -376,6 +399,56 @@ def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
                            for src in range(n)]
     assert all(is_dst[g] for g in recv_by_rank)
     return recv_by_rank, rep_times
+
+
+def cw2_local_agg_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
+                      devices, ntimes: int = 1):
+    """Run the collective_write2 route on a ``(node, local)`` mesh: the
+    targeted-staging variant of :func:`_two_level_mesh_exchange` (members
+    *send* their blocks to their local aggregator — the hindexed gather,
+    l_d_t.c:848-856 — then the aggregator↔aggregator exchange)."""
+    return _two_level_mesh_exchange(wl, na, meta, devices, ntimes,
+                                    "targeted", "cw2_local_agg_jax")
+
+
+# ---------------------------------------------------------------------------
+# JAX mesh engine for the shared-window route (collective_write3)
+
+def cw3_shared_jax(wl: Workload, na: NodeAssignment, meta: AggregatorMeta,
+                   devices, ntimes: int = 1):
+    """Run the collective_write3 route on a ``(node, local)`` mesh.
+
+    The reference's MPI-3 shared window (l_d_t.c:647-671) lets every rank
+    of a node *fill* a staging region and lets its local aggregator *read*
+    all members' staging zero-copy after a fence. The same-slice analog:
+    the intra-node hop is an inner-axis ``all_gather`` — every chip of the
+    slice holds the node's full staging buffer in its HBM, and each local
+    aggregator *selects* the blocks of the ranks it owns from that
+    replicated staging (a read, not a targeted message: exactly the
+    shared-query semantics). The aggregator↔aggregator hindexed exchange
+    (l_d_t.c:705-711) then rides the outer (DCN) axis, identical to the
+    collective_write2 exchange — which mirrors the reference, where cw2
+    and cw3 differ only in how the intra-node gather happens.
+
+    Requires the cw3 preconditions (destinations are local aggregators —
+    meta mode 1 — and no group spans nodes); raises like
+    :func:`cw3_shared` otherwise. Returns ``(recv_by_rank, rep_times)``.
+    """
+    # same validity domain as the oracle (shared windows are per node)
+    is_local = meta.is_local_aggregator
+    missing = [int(d) for d in wl.aggregators if not is_local[int(d)]]
+    if missing:
+        raise ValueError(
+            f"collective_write3 route requires destinations to be local "
+            f"aggregators (meta mode 1); not local: {missing}")
+    for agg in meta.local_aggregators:
+        nodes = {int(na.node_of[w]) for w in meta.owned_ranks(int(agg))}
+        nodes.add(int(na.node_of[int(agg)]))
+        if len(nodes) > 1:
+            raise ValueError(f"group of local aggregator {int(agg)} spans "
+                             f"nodes {sorted(nodes)}; shared window invalid")
+    return _two_level_mesh_exchange(wl, na, meta, devices, ntimes,
+                                    "shared", "cw3_shared_jax")
 
 
 # ---------------------------------------------------------------------------
