@@ -175,8 +175,27 @@ class JaxIciBackend:
             p = schedule.pattern
             devs = (list(self._devices) if self._devices is not None
                     else jax.devices())
-            recv_bufs, rep_times = tam_two_level_jax(schedule, devs, iter_,
-                                                     ntimes)
+            na = schedule.assignment
+            needed = na.nnodes * int(na.node_sizes[0])  # padded-mesh size
+            if len(devs) < needed:
+                # a ragged node map pads the mesh to N*L coordinates; when
+                # the pool can't host that, run the device-resident
+                # single-chip route instead of failing the method
+                import warnings
+                warnings.warn(
+                    f"TAM padded mesh needs {needed} devices, have "
+                    f"{len(devs)}; falling back to the jax_sim "
+                    f"single-device route", RuntimeWarning, stacklevel=2)
+                from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+                if getattr(self, "_sim_delegate", None) is None:
+                    self._sim_delegate = JaxSimBackend(device=devs[0])
+                out = self._sim_delegate.run(schedule, ntimes=ntimes,
+                                             iter_=iter_, verify=verify)
+                self.last_rep_timers = getattr(self._sim_delegate,
+                                               "last_rep_timers", [])
+                return out
+            recv_bufs, rep_times = tam_two_level_jax(schedule, devs,
+                                                     iter_, ntimes)
             timers = [Timer(total_time=sum(rep_times))
                       for _ in range(p.nprocs)]
             self.last_rep_timers = [

@@ -165,7 +165,15 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
     """Run the two-level exchange on a (node, local) mesh. Returns
     (per-rank recv slabs, per-rep wall times). Rank r lives at mesh
     coordinate (r // L, r % L) with L = ranks per node (contiguous node
-    map, the same shape static_node_assignment type 0 fabricates)."""
+    map, the same shape static_node_assignment type 0 fabricates).
+
+    A ragged last node (nprocs % proc_node != 0 — the reference supports
+    this, l_d_t.c:359-429) is handled by padding the mesh to N*L
+    coordinates: the phantom ranks of the last node carry zero slabs and
+    their outputs are dropped at the host boundary, so N*L devices are
+    required (VERDICT r1 item 5). Raises if the device pool can't host the
+    padded mesh; jax_ici then falls back to the single-chip jax_sim route.
+    """
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -178,12 +186,12 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
     n, ds = p.nprocs, p.data_size
     L = int(na.node_sizes[0])
     N = na.nnodes
-    if N * L != n:
+    n_pad = N * L            # == n unless the last node is ragged
+    if len(devices) < n_pad:
         raise ValueError(
-            f"two-level mesh needs nprocs divisible by proc_node; got "
-            f"nprocs={n}, proc_node={L}")
-    if len(devices) < n:
-        raise ValueError(f"need {n} devices, have {len(devices)}")
+            f"two-level mesh needs {n_pad} devices "
+            f"({N} nodes x {L} ranks; ragged last node is padded with "
+            f"phantom coordinates), have {len(devices)}")
 
     # host-major ordering aligns the logical node boundary with the DCN
     # boundary when L divides the chips-per-host (no-op on one host);
@@ -192,9 +200,9 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
     from tpu_aggcomm.parallel import (host_major_devices,
                                       warn_if_node_straddles_hosts)
     devices = host_major_devices(devices)
-    warn_if_node_straddles_hosts(devices[:n], L, "tam_two_level_jax")
+    warn_if_node_straddles_hosts(devices[:n_pad], L, "tam_two_level_jax")
 
-    mesh = Mesh(np.array(devices[:n]).reshape(N, L), ("node", "local"))
+    mesh = Mesh(np.array(devices[:n_pad]).reshape(N, L), ("node", "local"))
     agg_index = np.asarray(p.agg_index)
     rank_list = np.asarray(p.rank_list)
     agg_node = (rank_list // L).astype(np.int64)
@@ -216,8 +224,11 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
                                             to_lanes)
     _, jdt, w = lane_layout(ds)
     slabs = make_send_slabs(p, iter_)
+    # phantom pad ranks (row >= n) and phantom destination slots carry zeros
     send_g = np.zeros(
-        (n, (p.cb_nodes if p.direction is Direction.ALL_TO_MANY else n), ds),
+        (n_pad,
+         (p.cb_nodes if p.direction is Direction.ALL_TO_MANY else n_pad),
+         ds),
         dtype=np.uint8)
     for r, s in enumerate(slabs):
         if s is not None:
@@ -256,10 +267,10 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
             got2 = lax.all_to_all(buf, "local", 0, 0)          # (L, N, w)
             # got2[l', a] = slab from source rank a*L + l' (zeros if I'm not
             # an aggregator). recv[src] ordering: src = a*L + l'.
-            recv = jnp.transpose(got2, (1, 0, 2)).reshape(n, w)
+            recv = jnp.transpose(got2, (1, 0, 2)).reshape(n_pad, w)
             return recv[None, None]
 
-        out_rows = n
+        out_rows = n_pad          # phantom source rows sliced off on host
     else:
 
         def local_fn(send):
@@ -304,12 +315,13 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
         out_dev.block_until_ready()
         rep_times.append(_time.perf_counter() - t0)
     out = lanes_to_bytes(
-        np.asarray(jax.device_get(out_dev)).reshape(n, out_rows, w), ds)
+        np.asarray(jax.device_get(out_dev)).reshape(n_pad, out_rows, w), ds)
 
     recv_bufs = []
-    for rank in range(n):
+    for rank in range(n):           # phantom pad ranks dropped
         if p.direction is Direction.ALL_TO_MANY:
-            recv_bufs.append(out[rank] if agg_index[rank] >= 0 else None)
+            # slice each aggregator's rows to the real sources
+            recv_bufs.append(out[rank][:n] if agg_index[rank] >= 0 else None)
         else:
             recv_bufs.append(out[rank])
     return recv_bufs, rep_times
