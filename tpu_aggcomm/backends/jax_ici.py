@@ -175,15 +175,19 @@ class JaxIciBackend:
             p = schedule.pattern
             devs = (list(self._devices) if self._devices is not None
                     else jax.devices())
-            na = schedule.assignment
-            needed = na.nnodes * int(na.node_sizes[0])  # padded-mesh size
-            if len(devs) < needed:
-                # a ragged node map pads the mesh to N*L coordinates; when
-                # the pool can't host that, run the device-resident
-                # single-chip route instead of failing the method
+            from tpu_aggcomm.tam.engine import padded_mesh_size
+            needed = padded_mesh_size(schedule.assignment)
+            if len(devs) < needed and needed > p.nprocs \
+                    and len(devs) >= p.nprocs:
+                # ONLY the ragged-pad case falls back: the pool covers the
+                # real ranks but not the phantom pad coordinates. A genuine
+                # device shortfall (fewer devices than ranks) still raises
+                # inside tam_two_level_jax with the remediation hint —
+                # silently swapping multi-chip timing for a single-chip
+                # simulation would mislabel the numbers.
                 import warnings
                 warnings.warn(
-                    f"TAM padded mesh needs {needed} devices, have "
+                    f"TAM ragged-pad mesh needs {needed} devices, have "
                     f"{len(devs)}; falling back to the jax_sim "
                     f"single-device route", RuntimeWarning, stacklevel=2)
                 from tpu_aggcomm.backends.jax_sim import JaxSimBackend

@@ -72,6 +72,9 @@ def build_parser() -> argparse.ArgumentParser:
     pt.add_argument("-d", dest="data_size", type=int, default=0)
     pt.add_argument("-k", dest="ntimes", type=int, default=0)
     pt.add_argument("-i", dest="runs", type=int, default=0)
+    pt.add_argument("--chained", action="store_true",
+                    help="serial-chained differenced per-transfer timing "
+                         "(honest through the TPU tunnel)")
 
     # TAM workload harness — the reference's DEBUG driver
     # (lustre_driver_test.c:1417-1509, grammar "hp:b:n:t:r:c:")
@@ -513,7 +516,7 @@ def main(argv=None) -> int:
     if args.command == "pt2pt":
         from tpu_aggcomm.harness.pt2pt import pt2pt_statistics
         pt2pt_statistics(max(args.data_size, 1), max(args.ntimes, 1),
-                         max(args.runs, 1))
+                         max(args.runs, 1), chained=args.chained)
         return 0
     if args.command == "tam":
         return _run_tam(args)

@@ -10,6 +10,15 @@ On a mesh with ≥2 devices the transfer is a real single-edge
 Issend/Irecv+Wait pair becomes one ppermute step — rendezvous and delivery
 are one event on a lockstep collective backend; what's measured is the
 per-message link latency, same quantity as the reference.
+
+The ``runs`` transfers are chained serially inside one compiled program
+via ``lax.scan`` (unroll=1) with an XOR perturbation per step, so compile
+time is constant in ``runs`` (the reference sweeps -i into the thousands,
+mpi_sendrecv_test.c:87) and XLA can neither batch nor elide steps.
+``chained=True`` additionally replaces per-dispatch wall times with the
+differenced two-chain-length measurement (harness/chained.py): through
+the TPU tunnel a single dispatch measures the ~60-90 ms RPC, not the
+link (VERDICT r1 item 8).
 """
 
 from __future__ import annotations
@@ -21,12 +30,46 @@ import numpy as np
 __all__ = ["pt2pt_statistics"]
 
 
-def pt2pt_statistics(data_size: int, ntimes: int, runs: int, *,
-                     filename: str = "sendrecv_results.csv",
-                     out=None, devices=None) -> dict:
+def _make_chain_factory(mesh, data_size: int):
+    """Chain factory over the lane layout for ``data_size``: payloads ride
+    as uint32 lanes when 4-aligned (CLAUDE.md: u8 paths are 4-5x slower on
+    TPU) and the perturbation is a byte-replicated word XOR."""
     import jax
     import jax.numpy as jnp
     from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_aggcomm.backends.lanes import lane_layout
+
+    _, jdt, _w = lane_layout(data_size)
+    rep = 0x01010101 if jdt == jnp.uint32 else 1  # byte-replicated word
+
+    def make_chain(steps: int):
+        def local_fn(x):
+            v = x[0]
+
+            def body(v, r):
+                v = lax.ppermute(v, "p", [(1, 0)])
+                (v,) = lax.optimization_barrier((v,))
+                # serial dependence: step k+1 sends step k's delivery,
+                # XOR-perturbed so steps cannot fuse, hoist, or elide
+                return v ^ r, ()
+
+            xs = ((jnp.arange(steps, dtype=jnp.int32) % 251)
+                  .astype(jdt) * jdt(rep))
+            v, _ = lax.scan(body, v, xs, unroll=1)
+            return v[None]
+
+        return jax.jit(jax.shard_map(local_fn, mesh=mesh, in_specs=P("p"),
+                                     out_specs=P("p")))
+
+    return make_chain
+
+
+def pt2pt_statistics(data_size: int, ntimes: int, runs: int, *,
+                     filename: str = "sendrecv_results.csv",
+                     out=None, devices=None, chained: bool = False) -> dict:
+    import jax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devs = list(devices) if devices is not None else jax.devices()
@@ -35,31 +78,30 @@ def pt2pt_statistics(data_size: int, ntimes: int, runs: int, *,
                          "(the reference requires exactly 2 ranks)")
     mesh = Mesh(np.array(devs[:2]), ("p",))
     sharding = NamedSharding(mesh, P("p"))
+    make_chain = _make_chain_factory(mesh, data_size)
 
-    def local_fn(x):
-        # rank 1 -> rank 0, `runs` sequential transfers (chained so XLA
-        # cannot batch them into one)
-        v = x[0]
-        for _ in range(runs):
-            v = lax.ppermute(v, "p", [(1, 0)])
-            (v,) = lax.optimization_barrier((v,))
-        return v[None]
-
-    fn = jax.jit(jax.shard_map(local_fn, mesh=mesh, in_specs=P("p"),
-                               out_specs=P("p")))
-
+    from tpu_aggcomm.backends.lanes import to_lanes
     buf = jax.device_put(
-        np.arange(2 * data_size, dtype=np.uint8).reshape(2, data_size),
+        to_lanes(np.arange(2 * data_size, dtype=np.uint8)
+                 .reshape(2, data_size), data_size),
         sharding)
-    fn(buf).block_until_ready()  # warm-up compile
 
-    times = []
-    t_all = time.perf_counter()
-    for _ in range(ntimes):
-        t0 = time.perf_counter()
-        fn(buf).block_until_ready()
-        times.append(time.perf_counter() - t0)
-    total = time.perf_counter() - t_all
+    if chained:
+        from tpu_aggcomm.harness.chained import differenced_per_rep
+        per_transfer = differenced_per_rep(make_chain, buf,
+                                           iters_small=50, iters_big=1050)
+        times = [per_transfer * runs] * max(ntimes, 1)
+        total = sum(times)
+    else:
+        fn = make_chain(runs)
+        fn(buf).block_until_ready()  # warm-up compile
+        times = []
+        t_all = time.perf_counter()
+        for _ in range(ntimes):
+            t0 = time.perf_counter()
+            fn(buf).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        total = time.perf_counter() - t_all
 
     times_a = np.array(times)
     mean = float(times_a.mean())

@@ -44,8 +44,15 @@ import numpy as np
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.topology import NodeAssignment, static_node_assignment
 
-__all__ = ["TamMethod", "gen_tam_schedule", "tam_oracle",
-           "tam_two_level_jax", "tam_phase_bytes"]
+__all__ = ["TamMethod", "gen_tam_schedule", "padded_mesh_size",
+           "tam_oracle", "tam_two_level_jax", "tam_phase_bytes"]
+
+
+def padded_mesh_size(na: NodeAssignment) -> int:
+    """Devices the two-level mesh engine needs: N*L coordinates, where a
+    ragged last node is padded with phantom ranks. The single source of
+    truth for both the engine and jax_ici's fallback pre-check."""
+    return na.nnodes * int(na.node_sizes[0])
 
 
 @dataclass
@@ -186,6 +193,16 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
     n, ds = p.nprocs, p.data_size
     L = int(na.node_sizes[0])
     N = na.nnodes
+    # the r // L coordinate math requires the contiguous type-0 shape:
+    # full nodes of L ranks, optionally one ragged last node
+    sizes_ok = (all(int(s) == L for s in na.node_sizes[:-1])
+                and int(na.node_sizes[-1]) <= L
+                and np.array_equal(na.node_of, np.arange(n) // L))
+    if not sizes_ok:
+        raise ValueError(
+            "two-level mesh needs the contiguous type-0 node map (full "
+            f"nodes of {L} ranks + optional ragged last node); got node "
+            f"sizes {[int(s) for s in na.node_sizes]}")
     n_pad = N * L            # == n unless the last node is ragged
     if len(devices) < n_pad:
         raise ValueError(
