@@ -18,15 +18,16 @@ maps to one mesh device; the schedule's global round/edge view lowers to:
   dataflow.
 
 Timing semantics (documented difference, SURVEY.md §7 hard part (3)): XLA
-executes one compiled program per rep, so per-phase post/waitall times do
-not exist on this backend; ``total_time`` is the honest number (wall time
-per rep after a warm-up compile, synchronized via ``block_until_ready``).
-``profile_rounds=True`` splits the program at round boundaries into
-separately-jitted segments and reports their summed wall times into
-``recv_wait_all_time`` (adds dispatch sync — use for schedule-shape
-analysis, not headline numbers). Per-phase attribution with device-side
-semaphores lives in the pallas_dma backend; host-side per-op timing lives
-in the native backend.
+executes one compiled program per rep, so per-phase post/waitall times
+cannot be bracketed individually on this backend; ``total_time`` is the
+directly measured number (wall time per rep after a warm-up compile,
+synchronized via ``block_until_ready``). Phase columns are filled by the
+*fenced-segment approximation* (harness/attribution.py): measured wall
+time is split onto each rank's TimerBucket structure — per throttle round
+when ``profile_rounds=True`` (the program is split at round boundaries
+into separately-jitted, separately-timed segments; adds dispatch sync),
+whole-rep otherwise. Direct per-op host timing lives in the native
+backend; device-side semaphore timing in pallas_dma.
 """
 
 from __future__ import annotations
@@ -44,6 +45,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_aggcomm.backends.lanes import lane_layout, lanes_to_bytes, to_lanes
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import OpKind, Schedule
+from tpu_aggcomm.harness.attribution import (attribute_rounds,
+                                             attribute_tam_total,
+                                             attribute_total,
+                                             rank_round_weights,
+                                             tam_rank_weights)
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs
 
@@ -200,11 +206,17 @@ class JaxIciBackend:
                 return out
             recv_bufs, rep_times = tam_two_level_jax(schedule, devs,
                                                      iter_, ntimes)
-            timers = [Timer(total_time=sum(rep_times))
-                      for _ in range(p.nprocs)]
-            self.last_rep_timers = [
-                [Timer(total_time=dt) for _ in range(p.nprocs)]
-                for dt in rep_times]
+            # per-rank byte-weighted P2/P3/P4 split of each measured rep
+            # (harness/attribution.py: intra hops -> recv_wait, inter hop
+            # -> send_wait, matching collective_write's brackets)
+            tam_w = tam_rank_weights(schedule)
+            timers = [Timer() for _ in range(p.nprocs)]
+            self.last_rep_timers = []
+            for dt in rep_times:
+                rep_attr = attribute_tam_total(schedule, dt, weights=tam_w)
+                for r, t in enumerate(timers):
+                    t += rep_attr[r]
+                self.last_rep_timers.append(rep_attr)
             if verify:
                 from tpu_aggcomm.harness.verify import verify_recv
                 verify_recv(p, recv_bufs, iter_)
@@ -219,8 +231,10 @@ class JaxIciBackend:
             n_send_slots = p.cb_nodes if p.direction is Direction.ALL_TO_MANY else n
             key = (p, "dense")
             if key not in self._segment_cache:
-                self._segment_cache[key] = [self._build_dense(p, mesh)]
-            segments = self._segment_cache[key]
+                self._segment_cache[key] = ([self._build_dense(p, mesh)],
+                                            None)
+            segments, seg_rounds = self._segment_cache[key]
+            attr_w = None
         else:
             low = lower_schedule(schedule)
             n_recv_slots, n_send_slots = low.n_recv_slots, low.n_send_slots
@@ -228,7 +242,11 @@ class JaxIciBackend:
             if key not in self._segment_cache:
                 self._segment_cache[key] = self._build_ppermute(
                     p, mesh, sharding, low, split_rounds=profile_rounds)
-            segments = self._segment_cache[key]
+            segments, seg_rounds = self._segment_cache[key]
+            akey = (key, "attr")
+            if akey not in self._segment_cache:
+                self._segment_cache[akey] = rank_round_weights(schedule)
+            attr_w = self._segment_cache[akey]
 
         send_g = self._global_send(p, iter_, n_send_slots)
         send_dev = jax.device_put(send_g, sharding)
@@ -249,19 +267,28 @@ class JaxIciBackend:
         recv_dev = None
         for _ in range(ntimes):
             recv_dev = fresh_recv()
+            seg_times = []
             t0 = time.perf_counter()
             for seg in segments:
+                ts = time.perf_counter()
                 recv_dev = seg(send_dev, recv_dev)
                 if profile_rounds:
                     recv_dev.block_until_ready()
+                    seg_times.append(time.perf_counter() - ts)
             recv_dev.block_until_ready()
             dt = time.perf_counter() - t0
-            for t in timers:
-                t.total_time += dt
-                if profile_rounds and len(segments) > 1:
-                    t.recv_wait_all_time += dt
-            self.last_rep_timers.append(
-                [Timer(total_time=dt) for _ in range(n)])
+            # measured time -> TimerBucket structure (the fenced-segment
+            # approximation, harness/attribution.py): per-round when the
+            # program was split at round boundaries, whole-rep otherwise
+            if profile_rounds and seg_rounds is not None and len(segments) > 1:
+                rep_attr = attribute_rounds(
+                    schedule, dict(zip(seg_rounds, seg_times)),
+                    weights=attr_w)
+            else:
+                rep_attr = attribute_total(schedule, dt, weights=attr_w)
+            for r, t in enumerate(timers):
+                t += rep_attr[r]
+            self.last_rep_timers.append(rep_attr)
 
         recv_w = np.asarray(jax.device_get(recv_dev))[:, :n_recv_slots, :]
         recv_np = lanes_to_bytes(recv_w, p.data_size)
@@ -364,7 +391,13 @@ class JaxIciBackend:
 
             return seg
 
-        return [make_segment(c0, c1) for c0, c1 in seg_bounds]
+        segs = [make_segment(c0, c1) for c0, c1 in seg_bounds]
+        # one segment per round in split mode -> its round id, for mapping
+        # measured segment times onto TimerBucket weights; None for the
+        # whole-rep single segment
+        seg_rounds = ([low.round_of_color[c0] for c0, _c1 in seg_bounds]
+                      if split_rounds and len(seg_bounds) > 1 else None)
+        return segs, seg_rounds
 
     # ------------------------------------------------------------------
     def _build_dense(self, p: AggregatorPattern, mesh: Mesh):

@@ -44,6 +44,10 @@ from tpu_aggcomm.backends.lanes import (lane_layout, lanes_to_bytes,
                                         to_lanes)
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import Schedule
+from tpu_aggcomm.harness.attribution import (attribute_rounds,
+                                             attribute_total,
+                                             rank_round_weights,
+                                             tam_rank_weights)
 from tpu_aggcomm.harness.chained import differenced_per_rep
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs, recv_slot_counts
@@ -283,6 +287,20 @@ class JaxSimBackend:
             self._cache[key] = jax.jit(self._one_rep(schedule))
         return self._cache[key]
 
+    def _attr_weights(self, schedule):
+        """Cached attribution weights (harness/attribution.py) — the
+        TimerBucket structure the measured wall times are mapped onto."""
+        from tpu_aggcomm.tam.engine import TamMethod
+        key = (self._key(schedule), "attr")
+        if key not in self._cache:
+            if isinstance(schedule, TamMethod):
+                self._cache[key] = tam_rank_weights(schedule)
+            elif schedule.collective:
+                self._cache[key] = None
+            else:
+                self._cache[key] = rank_round_weights(schedule)
+        return self._cache[key]
+
     # ------------------------------------------------------------------
     def _global_send(self, p: AggregatorPattern, iter_: int) -> np.ndarray:
         """Byte fills viewed in the device lane layout (_words)."""
@@ -326,13 +344,13 @@ class JaxSimBackend:
         timers = [Timer() for _ in range(p.nprocs)]
         self.last_rep_timers = []
         self.last_round_times = []         # [rep] -> [per-round seconds]
+        attr_w = self._attr_weights(schedule)
         if chained:
             per_rep = self.measure_per_rep(schedule)
-            for t in timers:
-                t.total_time = per_rep * ntimes
-            self.last_rep_timers = [
-                [Timer(total_time=per_rep) for _ in range(p.nprocs)]
-                for _ in range(ntimes)]
+            rep_attr = attribute_total(schedule, per_rep, weights=attr_w)
+            for r, t in enumerate(timers):
+                t += Timer.from_array(rep_attr[r].as_array() * ntimes)
+            self.last_rep_timers = [rep_attr for _ in range(ntimes)]
         elif profile_rounds:
             out = self._run_profiled(schedule, send_dev, ntimes, timers,
                                      profiled_segs)
@@ -342,10 +360,10 @@ class JaxSimBackend:
                 out = fn(send_dev)
                 out.block_until_ready()
                 dt = time.perf_counter() - t0
-                for t in timers:
-                    t.total_time += dt
-                self.last_rep_timers.append(
-                    [Timer(total_time=dt) for _ in range(p.nprocs)])
+                rep_attr = attribute_total(schedule, dt, weights=attr_w)
+                for r, t in enumerate(timers):
+                    t += rep_attr[r]
+                self.last_rep_timers.append(rep_attr)
 
         _, n_recv_slots = self._slots(p)
         recv_words = np.asarray(jax.device_get(out))[:, :n_recv_slots, :]
@@ -358,9 +376,9 @@ class JaxSimBackend:
 
     # ------------------------------------------------------------------
     def _round_segments(self, schedule):
-        """Per-round jitted (send, recv) -> recv programs, for profiling.
-        None when the schedule has no round structure to split (dense
-        collective methods and the 3-hop TAM route)."""
+        """Per-round jitted (send, recv) -> recv programs plus their round
+        ids, for profiling. None when the schedule has no round structure
+        to split (dense collective methods and the 3-hop TAM route)."""
         from tpu_aggcomm.tam.engine import TamMethod
         if isinstance(schedule, TamMethod) or schedule.collective:
             return None
@@ -382,30 +400,35 @@ class JaxSimBackend:
 
         segs = [make_seg(srcs, ss, dsts, ds_, barrier_rounds.get(r, 0))
                 for (r, srcs, ss, dsts, ds_) in rounds]
-        self._cache[key] = segs
-        return segs
+        round_ids = [r for (r, *_rest) in rounds]
+        self._cache[key] = (segs, round_ids)
+        return self._cache[key]
 
     def _run_profiled(self, schedule, send_dev, ntimes: int, timers, segs):
         """profile_rounds execution: one dispatch per throttle round, each
         synced and timed — schedule-shape analysis, not headline numbers
         (per-dispatch sync overhead is included, as on jax_ici). Per-round
-        times land in ``last_round_times``; their sum is charged to
-        recv_wait_all_time, mirroring the jax_ici convention."""
+        times land in ``last_round_times`` and are mapped onto each rank's
+        TimerBucket structure (harness/attribution.py): the measured time
+        of round k is split among the post/wait/barrier buckets the rank's
+        ops charge in round k — the fenced-segment approximation of the
+        reference's per-phase MPI_Wtime brackets (mpi_test.c:1768-1815)."""
         p = schedule.pattern
         dev = self._dev()
         _, n_recv_slots = self._slots(p)
         _, jdt, w = self._words(p)
+        attr_w = self._attr_weights(schedule)
 
         if segs is None:
-            segs_run = None
+            segs_run, round_ids = None, None
         else:
+            segs_run, round_ids = segs
             # warm-up compile every segment
             recv_w = jnp.zeros((p.nprocs, n_recv_slots + 1, w), dtype=jdt)
             recv_w = jax.device_put(recv_w, dev)
-            for seg in segs:
+            for seg in segs_run:
                 recv_w = seg(send_dev, recv_w)
             recv_w.block_until_ready()
-            segs_run = segs
 
         out = None
         for _ in range(ntimes):
@@ -416,26 +439,25 @@ class JaxSimBackend:
                 out.block_until_ready()
                 dt = time.perf_counter() - t0
                 self.last_round_times.append([dt])
+                rep_attr = attribute_total(schedule, dt, weights=attr_w)
             else:
                 recv = jax.device_put(
                     jnp.zeros((p.nprocs, n_recv_slots + 1, w), dtype=jdt),
                     dev)
                 round_times = []
-                t0 = time.perf_counter()
                 for seg in segs_run:
                     ts = time.perf_counter()
                     recv = seg(send_dev, recv)
                     recv.block_until_ready()
                     round_times.append(time.perf_counter() - ts)
-                dt = time.perf_counter() - t0
                 out = recv
                 self.last_round_times.append(round_times)
-            for t in timers:
-                t.total_time += dt
-                if segs_run is not None and len(segs_run) > 1:
-                    t.recv_wait_all_time += sum(self.last_round_times[-1])
-            self.last_rep_timers.append(
-                [Timer(total_time=dt) for _ in range(p.nprocs)])
+                rep_attr = attribute_rounds(
+                    schedule, dict(zip(round_ids, round_times)),
+                    weights=attr_w)
+            for r, t in enumerate(timers):
+                t += rep_attr[r]
+            self.last_rep_timers.append(rep_attr)
         return out
 
     # ------------------------------------------------------------------
