@@ -160,6 +160,42 @@ def test_jax_sim_tam_phase_columns():
     assert timers[1].send_wait_all_time == 0.0
 
 
+def test_weights_for_distinguishes_methods():
+    """Regression (round-3 review): m=4 and m=11 lower to the same comm
+    shape but charge different buckets; a reused backend instance must not
+    attribute one method's time with the other's structure."""
+    from tpu_aggcomm.harness.attribution import weights_for
+    p = _pattern()
+    w4 = weights_for(compile_method(4, p))
+    w11 = weights_for(compile_method(11, p))
+    assert w4 != w11
+    t4 = attribute_total(compile_method(4, p), 1.0, weights=w4)
+    t4_fresh = attribute_total(compile_method(4, p), 1.0)
+    for a, b in zip(t4, t4_fresh):
+        assert a == b
+
+
+def test_jax_ici_reused_instance_keeps_method_attribution():
+    """End-to-end collision regression: run m=4 then m=11 on ONE backend
+    (the -m 0 run-all pattern); m=11's attribution must match a fresh
+    instance's."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from tpu_aggcomm.backends.jax_ici import JaxIciBackend
+    b = JaxIciBackend()
+    b.run(compile_method(4, _pattern()), verify=True)
+    _, t_shared = b.run(compile_method(11, _pattern()), verify=True)
+    _, t_fresh = JaxIciBackend().run(compile_method(11, _pattern()),
+                                     verify=True)
+    for a, c in zip(t_shared, t_fresh):
+        for f in ("post_request_time", "send_wait_all_time",
+                  "recv_wait_all_time", "barrier_time"):
+            ra = getattr(a, f) / a.total_time if a.total_time else 0.0
+            rc = getattr(c, f) / c.total_time if c.total_time else 0.0
+            assert np.isclose(ra, rc), (f, ra, rc)
+
+
 def test_jax_ici_phase_columns_nonzero():
     import jax
     if len(jax.devices()) < 8:

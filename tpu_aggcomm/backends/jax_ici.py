@@ -47,9 +47,7 @@ from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import OpKind, Schedule
 from tpu_aggcomm.harness.attribution import (attribute_rounds,
                                              attribute_tam_total,
-                                             attribute_total,
-                                             rank_round_weights,
-                                             tam_rank_weights)
+                                             attribute_total, weights_for)
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs
 
@@ -209,7 +207,7 @@ class JaxIciBackend:
             # per-rank byte-weighted P2/P3/P4 split of each measured rep
             # (harness/attribution.py: intra hops -> recv_wait, inter hop
             # -> send_wait, matching collective_write's brackets)
-            tam_w = tam_rank_weights(schedule)
+            tam_w = weights_for(schedule)
             timers = [Timer() for _ in range(p.nprocs)]
             self.last_rep_timers = []
             for dt in rep_times:
@@ -243,10 +241,7 @@ class JaxIciBackend:
                 self._segment_cache[key] = self._build_ppermute(
                     p, mesh, sharding, low, split_rounds=profile_rounds)
             segments, seg_rounds = self._segment_cache[key]
-            akey = (key, "attr")
-            if akey not in self._segment_cache:
-                self._segment_cache[akey] = rank_round_weights(schedule)
-            attr_w = self._segment_cache[akey]
+            attr_w = weights_for(schedule)
 
         send_g = self._global_send(p, iter_, n_send_slots)
         send_dev = jax.device_put(send_g, sharding)

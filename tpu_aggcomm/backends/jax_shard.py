@@ -1,0 +1,392 @@
+"""Sharded rank-axis backend: B logical ranks per device over a real mesh.
+
+The reference's flagship configuration is 16,384 MPI ranks on 256 nodes
+(script_theta_all_to_many_256.sh:3,11) — far more ranks than any TPU slice
+has chips. jax_sim solves that on ONE chip by carrying the whole rank set
+as an array axis; this backend is the multi-chip generalization
+DISTRIBUTED.md describes ("64 logical ranks per chip, shard_map over the
+rank axis"): the rank axis is sharded over a 1-D device mesh, each device
+owning a contiguous block of ``B = nprocs / ndev`` ranks (the same
+contiguous node map static_node_assignment type 0 fabricates,
+lustre_driver_test.c:359-429 — so a "device" is a "node" of logical
+ranks and inter-device traffic is exactly the inter-node traffic).
+
+Lowering (TPU-idiomatic, not a translation): one throttle round = one
+padded **block all_to_all** over the device axis. On the host we group the
+round's (src, dst) edges by (src device, dst device) block, pad every
+block to the round's max block size M, and build two static index tables:
+
+- ``pack[a, b, j]``  — flat local send index of the j-th message device a
+  ships to device b (-1 = padding, contributes zeros);
+- ``scat[b, a, j]``  — flat local recv index where device b lands the
+  j-th message from device a (trash element for padding).
+
+Each device gathers its outgoing blocks, one ``lax.all_to_all`` exchanges
+them, and a static scatter lands the payload — per round, fenced with
+``lax.optimization_barrier`` so the ``-c`` throttle rounds stay distinct
+program steps (SURVEY.md §7 hard part 2). Reference MPI_Barrier rounds
+become live ``psum`` tokens, as on jax_ici. Traffic per round is the
+round's true message volume times a small padding factor (blocks padded
+to M), never the dense n² — the dense methods (m=5/8 Alltoallw) reuse the
+same machinery as a single round containing every pattern edge.
+
+TAM methods (m=15/16) run the jax_sim 3-hop index-map route jitted with
+rank-axis shardings — XLA's SPMD partitioner inserts the collectives for
+the cross-device gathers (the "annotate shardings, let XLA insert
+collectives" recipe); the explicit two-level engine lives in jax_ici.
+
+Timing: whole-rep wall time, phases filled by the fenced-segment
+attribution (harness/attribution.py), exactly like jax_sim.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_aggcomm.backends.lanes import lane_layout, lanes_to_bytes, to_lanes
+from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
+from tpu_aggcomm.core.schedule import OpKind, Schedule
+from tpu_aggcomm.harness.attribution import attribute_total, weights_for
+from tpu_aggcomm.harness.timer import Timer
+from tpu_aggcomm.harness.verify import make_send_slabs, recv_slot_counts
+
+__all__ = ["JaxShardBackend", "block_round_tables"]
+
+AXIS = "dev"
+
+
+def _schedule_edges(schedule: Schedule) -> np.ndarray:
+    """(src, dst, sslot, dslot, round) int64 rows for every payload edge,
+    with receive slots resolved (vectorized recv_slot_table lookup — the
+    dict walk is O(E) Python either way, but the per-edge joins here are
+    numpy). Collective schedules (m=5/8) synthesize the full pattern as a
+    single round: the Alltoallw's whole exchange is one program step, as
+    in the reference (mpi_test.c:627-645)."""
+    p = schedule.pattern
+    n = p.nprocs
+    if schedule.collective:
+        agg_index = np.asarray(p.agg_index)
+        if p.direction is Direction.ALL_TO_MANY:
+            srcs = np.repeat(np.arange(n), p.cb_nodes)
+            dsts = np.tile(np.asarray(p.rank_list), n)
+            sslots = np.tile(np.arange(p.cb_nodes), n)
+            dslots = srcs
+        else:
+            srcs = np.repeat(np.asarray(p.rank_list), n)
+            dsts = np.tile(np.arange(n), p.cb_nodes)
+            sslots = dsts
+            dslots = agg_index[srcs]
+        rounds = np.zeros(len(srcs), dtype=np.int64)
+        return np.stack([srcs, dsts, sslots, dslots, rounds],
+                        axis=1).astype(np.int64)
+
+    edges = schedule.data_edges()
+    if len(edges) == 0:
+        return edges.reshape(0, 5)
+    rt = schedule.recv_slot_table()
+    keys = np.empty(len(rt), dtype=np.int64)
+    vals = np.empty(len(rt), dtype=np.int64)
+    for i, ((s, d), slot) in enumerate(rt.items()):
+        keys[i] = s * n + d
+        vals[i] = slot
+    order = np.argsort(keys)
+    keys, vals = keys[order], vals[order]
+    ekeys = edges[:, 0] * n + edges[:, 1]
+    pos = np.searchsorted(keys, ekeys)
+    out = edges.copy()
+    out[:, 3] = vals[pos]
+    return out
+
+
+def recv_layout(counts: np.ndarray, ndev: int, bsz: int):
+    """Compacted per-device recv layout: only ranks that receive get rows
+    (all-to-many non-aggregators own zero recv slabs, mpi_test.c:162-202
+    — padding them to nprocs rows each would be 1000x the needed memory
+    at flagship scale). Returns (base, F): ``base[rank]`` = offset of the
+    rank's first row in its device's flat recv buffer (-1 if it receives
+    nothing), ``F`` = uniform per-device buffer length incl. 1 trash row.
+    """
+    n = len(counts)
+    base = np.full(n, -1, dtype=np.int64)
+    F = 1
+    for dev in range(ndev):
+        off = 0
+        for r in range(dev * bsz, min((dev + 1) * bsz, n)):
+            if counts[r]:
+                base[r] = off
+                off += int(counts[r])
+        F = max(F, off + 1)
+    return base, F
+
+
+def block_round_tables(edges: np.ndarray, *, ndev: int, bsz: int,
+                       send_base: np.ndarray, recv_base: np.ndarray,
+                       F: int):
+    """Per-round (pack, scat, M) block tables for the device all_to_all.
+
+    pack: (ndev, ndev, M) flat local-send indices (send_base[src] + sslot,
+    -1 pad); scat: (ndev, ndev, M) flat local-recv indices (recv_base[dst]
+    + dslot), b-major (scat[b, a, j] matches the all_to_all output block
+    from device a), trash = F - 1 for padding. Vectorized group-by, so the
+    flagship edge counts (4M+ edges) stay in numpy.
+    """
+    trash = F - 1
+    out = []
+    if len(edges) == 0:
+        return out
+    n_rounds = int(edges[:, 4].max()) + 1
+    for r in range(n_rounds):
+        sel = edges[edges[:, 4] == r]
+        if len(sel) == 0:
+            continue
+        src, dst, sslot, dslot = sel[:, 0], sel[:, 1], sel[:, 2], sel[:, 3]
+        sdev, ddev = src // bsz, dst // bsz
+        pair = sdev * ndev + ddev
+        order = np.argsort(pair, kind="stable")
+        pair_s = pair[order]
+        counts = np.bincount(pair_s, minlength=ndev * ndev)
+        M = int(counts.max())
+        # position of each edge within its (a, b) block
+        starts = np.zeros(ndev * ndev, dtype=np.int64)
+        np.cumsum(counts[:-1], out=starts[1:])
+        pos = np.arange(len(sel)) - starts[pair_s]
+        pack = np.full((ndev * ndev, M), -1, dtype=np.int32)
+        scat = np.full((ndev * ndev, M), trash, dtype=np.int32)
+        pack[pair_s, pos] = (send_base[src] + sslot)[order]
+        scat[pair_s, pos] = (recv_base[dst] + dslot)[order]
+        pack = pack.reshape(ndev, ndev, M)
+        # b-major view: device b's landing table over source devices a
+        scat = scat.reshape(ndev, ndev, M).transpose(1, 0, 2).copy()
+        out.append((r, pack, scat, M))
+    return out
+
+
+class JaxShardBackend:
+    """Executes schedules with the rank axis sharded over a device mesh."""
+
+    name = "jax_shard"
+
+    def __init__(self, devices=None, ranks_per_device=None):
+        self._devices = devices
+        self._ranks_per_device = ranks_per_device
+        self._cache: dict = {}
+
+    def _mesh(self, nprocs: int) -> tuple[Mesh, int]:
+        from tpu_aggcomm.parallel import host_major_devices
+        devs = host_major_devices(self._devices)
+        if self._ranks_per_device:
+            b = self._ranks_per_device
+            if nprocs % b:
+                raise ValueError(
+                    f"ranks_per_device={b} must divide nprocs={nprocs}")
+            d = nprocs // b
+            if d > len(devs):
+                raise ValueError(
+                    f"nprocs={nprocs} at {b} ranks/device needs {d} "
+                    f"devices, have {len(devs)}")
+        else:
+            d = min(len(devs), nprocs)
+            while nprocs % d:
+                d -= 1
+        return Mesh(np.array(devs[:d]), (AXIS,)), d
+
+    # ------------------------------------------------------------------
+    def _slots(self, p: AggregatorPattern) -> tuple[int, int]:
+        if p.direction is Direction.ALL_TO_MANY:
+            return p.cb_nodes, p.nprocs
+        return p.nprocs, p.cb_nodes
+
+    def _key(self, schedule):
+        barrier_sig = tuple(
+            op.round for op in (schedule.programs[0] if getattr(
+                schedule, "programs", None) else ())
+            if op.kind is OpKind.BARRIER)
+        return (schedule.pattern, schedule.method_id,
+                getattr(schedule, "collective", False), barrier_sig)
+
+    def _barrier_rounds(self, schedule) -> dict[int, int]:
+        barrier_rounds: dict[int, int] = {}
+        if getattr(schedule, "programs", None):
+            for op in schedule.programs[0]:
+                if op.kind is OpKind.BARRIER:
+                    barrier_rounds[op.round] = \
+                        barrier_rounds.get(op.round, 0) + 1
+        return barrier_rounds
+
+    # ------------------------------------------------------------------
+    def _compiled(self, schedule):
+        """(jitted fn, mesh, ndev, bsz, table device arrays)."""
+        from tpu_aggcomm.tam.engine import TamMethod
+
+        key = self._key(schedule)
+        if key in self._cache:
+            return self._cache[key]
+
+        p = schedule.pattern
+        n = p.nprocs
+        mesh, ndev = self._mesh(n)
+        bsz = n // ndev
+        n_send_slots, n_recv_slots = self._slots(p)
+        _, jdt, w = lane_layout(p.data_size)
+        sharding = NamedSharding(mesh, P(AXIS))
+
+        if isinstance(schedule, TamMethod):
+            # XLA-partitioned 3-hop TAM route: same program as jax_sim,
+            # rank axis sharded; SPMD inserts the cross-device collectives
+            from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+            rep = JaxSimBackend()._one_rep(schedule)
+            fn = jax.jit(rep, in_shardings=sharding,
+                         out_shardings=sharding)
+            built = (fn, mesh, ndev, bsz, None)
+            self._cache[key] = built
+            return built
+
+        edges = _schedule_edges(schedule)
+        # compacted flat layouts: only ranks that send/receive get rows
+        # (a dense (n, nprocs)-slot layout would be n^2 at flagship scale)
+        counts = np.asarray(recv_slot_counts(p))
+        recv_base, F = recv_layout(counts, ndev, bsz)
+        if p.direction is Direction.ALL_TO_MANY:
+            scounts = np.full(n, p.cb_nodes, dtype=np.int64)
+        else:
+            scounts = np.where(np.asarray(p.agg_index) >= 0, n, 0)
+        send_base, Fs = recv_layout(scounts, ndev, bsz)
+        tabs = block_round_tables(edges, ndev=ndev, bsz=bsz,
+                                  send_base=send_base,
+                                  recv_base=recv_base, F=F)
+        barrier_rounds = self._barrier_rounds(schedule)
+        kept = {r for (r, *_rest) in tabs}
+        orphans = set(barrier_rounds) - kept
+        if orphans:
+            raise ValueError(
+                f"schedule {schedule.name!r} has barrier-only rounds "
+                f"{sorted(orphans)}; the block lowering cannot represent "
+                f"a standalone fence")
+
+        pack_dev = [jax.device_put(pk, sharding) for (_r, pk, _sc, _m) in tabs]
+        scat_dev = [jax.device_put(sc, sharding) for (_r, _pk, sc, _m) in tabs]
+        round_ids = [r for (r, *_rest) in tabs]
+
+        def local_fn(send, packs, scats):
+            # send: (1, Fs, w) compact flat; packs/scats: (1, ndev, M) each
+            flat_send = send[0]
+            recv = jnp.zeros((F, w), dtype=jdt)
+            for k in range(len(packs)):
+                pk = packs[k][0]            # (ndev, M)
+                sc = scats[k][0]
+                vals = jnp.where(
+                    (pk >= 0)[..., None],
+                    jnp.take(flat_send, jnp.maximum(pk, 0), axis=0),
+                    jnp.zeros((w,), jdt))
+                got = lax.all_to_all(vals, AXIS, 0, 0)   # (ndev, M, w)
+                recv = recv.at[sc.reshape(-1)].set(got.reshape(-1, w))
+                for _ in range(barrier_rounds.get(round_ids[k], 0)):
+                    tok = lax.psum(recv[0, 0].astype(jnp.int32), AXIS)
+                    recv = recv.at[F - 1, 0].set(tok.astype(jdt))
+                if k + 1 < len(packs):
+                    flat_send, recv = lax.optimization_barrier(
+                        (flat_send, recv))
+            return recv[None]
+
+        sm = jax.shard_map(
+            local_fn, mesh=mesh,
+            in_specs=(P(AXIS), [P(AXIS)] * len(tabs), [P(AXIS)] * len(tabs)),
+            out_specs=P(AXIS))
+
+        @jax.jit
+        def fn(send):
+            return sm(send, pack_dev, scat_dev)
+
+        built = (fn, mesh, ndev, bsz, (Fs, send_base, recv_base, counts))
+        self._cache[key] = built
+        return built
+
+    # ------------------------------------------------------------------
+    def _global_send_dense(self, p: AggregatorPattern,
+                           iter_: int) -> np.ndarray:
+        """Dense (nprocs, S, w) layout — only the TAM sharded route uses
+        it (the jax_sim rep addresses ranks by global slab index)."""
+        n_send_slots, _ = self._slots(p)
+        slabs = make_send_slabs(p, iter_)
+        out = np.zeros((p.nprocs, n_send_slots, p.data_size), dtype=np.uint8)
+        for r, s in enumerate(slabs):
+            if s is not None:
+                out[r, :s.shape[0]] = s
+        return to_lanes(out, p.data_size)
+
+    def _global_send_flat(self, p: AggregatorPattern, iter_: int,
+                          ndev: int, bsz: int, send_base: np.ndarray,
+                          Fs: int) -> np.ndarray:
+        """Compact (ndev, Fs, w) layout: each sender's slabs at its
+        send_base offset in its device's flat buffer."""
+        slabs = make_send_slabs(p, iter_)
+        out = np.zeros((ndev, Fs, p.data_size), dtype=np.uint8)
+        for r, s in enumerate(slabs):
+            if s is not None:
+                b = int(send_base[r])
+                out[r // bsz, b:b + s.shape[0]] = s
+        return to_lanes(out, p.data_size)
+
+    def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
+            verify: bool = False):
+        from tpu_aggcomm.tam.engine import TamMethod
+
+        if ntimes < 1:
+            raise ValueError("ntimes must be >= 1")
+        p = schedule.pattern
+        n = p.nprocs
+        n_send_slots, n_recv_slots = self._slots(p)
+        _, jdt, w = lane_layout(p.data_size)
+        fn, mesh, ndev, bsz, extra = self._compiled(schedule)
+        sharding = NamedSharding(mesh, P(AXIS))
+
+        is_tam = isinstance(schedule, TamMethod)
+        if is_tam:
+            send_dev = jax.device_put(self._global_send_dense(p, iter_),
+                                      sharding)
+        else:
+            (Fs, send_base, recv_base, counts) = extra
+            send_dev = jax.device_put(
+                self._global_send_flat(p, iter_, ndev, bsz, send_base, Fs),
+                sharding)
+
+        out = fn(send_dev)
+        out.block_until_ready()            # warm-up compile
+
+        timers = [Timer() for _ in range(n)]
+        self.last_rep_timers = []
+        attr_w = weights_for(schedule)
+        for _ in range(ntimes):
+            t0 = time.perf_counter()
+            out = fn(send_dev)
+            out.block_until_ready()
+            dt = time.perf_counter() - t0
+            rep_attr = attribute_total(schedule, dt, weights=attr_w)
+            for r, t in enumerate(timers):
+                t += rep_attr[r]
+            self.last_rep_timers.append(rep_attr)
+
+        got = np.asarray(jax.device_get(out))
+        if is_tam:
+            recv_np = lanes_to_bytes(got[:, :n_recv_slots, :], p.data_size)
+            counts = recv_slot_counts(p)
+            recv_bufs = [recv_np[r] if counts[r] else None
+                         for r in range(n)]
+        else:
+            got_b = lanes_to_bytes(got, p.data_size)     # (ndev, F, ds)
+            recv_bufs = [
+                got_b[r // bsz,
+                      int(recv_base[r]):int(recv_base[r]) + int(counts[r])]
+                if counts[r] else None
+                for r in range(n)]
+        if verify:
+            from tpu_aggcomm.harness.verify import verify_recv
+            verify_recv(p, recv_bufs, iter_)
+        return recv_bufs, timers

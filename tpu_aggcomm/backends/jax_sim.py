@@ -45,9 +45,7 @@ from tpu_aggcomm.backends.lanes import (lane_layout, lanes_to_bytes,
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import Schedule
 from tpu_aggcomm.harness.attribution import (attribute_rounds,
-                                             attribute_total,
-                                             rank_round_weights,
-                                             tam_rank_weights)
+                                             attribute_total, weights_for)
 from tpu_aggcomm.harness.chained import differenced_per_rep
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs, recv_slot_counts
@@ -288,18 +286,9 @@ class JaxSimBackend:
         return self._cache[key]
 
     def _attr_weights(self, schedule):
-        """Cached attribution weights (harness/attribution.py) — the
-        TimerBucket structure the measured wall times are mapped onto."""
-        from tpu_aggcomm.tam.engine import TamMethod
-        key = (self._key(schedule), "attr")
-        if key not in self._cache:
-            if isinstance(schedule, TamMethod):
-                self._cache[key] = tam_rank_weights(schedule)
-            elif schedule.collective:
-                self._cache[key] = None
-            else:
-                self._cache[key] = rank_round_weights(schedule)
-        return self._cache[key]
+        """Attribution weights (harness/attribution.py) — the TimerBucket
+        structure the measured wall times are mapped onto."""
+        return weights_for(schedule)
 
     # ------------------------------------------------------------------
     def _global_send(self, p: AggregatorPattern, iter_: int) -> np.ndarray:

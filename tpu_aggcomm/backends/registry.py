@@ -2,10 +2,11 @@
 
 from __future__ import annotations
 
-__all__ = ["BACKENDS", "DEVICE_FREE_BACKENDS", "SINGLE_DEVICE_BACKENDS",
-           "get_backend"]
+__all__ = ["BACKENDS", "DEVICE_FREE_BACKENDS", "SHARDED_RANK_BACKENDS",
+           "SINGLE_DEVICE_BACKENDS", "get_backend"]
 
-BACKENDS = ("local", "jax_ici", "jax_sim", "pallas_dma", "native")
+BACKENDS = ("local", "jax_ici", "jax_sim", "jax_shard", "pallas_dma",
+            "native")
 
 # backends that execute without accelerator devices (pure host runtimes)
 DEVICE_FREE_BACKENDS = ("local", "native")
@@ -13,6 +14,10 @@ DEVICE_FREE_BACKENDS = ("local", "native")
 # backends that carry the whole rank set on ONE device (rank count is free,
 # not bounded by the visible device count)
 SINGLE_DEVICE_BACKENDS = ("jax_sim",)
+
+# backends that carry MANY logical ranks per device (rank count bounded by
+# memory, not the device count — the flagship-scale tier, DISTRIBUTED.md)
+SHARDED_RANK_BACKENDS = ("jax_shard",)
 
 
 def get_backend(name: str):
@@ -26,6 +31,9 @@ def get_backend(name: str):
         if name == "jax_sim":
             from tpu_aggcomm.backends.jax_sim import JaxSimBackend
             return JaxSimBackend()
+        if name == "jax_shard":
+            from tpu_aggcomm.backends.jax_shard import JaxShardBackend
+            return JaxShardBackend()
         if name == "pallas_dma":
             from tpu_aggcomm.backends.pallas_dma import PallasDmaBackend
             return PallasDmaBackend()

@@ -18,6 +18,7 @@ import argparse
 import sys
 
 from tpu_aggcomm.backends.registry import (BACKENDS, DEVICE_FREE_BACKENDS,
+                                           SHARDED_RANK_BACKENDS,
                                            SINGLE_DEVICE_BACKENDS)
 
 __all__ = ["main", "build_parser"]
@@ -261,7 +262,9 @@ def _default_nprocs(backend: str) -> int:
     """Rank count when -n is omitted: the reference README example's 32 for
     backends that do not need one device per rank, the visible device count
     otherwise."""
-    if backend in DEVICE_FREE_BACKENDS or backend in SINGLE_DEVICE_BACKENDS:
+    if (backend in DEVICE_FREE_BACKENDS
+            or backend in SINGLE_DEVICE_BACKENDS
+            or backend in SHARDED_RANK_BACKENDS):
         return 32
     import jax
     return len(jax.devices())

@@ -54,7 +54,8 @@ from tpu_aggcomm.core.schedule import OpKind, Schedule, TimerBucket
 from tpu_aggcomm.harness.timer import Timer
 
 __all__ = ["POST_COST_BYTES", "attribute_total", "attribute_rounds",
-           "rank_round_weights", "tam_rank_weights", "attribute_tam_total"]
+           "rank_round_weights", "tam_rank_weights", "attribute_tam_total",
+           "weights_for"]
 
 #: Per-call overhead of posting one nonblocking op / one pure-sync wait /
 #: one barrier, expressed in byte-equivalents of transfer time. See module
@@ -98,6 +99,35 @@ def rank_round_weights(schedule: Schedule):
             acc[key] = acc.get(key, 0.0) + w
         out.append(acc)
     return out
+
+
+_WEIGHT_CACHE: dict = {}
+
+
+def weights_for(schedule):
+    """Cached attribution weights for a schedule — THE one place that
+    dispatches between the TAM byte-split, collective total-only (None),
+    and op-program weights, and the one place that owns the cache-key
+    contract: (pattern, method_id, collective, barrier signature). The
+    method id is load-bearing — methods can lower to identical comm
+    shapes while charging different buckets (e.g. m=4 vs m=11), so a
+    shape-only key would silently attribute one method's time with
+    another's structure."""
+    if getattr(schedule, "assignment", None) is not None:
+        key = (schedule.pattern, schedule.method_id, "tam")
+        if key not in _WEIGHT_CACHE:
+            _WEIGHT_CACHE[key] = tam_rank_weights(schedule)
+        return _WEIGHT_CACHE[key]
+    if schedule.collective:
+        return None
+    barrier_sig = tuple(
+        op.round for op in (schedule.programs[0] if schedule.programs else ())
+        if op.kind is OpKind.BARRIER)
+    key = (schedule.pattern, schedule.method_id, schedule.collective,
+           barrier_sig)
+    if key not in _WEIGHT_CACHE:
+        _WEIGHT_CACHE[key] = rank_round_weights(schedule)
+    return _WEIGHT_CACHE[key]
 
 
 def attribute_total(schedule, total_seconds: float,
