@@ -271,3 +271,69 @@ def test_cw3_shared_jax_rejects_non_local_destination():
     meta = aggregator_meta_information(na, wl.aggregators, 1, 0)  # mode 0
     with pytest.raises(ValueError, match="local aggregators"):
         cw3_shared_jax(wl, na, meta, jax.devices())
+
+
+# ---------------------------------------------------------------------------
+# mutation tests: the oracles route REAL bytes (VERDICT r2 item 6) — a
+# corrupted staged payload must surface as a VerificationError, proving
+# delivery reads the staging structures instead of re-filling
+
+
+def _flip_first_byte(arr: np.ndarray) -> None:
+    arr[0] ^= 0xFF
+
+
+def test_proxy_mutation_caught():
+    na, wl = _mk(nprocs=8, per_node=4, stripe=StripeType.SAME)
+
+    def corrupt(holdings):
+        # one staged run at node 0's proxy, between P2 and P3
+        _src, _dst, payload = holdings[0][0]
+        _flip_first_byte(payload)
+
+    recv, _ = cw_proxy(wl, na, corrupt_hook=corrupt)
+    with pytest.raises(VerificationError):
+        wl.verify_all(recv)
+
+
+def test_local_agg_mutation_caught():
+    na, wl = _mk(nprocs=8, per_node=4, stripe=StripeType.GREATER)
+    meta = aggregator_meta_information(na, wl.aggregators, 2, 0)
+
+    def corrupt(staged):
+        agg = next(iter(staged))
+        src = next(iter(staged[agg]))
+        dst = next(iter(staged[agg][src]))
+        _flip_first_byte(staged[agg][src][dst])
+
+    recv, _ = cw2_local_agg(wl, na, meta, corrupt_hook=corrupt)
+    with pytest.raises(VerificationError):
+        wl.verify_all(recv)
+
+
+def test_shared_mutation_caught():
+    na, wl = _mk(nprocs=8, per_node=4, stripe=StripeType.SAME)
+    meta = aggregator_meta_information(na, wl.aggregators, 4, 1)
+
+    def corrupt(windows):
+        agg = next(iter(windows))
+        src = next(iter(windows[agg]))
+        dst = next(iter(windows[agg][src]))
+        _flip_first_byte(windows[agg][src][dst])
+
+    recv, _ = cw3_shared(wl, na, meta, corrupt_hook=corrupt)
+    with pytest.raises(VerificationError):
+        wl.verify_all(recv)
+
+
+def test_uncorrupted_oracles_still_verify():
+    """The staging rewire changes no delivered byte."""
+    na, wl = _mk(nprocs=8, per_node=4, stripe=StripeType.SAME)
+    recv, _ = cw_proxy(wl, na)
+    wl.verify_all(recv)
+    meta = aggregator_meta_information(na, wl.aggregators, 2, 0)
+    recv, _ = cw2_local_agg(wl, na, meta)
+    wl.verify_all(recv)
+    meta1 = aggregator_meta_information(na, wl.aggregators, 4, 1)
+    recv, _ = cw3_shared(wl, na, meta1, corrupt_hook=None)
+    wl.verify_all(recv)

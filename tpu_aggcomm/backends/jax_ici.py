@@ -126,11 +126,8 @@ def lower_schedule(schedule: Schedule) -> _Lowered:
             sslots.append(ss)
             rslots.append(rs)
 
-    barrier_rounds: dict[int, int] = {}
-    if schedule.programs:
-        for op in schedule.programs[0]:  # SPMD-symmetric barrier structure
-            if op.kind is OpKind.BARRIER:
-                barrier_rounds[op.round] = barrier_rounds.get(op.round, 0) + 1
+    from tpu_aggcomm.core.schedule import barrier_rounds_of
+    barrier_rounds = barrier_rounds_of(schedule)
 
     return _Lowered(
         perms=perms,

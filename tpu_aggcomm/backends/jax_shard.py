@@ -52,7 +52,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_aggcomm.backends.lanes import lane_layout, lanes_to_bytes, to_lanes
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
-from tpu_aggcomm.core.schedule import OpKind, Schedule
+from tpu_aggcomm.core.schedule import (Schedule, barrier_rounds_of,
+                                       schedule_shape_key)
 from tpu_aggcomm.harness.attribution import attribute_total, weights_for
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs, recv_slot_counts
@@ -204,21 +205,7 @@ class JaxShardBackend:
         return p.nprocs, p.cb_nodes
 
     def _key(self, schedule):
-        barrier_sig = tuple(
-            op.round for op in (schedule.programs[0] if getattr(
-                schedule, "programs", None) else ())
-            if op.kind is OpKind.BARRIER)
-        return (schedule.pattern, schedule.method_id,
-                getattr(schedule, "collective", False), barrier_sig)
-
-    def _barrier_rounds(self, schedule) -> dict[int, int]:
-        barrier_rounds: dict[int, int] = {}
-        if getattr(schedule, "programs", None):
-            for op in schedule.programs[0]:
-                if op.kind is OpKind.BARRIER:
-                    barrier_rounds[op.round] = \
-                        barrier_rounds.get(op.round, 0) + 1
-        return barrier_rounds
+        return schedule_shape_key(schedule)
 
     # ------------------------------------------------------------------
     def _compiled(self, schedule):
@@ -261,7 +248,7 @@ class JaxShardBackend:
         tabs = block_round_tables(edges, ndev=ndev, bsz=bsz,
                                   send_base=send_base,
                                   recv_base=recv_base, F=F)
-        barrier_rounds = self._barrier_rounds(schedule)
+        barrier_rounds = barrier_rounds_of(schedule)
         kept = {r for (r, *_rest) in tabs}
         orphans = set(barrier_rounds) - kept
         if orphans:
@@ -309,18 +296,6 @@ class JaxShardBackend:
         return built
 
     # ------------------------------------------------------------------
-    def _global_send_dense(self, p: AggregatorPattern,
-                           iter_: int) -> np.ndarray:
-        """Dense (nprocs, S, w) layout — only the TAM sharded route uses
-        it (the jax_sim rep addresses ranks by global slab index)."""
-        n_send_slots, _ = self._slots(p)
-        slabs = make_send_slabs(p, iter_)
-        out = np.zeros((p.nprocs, n_send_slots, p.data_size), dtype=np.uint8)
-        for r, s in enumerate(slabs):
-            if s is not None:
-                out[r, :s.shape[0]] = s
-        return to_lanes(out, p.data_size)
-
     def _global_send_flat(self, p: AggregatorPattern, iter_: int,
                           ndev: int, bsz: int, send_base: np.ndarray,
                           Fs: int) -> np.ndarray:
@@ -349,8 +324,8 @@ class JaxShardBackend:
 
         is_tam = isinstance(schedule, TamMethod)
         if is_tam:
-            send_dev = jax.device_put(self._global_send_dense(p, iter_),
-                                      sharding)
+            from tpu_aggcomm.backends.jax_sim import dense_send_lanes
+            send_dev = jax.device_put(dense_send_lanes(p, iter_), sharding)
         else:
             (Fs, send_base, recv_base, counts) = extra
             send_dev = jax.device_put(

@@ -50,7 +50,21 @@ from tpu_aggcomm.harness.chained import differenced_per_rep
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs, recv_slot_counts
 
-__all__ = ["JaxSimBackend"]
+__all__ = ["JaxSimBackend", "dense_send_lanes"]
+
+
+def dense_send_lanes(p: AggregatorPattern, iter_: int) -> np.ndarray:
+    """Dense (nprocs, n_send_slots, w) send payload in the device lane
+    layout — the global-slab-index addressing the rank-axis reps use
+    (shared with jax_shard's TAM route, which runs the same rep)."""
+    n_send_slots = (p.cb_nodes if p.direction is Direction.ALL_TO_MANY
+                    else p.nprocs)
+    slabs = make_send_slabs(p, iter_)
+    out = np.zeros((p.nprocs, n_send_slots, p.data_size), dtype=np.uint8)
+    for r, s in enumerate(slabs):
+        if s is not None:
+            out[r, :s.shape[0]] = s
+    return to_lanes(out, p.data_size)
 
 
 def _round_tables(schedule: Schedule):
@@ -79,11 +93,8 @@ def _round_tables(schedule: Schedule):
                            for s, d in zip(srcs, dsts)], dtype=np.int32)
         rounds.append((r, srcs, sslots, dsts, dslots))
 
-    barrier_rounds: dict[int, int] = {}
-    if schedule.programs:
-        for op in schedule.programs[0]:  # SPMD-symmetric barrier structure
-            if op.kind is OpKind.BARRIER:
-                barrier_rounds[op.round] = barrier_rounds.get(op.round, 0) + 1
+    from tpu_aggcomm.core.schedule import barrier_rounds_of
+    barrier_rounds = barrier_rounds_of(schedule)
     # every METHODS generator attaches barriers to rounds that also move
     # data; a barrier-only round would be silently dropped by the data-edge
     # loop above and its fence lost — fail loudly instead (ADVICE r1)
@@ -268,16 +279,8 @@ class JaxSimBackend:
         return rep
 
     def _key(self, schedule):
-        # barrier placement is the one schedule-shape input not captured by
-        # (pattern, method_id): m=13's -b modes compile different programs
-        # from the same pattern, and they must not share a cache entry
-        from tpu_aggcomm.core.schedule import OpKind
-        barrier_sig = tuple(
-            op.round for op in (schedule.programs[0] if getattr(
-                schedule, "programs", None) else ())
-            if op.kind is OpKind.BARRIER)
-        return (schedule.pattern, schedule.method_id, schedule.collective,
-                barrier_sig)
+        from tpu_aggcomm.core.schedule import schedule_shape_key
+        return schedule_shape_key(schedule)
 
     def _compiled(self, schedule: Schedule):
         key = self._key(schedule)
@@ -292,14 +295,7 @@ class JaxSimBackend:
 
     # ------------------------------------------------------------------
     def _global_send(self, p: AggregatorPattern, iter_: int) -> np.ndarray:
-        """Byte fills viewed in the device lane layout (_words)."""
-        n_send_slots, _ = self._slots(p)
-        slabs = make_send_slabs(p, iter_)
-        out = np.zeros((p.nprocs, n_send_slots, p.data_size), dtype=np.uint8)
-        for r, s in enumerate(slabs):
-            if s is not None:
-                out[r, :s.shape[0]] = s
-        return to_lanes(out, p.data_size)
+        return dense_send_lanes(p, iter_)
 
     def _to_bytes(self, p: AggregatorPattern, arr: np.ndarray) -> np.ndarray:
         """Device lane layout back to the byte layout the verifier speaks."""

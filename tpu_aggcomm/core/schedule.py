@@ -36,7 +36,35 @@ import numpy as np
 
 from tpu_aggcomm.core.pattern import AggregatorPattern
 
-__all__ = ["OpKind", "Op", "Schedule", "TimerBucket"]
+__all__ = ["OpKind", "Op", "Schedule", "TimerBucket", "barrier_rounds_of",
+           "schedule_shape_key"]
+
+
+def barrier_rounds_of(schedule) -> dict:
+    """round -> number of MPI_Barrier ops in it, read from rank 0's
+    program (barrier structure is SPMD-symmetric in every method)."""
+    progs = getattr(schedule, "programs", None)
+    out: dict[int, int] = {}
+    for op in (progs[0] if progs else ()):
+        if op.kind is OpKind.BARRIER:
+            out[op.round] = out.get(op.round, 0) + 1
+    return out
+
+
+def schedule_shape_key(schedule) -> tuple:
+    """THE cache-key contract for anything derived from a schedule's shape
+    (compiled programs, attribution weights): ``(pattern, method_id,
+    collective, barrier signature)``. The method id is load-bearing —
+    methods can lower to identical comm shapes while charging different
+    timer buckets (m=4 vs m=11); the barrier signature is the one
+    schedule-shape input not captured by (pattern, method_id): m=13's
+    ``-b`` modes compile different programs from the same pattern."""
+    progs = getattr(schedule, "programs", None)
+    barrier_sig = tuple(
+        op.round for op in (progs[0] if progs else ())
+        if op.kind is OpKind.BARRIER)
+    return (schedule.pattern, schedule.method_id,
+            getattr(schedule, "collective", False), barrier_sig)
 
 
 class OpKind(enum.IntEnum):

@@ -28,8 +28,12 @@ weight to its bucket:
 
 A measured time is then split per rank proportionally to that rank's
 weights (per round when per-round segment times are available, over the
-whole program otherwise), so every rank's phase columns sum exactly to the
-measured total — and ops the reference leaves untimed (TimerBucket.NONE,
+whole program otherwise). A rank's phase columns sum to the measured
+total, with one reference-faithful exception: RECV_AND_SEND_WAIT ops
+charge their share to BOTH wait columns (the reference brackets a
+non-aggregator's Waitall once and adds it to both fields,
+mpi_test.c:1505-1510), so those ranks' column sums can exceed total —
+never undershoot. Ops the reference leaves untimed (TimerBucket.NONE,
 e.g. m=7 senders' blocking Sends, mpi_test.c:1055-1114) stay zero here
 too, exactly like the reference CSVs.
 
@@ -107,12 +111,11 @@ _WEIGHT_CACHE: dict = {}
 def weights_for(schedule):
     """Cached attribution weights for a schedule — THE one place that
     dispatches between the TAM byte-split, collective total-only (None),
-    and op-program weights, and the one place that owns the cache-key
-    contract: (pattern, method_id, collective, barrier signature). The
-    method id is load-bearing — methods can lower to identical comm
-    shapes while charging different buckets (e.g. m=4 vs m=11), so a
-    shape-only key would silently attribute one method's time with
-    another's structure."""
+    and op-program weights. Keyed by :func:`schedule_shape_key` (the
+    shared cache-key contract — a shape-only key would silently attribute
+    one method's time with another's bucket structure, e.g. m=4 vs m=11,
+    which lower identically but charge different buckets)."""
+    from tpu_aggcomm.core.schedule import schedule_shape_key
     if getattr(schedule, "assignment", None) is not None:
         key = (schedule.pattern, schedule.method_id, "tam")
         if key not in _WEIGHT_CACHE:
@@ -120,11 +123,7 @@ def weights_for(schedule):
         return _WEIGHT_CACHE[key]
     if schedule.collective:
         return None
-    barrier_sig = tuple(
-        op.round for op in (schedule.programs[0] if schedule.programs else ())
-        if op.kind is OpKind.BARRIER)
-    key = (schedule.pattern, schedule.method_id, schedule.collective,
-           barrier_sig)
+    key = schedule_shape_key(schedule)
     if key not in _WEIGHT_CACHE:
         _WEIGHT_CACHE[key] = rank_round_weights(schedule)
     return _WEIGHT_CACHE[key]

@@ -52,6 +52,12 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
     if cfg.chained and cfg.profile_rounds:
         raise ValueError("--chained and --profile-rounds are exclusive "
                          "(one program vs per-round programs)")
+    if cfg.profile_rounds and cfg.backend not in ("jax_ici", "jax_sim"):
+        raise ValueError(
+            "--profile-rounds requires --backend jax_ici or jax_sim "
+            "(per-round fenced segments exist only there; local/native "
+            "time each op directly, jax_shard/pallas_dma attribute "
+            "whole-rep time)")
     backend = get_backend(cfg.backend)
     pattern = AggregatorPattern(
         nprocs=cfg.nprocs, cb_nodes=cfg.cb_nodes,

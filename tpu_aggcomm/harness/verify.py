@@ -109,15 +109,16 @@ def verify_recv(p: AggregatorPattern, recv_bufs: list[np.ndarray | None],
         exp_all = ((np.asarray(p.rank_list)[None, :, None]
                     + ranks[:, None, None] + iter_ + ar) % 256
                    ).astype(np.uint8)         # (nprocs, cb_nodes, size)
-        missing = [r for r in range(p.nprocs) if recv_bufs[r] is None]
-        if missing:
-            raise VerificationError(
-                f"rank {missing[0]}: expected recv data, got none")
+        exp_shape = exp_all.shape[1:]
+        for r in range(p.nprocs):
+            if recv_bufs[r] is None:
+                raise VerificationError(
+                    f"rank {r}: expected recv data, got none")
+            if recv_bufs[r].shape != exp_shape:
+                raise VerificationError(
+                    f"rank {r}: recv shape {recv_bufs[r].shape} != "
+                    f"expected {exp_shape}")
         got_all = np.stack(recv_bufs)
-        if got_all.shape != exp_all.shape:
-            raise VerificationError(
-                f"recv shape {got_all.shape[1:]} != expected "
-                f"{exp_all.shape[1:]}")
         ok = (got_all == exp_all).all(axis=2)
         if not ok.all():
             rank, s = (int(x) for x in np.argwhere(~ok)[0])
