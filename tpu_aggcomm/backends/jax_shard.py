@@ -200,9 +200,8 @@ class JaxShardBackend:
 
     # ------------------------------------------------------------------
     def _slots(self, p: AggregatorPattern) -> tuple[int, int]:
-        if p.direction is Direction.ALL_TO_MANY:
-            return p.cb_nodes, p.nprocs
-        return p.nprocs, p.cb_nodes
+        from tpu_aggcomm.harness.verify import slot_shapes
+        return slot_shapes(p)
 
     def _key(self, schedule):
         return schedule_shape_key(schedule)

@@ -57,8 +57,8 @@ def dense_send_lanes(p: AggregatorPattern, iter_: int) -> np.ndarray:
     """Dense (nprocs, n_send_slots, w) send payload in the device lane
     layout — the global-slab-index addressing the rank-axis reps use
     (shared with jax_shard's TAM route, which runs the same rep)."""
-    n_send_slots = (p.cb_nodes if p.direction is Direction.ALL_TO_MANY
-                    else p.nprocs)
+    from tpu_aggcomm.harness.verify import slot_shapes
+    n_send_slots, _ = slot_shapes(p)
     slabs = make_send_slabs(p, iter_)
     out = np.zeros((p.nprocs, n_send_slots, p.data_size), dtype=np.uint8)
     for r, s in enumerate(slabs):
@@ -194,9 +194,8 @@ class JaxSimBackend:
 
     # ------------------------------------------------------------------
     def _slots(self, p: AggregatorPattern) -> tuple[int, int]:
-        if p.direction is Direction.ALL_TO_MANY:
-            return p.cb_nodes, p.nprocs       # (send slots, recv slots)
-        return p.nprocs, p.cb_nodes
+        from tpu_aggcomm.harness.verify import slot_shapes
+        return slot_shapes(p)
 
     @staticmethod
     def _words(p: AggregatorPattern):

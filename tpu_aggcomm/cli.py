@@ -118,6 +118,13 @@ def build_parser() -> argparse.ArgumentParser:
     tam.add_argument("--chained", action="store_true",
                      help="engine sim only: serial-chained differenced "
                           "per-rep timing (honest through the TPU tunnel)")
+    tam.add_argument("--reorder", action="store_true",
+                     help="apply reorder_ranklist before the engine: deal "
+                          "the destination list round-robin across nodes "
+                          "so consecutive destinations sit on distinct "
+                          "nodes (the reference driver's commented-out "
+                          "flow, lustre_driver_test.c:1495-1499 — an "
+                          "optional extension, not dispatched there)")
 
     # sweep — the Theta job scripts (script_theta_*.sh:33-106)
     sw = sub.add_parser(
@@ -186,11 +193,25 @@ def _run_tam(args) -> int:
     na = static_node_assignment(args.nprocs, args.proc_node,
                                 args.rank_assignment)
     wl = initialize_setting(na, args.blocklen, StripeType(args.stripe))
-    meta = aggregator_meta_information(na, wl.aggregators, args.co, args.mode)
-    # the reference's rank-0 config banner (l_d_t.c:1455-1457)
+    # the reference's rank-0 config banner — FIRST line, byte-identical
+    # to the DEBUG driver's printf (l_d_t.c:1454)
     print(f"blocklen = {args.blocklen}, nprocs_node = {args.proc_node}, "
           f"rank_assignment = {args.rank_assignment}, type = {args.stripe}, "
           f"co = {args.co}")
+    if getattr(args, "reorder", False):
+        # reorder_ranklist before the engine (the reference driver's
+        # commented-out call site, l_d_t.c:1495-1499): same destination
+        # SET, node-interleaved ORDER — engines must handle an unsorted
+        # destination list; the round-robin deal is what the reference's
+        # I/O phase would use to balance file domains across nodes
+        from dataclasses import replace as _replace
+
+        from tpu_aggcomm.core.pattern import reorder_ranklist
+        new_order = reorder_ranklist(na.node_of, wl.aggregators, na.nnodes)
+        wl = _replace(wl, aggregators=new_order)
+        print(f"| reordered aggregators = "
+              f"{', '.join(str(int(r)) for r in new_order)}")
+    meta = aggregator_meta_information(na, wl.aggregators, args.co, args.mode)
     print(f"| nprocs = {args.nprocs}, nodes = {na.nnodes}, "
           f"aggregators = {len(wl.aggregators)}, "
           f"local aggregators = {len(meta.local_aggregators)}, "

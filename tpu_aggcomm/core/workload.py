@@ -60,7 +60,9 @@ class Workload:
     nprocs: int
     blocklen: int
     stripe: StripeType
-    aggregators: np.ndarray = field(repr=False)  # sorted destination ranks
+    aggregators: np.ndarray = field(repr=False)  # destination ranks; order =
+    # file-domain order (ascending from initialize_setting; node-interleaved
+    # after reorder_ranklist — engines must not assume sortedness)
 
     def __post_init__(self):
         if self.blocklen < 1:

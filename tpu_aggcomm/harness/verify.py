@@ -20,7 +20,18 @@ import numpy as np
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 
 __all__ = ["fill_slab", "expected_recv", "make_send_slabs", "verify_recv",
-           "recv_slot_counts", "fill_slab_tam", "VerificationError"]
+           "recv_slot_counts", "slot_shapes", "fill_slab_tam",
+           "VerificationError"]
+
+
+def slot_shapes(p: "AggregatorPattern") -> tuple[int, int]:
+    """(n_send_slots, n_recv_slots) per rank — THE single definition of the
+    slab-matrix shapes (prepare_* analog, mpi_test.c:94-133/162-202):
+    all-to-many ranks send cb_nodes slabs and aggregators receive nprocs;
+    many-to-all aggregators send nprocs slabs and ranks receive cb_nodes."""
+    if p.direction is Direction.ALL_TO_MANY:
+        return p.cb_nodes, p.nprocs
+    return p.nprocs, p.cb_nodes
 
 
 def recv_slot_counts(p: "AggregatorPattern") -> list[int]:
