@@ -155,12 +155,18 @@ class PallasDmaBackend:
             schedule, mesh, interpret)
 
         # slab arenas padded to the DMA row size; one extra dummy row at the
-        # end feeds the uniform self-loop steps
+        # end feeds the uniform self-loop steps. Each slab row is shaped
+        # (4, pds/4) so the tiled trailing dims are always copied WHOLE and
+        # the dynamic slot index lands on an untiled leading dim — Mosaic
+        # rejects dynamic slices of the sublane dim and slice sizes not
+        # aligned to the i8 tiling (4, 128) (both surfaced by the first
+        # compiled v5e runs; interpret mode accepts anything)
         slabs = make_send_slabs(p, iter_)
         send_g = np.zeros((n, n_send_slots + 1, pds), dtype=np.uint8)
         for r, s in enumerate(slabs):
             if s is not None:
                 send_g[r, :s.shape[0], :p.data_size] = s
+        send_g = send_g.reshape(n, n_send_slots + 1, 4, pds // 4)
         send_dev = jax.device_put(send_g, sharding)
         tab_devs = [jax.device_put(t, sharding) for t in tabs]
 
@@ -183,8 +189,9 @@ class PallasDmaBackend:
                 t += rep_attr[r]
             self.last_rep_timers.append(rep_attr)
 
-        recv_np = np.asarray(jax.device_get(out))[:, :n_recv_slots,
-                                                  :p.data_size]
+        recv_w = np.asarray(jax.device_get(out))
+        recv_np = recv_w.reshape(n, recv_w.shape[1], -1)[:, :n_recv_slots,
+                                                         :p.data_size]
         counts = recv_slot_counts(p)
         recv_bufs = [recv_np[r] if counts[r] else None for r in range(n)]
         if verify:
@@ -274,9 +281,13 @@ class PallasDmaBackend:
 
         R1 = n_recv_slots + 1
 
-        def kernel(dst_r, src_r, sslot_r, rslot_r, send_r, recv_r,
+        def kernel(dst_r, src_r, sslot_r, rslot_r, send_r, recv0_r, recv_r,
                    ssem, rsem):
-            recv_r[...] = jnp.zeros((1, R1, pds), jnp.uint8)
+            # recv_r aliases the zero-initialized recv0 input — Mosaic
+            # forbids direct stores into ANY-space refs (first compiled-on-
+            # TPU run surfaced this; interpret mode had allowed it), so the
+            # zeroing happens in XLA before the kernel
+            del recv0_r
             for st in range(NS):
                 rdma = pltpu.make_async_remote_copy(
                     src_ref=send_r.at[0, pl.ds(sslot_r[0, st], 1)],
@@ -298,18 +309,25 @@ class PallasDmaBackend:
                 rdma_in.wait_recv()
 
         def outer(send, dst_a, src_a, sslot_a, rslot_a):
+            recv0 = jnp.zeros((1, R1, 4, pds // 4), jnp.uint8)
             return pl.pallas_call(
                 kernel,
-                out_shape=jax.ShapeDtypeStruct((1, R1, pds), jnp.uint8),
+                out_shape=jax.ShapeDtypeStruct((1, R1, 4, pds // 4),
+                                               jnp.uint8),
                 in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 4
-                + [pl.BlockSpec(memory_space=pl.ANY)],
+                + [pl.BlockSpec(memory_space=pl.ANY)] * 2,
                 out_specs=pl.BlockSpec(memory_space=pl.ANY),
                 scratch_shapes=[pltpu.SemaphoreType.DMA,
                                 pltpu.SemaphoreType.DMA],
+                # collective_id coordinates the cross-chip barrier at kernel
+                # entry; Mosaic rejects it on a single-device mesh (no
+                # custom barrier there — surfaced by the compiled v5e run)
                 compiler_params=pltpu.CompilerParams(
-                    has_side_effects=True, collective_id=0),
+                    has_side_effects=True,
+                    collective_id=0 if n > 1 else None),
+                input_output_aliases={5: 0},
                 interpret=interpret,
-            )(dst_a, src_a, sslot_a, rslot_a, send)
+            )(dst_a, src_a, sslot_a, rslot_a, send, recv0)
 
         sm = jax.shard_map(outer, mesh=mesh,
                            in_specs=(P(AXIS),) * 5, out_specs=P(AXIS),
