@@ -1,0 +1,71 @@
+"""Quiet-chip TPU sweeps toward the flagship scale (VERDICT r2 item 2).
+
+Runs, on the ONE tunneled v5e chip with ``jax_sim --chained --verify``:
+
+- the n=32 a=14 Theta grid (quiet re-run of the r2 noisy table),
+- n=256 a=16 and n=1024 a=64 Theta-shaped grids, d=2048,
+
+printing each cell as it completes plus the µs/rep + GB/s scaling
+summary for RESULTS_TPU.md.
+
+One process, strictly serial — two TPU clients skew differenced
+numbers 2-7x (CLAUDE.md). Cells print as they finish, so a killed run
+still yields its completed cells from the log.
+"""
+
+import sys
+import time
+
+
+GRIDS = [
+    # (nprocs, cb_nodes, methods, comm_sizes)
+    (32, 14, (1, 2), (1, 2, 4, 8, 16, 32, 999_999_999)),
+    (256, 16, (1, 2), (1, 4, 16, 64, 128, 256, 999_999_999)),
+    (1024, 64, (1, 2), (1, 16, 128, 512, 1024, 999_999_999)),
+]
+D = 2048
+
+
+def main() -> int:
+    import jax
+
+    from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({dev.platform})", flush=True)
+    backend = JaxSimBackend(device=dev)
+
+    best = {}
+    for n, a, methods, comms in GRIDS:
+        print(f"\n== n={n} a={a} d={D} ==", flush=True)
+        for m in methods:
+            row = []
+            for c in comms:
+                p = AggregatorPattern(nprocs=n, cb_nodes=a, data_size=D,
+                                      comm_size=c)
+                sched = compile_method(m, p)
+                t0 = time.perf_counter()
+                recv, timers = backend.run(sched, ntimes=1, verify=True,
+                                           chained=True)
+                per_rep = timers[0].total_time
+                row.append((c, per_rep))
+                key = (n, m)
+                if key not in best or per_rep < best[key]:
+                    best[key] = per_rep
+                print(f"  m={m} c={c}: {per_rep * 1e6:.1f} us/rep "
+                      f"(cell wall {time.perf_counter() - t0:.0f}s)",
+                      flush=True)
+
+    print("\n== scaling summary (best cell per n, m) ==", flush=True)
+    for (n, m), per_rep in sorted(best.items()):
+        a = {32: 14, 256: 16, 1024: 64}[n]
+        gbs = n * a * D / per_rep / 1e9
+        print(f"  n={n} a={a} m={m}: {per_rep * 1e6:.1f} us/rep, "
+              f"{gbs:.1f} GB/s aggregate", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

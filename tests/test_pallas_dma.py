@@ -95,3 +95,18 @@ def test_barrier_method_delivery_unchanged_log_barrier():
     p = AggregatorPattern(8, 3, data_size=32, comm_size=3, proc_node=2)
     sched = compile_method(17, p)
     recv, _ = PallasDmaBackend().run(sched, verify=True)
+
+
+def test_pallas_compiled_on_tpu():
+    """Platform-gated (runs only with a real TPU attached): the semaphore
+    kernel compiled through Mosaic — not interpret mode — on a degenerate
+    1-device mesh (self-loop remote DMA, real semaphore waits), delivery
+    verified. The CI CPU mesh always skips this; scripts/tpu_pallas_probe.py
+    is the manual driver (VERDICT r2 item 4)."""
+    import jax
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("needs a real TPU (see scripts/tpu_pallas_probe.py)")
+    p = AggregatorPattern(1, 1, data_size=2048, comm_size=1)
+    sched = compile_method(1, p)
+    b = PallasDmaBackend(devices=[jax.devices()[0]], interpret=False)
+    recv, _ = b.run(sched, ntimes=1, verify=True)
