@@ -18,8 +18,11 @@ Usage (on a machine with the TPU attached):
     python scripts/tpu_pallas_probe.py --execute  # also run + verify
 """
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:

@@ -13,8 +13,11 @@ numbers 2-7x (CLAUDE.md). Cells print as they finish, so a killed run
 still yields its completed cells from the log.
 """
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 GRIDS = [

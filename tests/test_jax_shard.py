@@ -129,3 +129,19 @@ def test_flagship_rank_count_m1_m8():
         assert timers[0].total_time > 0
     _fn, mesh, ndev, bsz, _extra = b._compiled(sched)
     assert ndev == 8 and bsz == 2048
+
+
+def test_shard_chained_measurement():
+    """Serial-chained differenced per-rep measurement on the device mesh
+    (the multi-chip analog of jax_sim --chained): positive per-rep time,
+    attributed phase columns, delivery still verified."""
+    p = AggregatorPattern(16, 5, data_size=32, comm_size=4)
+    b = JaxShardBackend()
+    sched = compile_method(1, p)
+    recv, timers = b.run(sched, verify=True, chained=True, ntimes=2)
+    assert timers[0].total_time > 0
+    assert timers[0].post_request_time > 0
+    per = b.measure_per_rep(sched)          # cached, no remeasure
+    assert np.isclose(timers[0].total_time, per * 2)
+    with pytest.raises(ValueError, match="TAM"):
+        b.run(compile_method(15, p), chained=True)

@@ -178,6 +178,7 @@ class JaxShardBackend:
         self._devices = devices
         self._ranks_per_device = ranks_per_device
         self._cache: dict = {}
+        self._chain_cache: dict = {}   # schedule key -> measured per-rep s
 
     def _mesh(self, nprocs: int) -> tuple[Mesh, int]:
         from tpu_aggcomm.parallel import host_major_devices
@@ -260,9 +261,9 @@ class JaxShardBackend:
         scat_dev = [jax.device_put(sc, sharding) for (_r, _pk, sc, _m) in tabs]
         round_ids = [r for (r, *_rest) in tabs]
 
-        def local_fn(send, packs, scats):
-            # send: (1, Fs, w) compact flat; packs/scats: (1, ndev, M) each
-            flat_send = send[0]
+        def rep_body(flat_send, packs, scats):
+            # one whole rep on this device's shard: flat_send (Fs, w);
+            # packs/scats: list of (1, ndev, M)
             recv = jnp.zeros((F, w), dtype=jdt)
             for k in range(len(packs)):
                 pk = packs[k][0]            # (ndev, M)
@@ -279,7 +280,10 @@ class JaxShardBackend:
                 if k + 1 < len(packs):
                     flat_send, recv = lax.optimization_barrier(
                         (flat_send, recv))
-            return recv[None]
+            return recv
+
+        def local_fn(send, packs, scats):
+            return rep_body(send[0], packs, scats)[None]
 
         sm = jax.shard_map(
             local_fn, mesh=mesh,
@@ -290,7 +294,41 @@ class JaxShardBackend:
         def fn(send):
             return sm(send, pack_dev, scat_dev)
 
-        built = (fn, mesh, ndev, bsz, (Fs, send_base, recv_base, counts))
+        def make_chain(iters: int):
+            """iters serially-dependent reps in ONE program (the chained
+            differenced-measurement scaffold, harness/chained.py): rep
+            r+1's send is XOR-perturbed by a psum over rep r's delivered
+            state, so reps can neither fuse nor elide and every device
+            depends on every other device's previous rep."""
+            def chain_local(send, packs, scats):
+                def body(flat_send, r):
+                    recv = rep_body(flat_send, packs, scats)
+                    # token = cross-device checksum of the delivered state
+                    # (psum makes rep r+1 depend on EVERY device's rep r)
+                    tok = (lax.psum(
+                        jnp.sum(recv[:F - 1, 0].astype(jnp.uint32)),
+                        AXIS).astype(jnp.int32) + r) % 251
+                    from tpu_aggcomm.harness.chained import xor_word
+                    return flat_send ^ xor_word(tok, jdt), ()
+                out, _ = lax.scan(body, send[0],
+                                  jnp.arange(iters, dtype=jnp.int32),
+                                  unroll=1)
+                return out[None]
+
+            csm = jax.shard_map(
+                chain_local, mesh=mesh,
+                in_specs=(P(AXIS), [P(AXIS)] * len(tabs),
+                          [P(AXIS)] * len(tabs)),
+                out_specs=P(AXIS))
+
+            @jax.jit
+            def chain(send):
+                return csm(send, pack_dev, scat_dev)
+
+            return chain
+
+        built = (fn, mesh, ndev, bsz,
+                 (Fs, send_base, recv_base, counts, make_chain))
         self._cache[key] = built
         return built
 
@@ -308,8 +346,39 @@ class JaxShardBackend:
                 out[r // bsz, b:b + s.shape[0]] = s
         return to_lanes(out, p.data_size)
 
+    def measure_per_rep(self, schedule, *, iters_small: int = 50,
+                        iters_big: int = 1050, trials: int = 3,
+                        windows: int = 3) -> float:
+        """Serial-chained differenced per-rep seconds on the device mesh
+        (harness/chained.py) — the honest multi-chip measurement: reps run
+        back-to-back inside one compiled program, rep r+1's send perturbed
+        by a psum over rep r's delivery, dispatch overhead differenced
+        away. Cached per schedule (iteration-invariant)."""
+        from tpu_aggcomm.harness.chained import differenced_per_rep
+        from tpu_aggcomm.tam.engine import TamMethod
+
+        if isinstance(schedule, TamMethod):
+            raise ValueError("chained measurement for TAM runs on "
+                             "jax_sim/jax_ici, not jax_shard")
+        key = (self._key(schedule), iters_small, iters_big, trials, windows)
+        if key in self._chain_cache:
+            return self._chain_cache[key]
+        p = schedule.pattern
+        fn, mesh, ndev, bsz, extra = self._compiled(schedule)
+        (Fs, send_base, _recv_base, _counts, make_chain) = extra
+        sharding = NamedSharding(mesh, P(AXIS))
+        send0 = jax.device_put(
+            self._global_send_flat(p, 0, ndev, bsz, send_base, Fs),
+            sharding)
+        per_rep = differenced_per_rep(make_chain, send0,
+                                      iters_small=iters_small,
+                                      iters_big=iters_big,
+                                      trials=trials, windows=windows)
+        self._chain_cache[key] = per_rep
+        return per_rep
+
     def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
-            verify: bool = False):
+            verify: bool = False, chained: bool = False):
         from tpu_aggcomm.tam.engine import TamMethod
 
         if ntimes < 1:
@@ -323,10 +392,13 @@ class JaxShardBackend:
 
         is_tam = isinstance(schedule, TamMethod)
         if is_tam:
+            if chained:
+                raise ValueError("chained measurement for TAM runs on "
+                                 "jax_sim/jax_ici, not jax_shard")
             from tpu_aggcomm.backends.jax_sim import dense_send_lanes
             send_dev = jax.device_put(dense_send_lanes(p, iter_), sharding)
         else:
-            (Fs, send_base, recv_base, counts) = extra
+            (Fs, send_base, recv_base, counts, _make_chain) = extra
             send_dev = jax.device_put(
                 self._global_send_flat(p, iter_, ndev, bsz, send_base, Fs),
                 sharding)
@@ -337,15 +409,22 @@ class JaxShardBackend:
         timers = [Timer() for _ in range(n)]
         self.last_rep_timers = []
         attr_w = weights_for(schedule)
-        for _ in range(ntimes):
-            t0 = time.perf_counter()
-            out = fn(send_dev)
-            out.block_until_ready()
-            dt = time.perf_counter() - t0
-            rep_attr = attribute_total(schedule, dt, weights=attr_w)
+        if chained:
+            per_rep = self.measure_per_rep(schedule)
+            rep_attr = attribute_total(schedule, per_rep, weights=attr_w)
             for r, t in enumerate(timers):
-                t += rep_attr[r]
-            self.last_rep_timers.append(rep_attr)
+                t += Timer.from_array(rep_attr[r].as_array() * ntimes)
+            self.last_rep_timers = [rep_attr for _ in range(ntimes)]
+        else:
+            for _ in range(ntimes):
+                t0 = time.perf_counter()
+                out = fn(send_dev)
+                out.block_until_ready()
+                dt = time.perf_counter() - t0
+                rep_attr = attribute_total(schedule, dt, weights=attr_w)
+                for r, t in enumerate(timers):
+                    t += rep_attr[r]
+                self.last_rep_timers.append(rep_attr)
 
         got = np.asarray(jax.device_get(out))
         if is_tam:

@@ -474,13 +474,8 @@ class JaxSimBackend:
                     recv = rep(send)
                     tok = (jnp.sum(recv[:, :n_recv_slots, 0]
                                    .astype(jnp.int32)) + r) % 251
-                    # byte-wise perturbation in the lane dtype: XOR with the
-                    # token replicated into every byte (carry-free, so the
-                    # u32-lane and u8 paths perturb identical byte streams)
-                    from tpu_aggcomm.backends.pallas_local import rep_word
-                    word = (rep_word(tok) if jdt == jnp.uint32
-                            else tok.astype(jnp.uint8))
-                    return send ^ word, ()
+                    from tpu_aggcomm.harness.chained import xor_word
+                    return send ^ xor_word(tok, jdt), ()
                 out, _ = lax.scan(body, send0,
                                   jnp.arange(iters, dtype=jnp.int32),
                                   unroll=1)

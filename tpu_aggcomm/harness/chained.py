@@ -20,7 +20,21 @@ from __future__ import annotations
 import statistics
 import time
 
-__all__ = ["differenced_per_rep", "differenced_trials"]
+__all__ = ["differenced_per_rep", "differenced_trials", "xor_word"]
+
+
+def xor_word(tok, lane_dtype):
+    """The chain perturbation, shared by every chained backend: a scalar
+    token (a checksum of the previous rep's delivered state, mod 251)
+    becomes a byte-replicated word in the carry's lane dtype, XORed into
+    the send buffer. Byte-replication keeps the uint32-lane and uint8
+    paths perturbing identical byte streams (carry-free), so chained
+    numbers stay comparable across backends."""
+    import jax.numpy as jnp
+
+    from tpu_aggcomm.backends.pallas_local import rep_word
+    return (rep_word(tok) if lane_dtype == jnp.uint32
+            else tok.astype(jnp.uint8))
 
 
 def differenced_trials(chain_factory, send0, *, iters_small: int,

@@ -36,7 +36,7 @@ class ExperimentConfig:
     verify: bool = False         # --verify
     results_csv: str | None = "results.csv"
     profile_rounds: bool = False
-    chained: bool = False        # jax_sim: serial-chained per-rep measurement
+    chained: bool = False        # jax_sim/jax_shard: chained per-rep timing
 
 
 def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
@@ -46,9 +46,9 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
         raise ValueError("data_size (-d) must be >= 1 "
                          "(the reference's -d 0 default sends empty messages; "
                          "pass an explicit size)")
-    if cfg.chained and cfg.backend != "jax_sim":
-        raise ValueError("--chained requires --backend jax_sim "
-                         "(serial-chained on-device measurement)")
+    if cfg.chained and cfg.backend not in ("jax_sim", "jax_shard"):
+        raise ValueError("--chained requires --backend jax_sim or "
+                         "jax_shard (serial-chained on-device measurement)")
     if cfg.chained and cfg.profile_rounds:
         raise ValueError("--chained and --profile-rounds are exclusive "
                          "(one program vs per-round programs)")
