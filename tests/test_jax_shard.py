@@ -145,3 +145,52 @@ def test_shard_chained_measurement():
     assert np.isclose(timers[0].total_time, per * 2)
     with pytest.raises(ValueError, match="TAM"):
         b.run(compile_method(15, p), chained=True)
+
+
+def test_block_tables_property_random():
+    """Property: for random edge sets, every edge appears in exactly one
+    (pack, scat) position, pack/scat positions correspond (same (a,b,j)),
+    and all padding lands on -1 / trash."""
+    rng = np.random.default_rng(7)
+    ndev, bsz = 4, 3
+    n = ndev * bsz
+    n_sslots, n_rslots = 3, n
+    send_base = np.arange(n) % bsz * n_sslots
+    recv_base = np.arange(n) % bsz * n_rslots
+    F = bsz * n_rslots + 1
+    for _trial in range(5):
+        E = int(rng.integers(1, 40))
+        src = rng.integers(0, n, E)
+        # unique (src, dst) pairs; dslot unique per (dst) for uniqueness
+        pairs = set()
+        rows = []
+        for s in src:
+            d = int(rng.integers(0, n))
+            if (int(s), d) in pairs:
+                continue
+            pairs.add((int(s), d))
+            rows.append((int(s), d, int(rng.integers(0, n_sslots)),
+                         len([1 for (ss, dd) in pairs if dd == d]) - 1, 0))
+        edges = np.array(rows, dtype=np.int64)
+        tabs = block_round_tables(edges, ndev=ndev, bsz=bsz,
+                                  send_base=send_base,
+                                  recv_base=recv_base, F=F)
+        (_r, pack, scat, M) = tabs[0]
+        # scat[b, a, j] corresponds to pack[a, b, j]
+        got = set()
+        for a in range(ndev):
+            for bdev in range(ndev):
+                for j in range(M):
+                    pk = int(pack[a, bdev, j])
+                    sc = int(scat[bdev, a, j])
+                    if pk < 0:
+                        assert sc == F - 1          # pad -> trash
+                    else:
+                        got.add((a, bdev, pk, sc))
+                        assert sc != F - 1
+        assert len(got) == len(edges)
+        # every edge is represented with its encoded flat indices
+        want = {(s // bsz, d // bsz,
+                 int(send_base[s]) + sl, int(recv_base[d]) + dl)
+                for (s, d, sl, dl, _rr) in rows}
+        assert got == want
