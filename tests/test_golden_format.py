@@ -109,3 +109,24 @@ def test_per_rank_csv_naming(tmp_path):
     for kind in ("send_wait_all_times", "total_times", "post_request_time",
                  "barrier_time"):
         assert (tmp_path / f"x_{kind}_7.csv").exists(), kind
+
+
+def test_pt2pt_console_golden(tmp_path):
+    """The pt2pt stat line is field-for-field the reference printf
+    (mpi_sendrecv_test.c:64): 'rank %d, mean = %lf, std = %lf,
+    ntimes = %d, total_timing = %lf, mean*ntimes = %lf'."""
+    import io
+    import re
+
+    from tpu_aggcomm.harness.pt2pt import pt2pt_statistics
+
+    buf = io.StringIO()
+    pt2pt_statistics(64, 2, 3, filename=str(tmp_path / "s.csv"), out=buf)
+    line = buf.getvalue().splitlines()[0]
+    assert re.fullmatch(
+        r"rank 0, mean = \d+\.\d{6}, std = \d+\.\d{6}, ntimes = 2, "
+        r"total_timing = \d+\.\d{6}, mean\*ntimes = \d+\.\d{6}", line), line
+    # per-rep CSV: one %lf per line (mpi_sendrecv_test.c:58)
+    rows = (tmp_path / "s.csv").read_text().splitlines()
+    assert len(rows) == 2
+    assert all(re.fullmatch(r"\d+\.\d{6}", r) for r in rows)
