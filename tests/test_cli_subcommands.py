@@ -242,3 +242,11 @@ def test_tam_reorder_interleaves_nodes():
     out = reorder_ranklist(na.node_of, np.array([0, 1, 2, 4]), na.nnodes)
     # consecutive entries land on distinct nodes while both have supply
     assert list(out) == [0, 4, 1, 2]
+
+
+def test_inspect_ndev_block_view():
+    rc, out = run_cli(["inspect", "-n", "16", "-m", "1", "-a", "5", "-d",
+                       "64", "-c", "4", "--ndev", "8"])
+    assert rc == 0
+    assert "jax_shard over 8 devices (2 ranks/device)" in out
+    assert "block M =" in out and "padding x" in out

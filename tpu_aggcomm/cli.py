@@ -165,6 +165,9 @@ def build_parser() -> argparse.ArgumentParser:
     ins.add_argument("-p", dest="proc_node", type=int, default=1)
     ins.add_argument("-t", dest="agg_type", type=int, default=1)
     ins.add_argument("-b", dest="barrier_type", type=int, default=0)
+    ins.add_argument("--ndev", type=int, default=0,
+                     help="also show the jax_shard block-table view over "
+                          "this many devices (block M, padding factor)")
 
     # analyze — summarize accumulated results.csv rows
     an = sub.add_parser(
@@ -484,6 +487,41 @@ def _run_inspect(args) -> int:
         bar = f", {nbar} barrier(s)" if nbar else ""
         print(f"  round {r:3d}: {len(sel):5d} msgs, {colors:3d} colors, "
               f"{len(sel) * p.data_size:9d} B{bar}")
+
+    if getattr(args, "ndev", 0):
+        # jax_shard view: per-round block-all_to_all tables over an
+        # --ndev-device mesh — block size M and the padding overhead the
+        # flagship tier actually ships (DISTRIBUTED.md)
+        from tpu_aggcomm.backends.jax_shard import (_schedule_edges,
+                                                    block_round_tables,
+                                                    recv_layout)
+        from tpu_aggcomm.harness.verify import recv_slot_counts
+        import numpy as np
+        ndev = args.ndev
+        if p.nprocs % ndev:
+            print(f"(ndev {ndev} does not divide nprocs {p.nprocs}; "
+                  f"no shard view)")
+            return 0
+        bsz = p.nprocs // ndev
+        counts = np.asarray(recv_slot_counts(p))
+        recv_base, F = recv_layout(counts, ndev, bsz)
+        from tpu_aggcomm.core.pattern import Direction as _D
+        if p.direction is _D.ALL_TO_MANY:
+            scounts = np.full(p.nprocs, p.cb_nodes, dtype=np.int64)
+        else:
+            scounts = np.where(np.asarray(p.agg_index) >= 0, p.nprocs, 0)
+        send_base, _Fs = recv_layout(scounts, ndev, bsz)
+        tabs = block_round_tables(_schedule_edges(sched), ndev=ndev,
+                                  bsz=bsz, send_base=send_base,
+                                  recv_base=recv_base, F=F)
+        print(f"jax_shard over {ndev} devices ({bsz} ranks/device): "
+              f"one block all_to_all per round")
+        for (r, pk, _sc, M) in tabs:
+            real = int((pk >= 0).sum())
+            shipped = ndev * ndev * M
+            print(f"  round {r:3d}: block M = {M:5d}, real msgs = "
+                  f"{real:6d}, shipped slots = {shipped:6d} "
+                  f"(padding x{shipped / max(real, 1):.2f})")
     return 0
 
 
