@@ -250,3 +250,19 @@ def test_inspect_ndev_block_view():
     assert rc == 0
     assert "jax_shard over 8 devices (2 ranks/device)" in out
     assert "block M =" in out and "padding x" in out
+
+
+def test_sweep_jax_shard_chained(tmp_path):
+    """The Theta-grid sweep drives the sharded flagship tier with chained
+    differenced timing — the exact command shape a pod run uses."""
+    csv = tmp_path / "results.csv"
+    rc, out = run_cli(["sweep", "-n", "16", "-a", "4", "-d", "32", "-i", "1",
+                       "-m", "1", "--backend", "jax_shard", "--chained",
+                       "--verify", "--comm-sizes", "2,8",
+                       "--results-csv", str(csv)])
+    assert rc == 0
+    rows = csv.read_text().strip().splitlines()
+    assert len(rows) == 3
+    # phase columns are attributed (non-zero), not zeros
+    post = float(rows[1].split(",")[7])
+    assert post > 0
