@@ -121,3 +121,18 @@ def test_sim_profile_rounds_excludes_chained():
     with pytest.raises(ValueError, match="exclusive"):
         JaxSimBackend().run(compile_method(1, p), chained=True,
                             profile_rounds=True)
+
+
+def test_sim_scanned_rounds_byte_exact():
+    """Many-round schedules take the lax.scan lowering (>=32 rounds);
+    delivery stays byte-exact vs the local oracle, including a barrier
+    method."""
+    from tpu_aggcomm.backends.local import LocalBackend
+    for m, kwargs in ((1, {}), (2, {}), (17, dict(proc_node=2))):
+        p = AggregatorPattern(64, 5, data_size=16, comm_size=1, **kwargs)
+        sched = compile_method(m, p)
+        recv_s, _ = JaxSimBackend().run(sched, verify=True)
+        recv_o, _ = LocalBackend().run(sched, verify=True)
+        for a, b in zip(recv_s, recv_o):
+            if a is not None:
+                np.testing.assert_array_equal(a, b)

@@ -265,6 +265,55 @@ class JaxSimBackend:
 
         _, jdt, w = self._words(p)
 
+        # Many-round schedules (n=1024 at c=1 is 1024 throttle rounds)
+        # compile O(rounds) when unrolled; pad the per-round tables to a
+        # uniform width and drive ONE lax.scan instead — compile cost
+        # becomes O(1) in the round count while rounds remain strictly
+        # sequential program steps (the scan carry is the fence: iteration
+        # k+1 reads iteration k's recv, so XLA cannot fuse or reorder
+        # across the -c boundaries). Pad entries scatter into the trash
+        # row. Barrier rounds fold in as a selected token write; a round
+        # with >1 barriers (no current method emits one) keeps the
+        # unrolled path.
+        scan_ok = (len(tabs) >= 32
+                   and all(v <= 1 for v in barrier_rounds.values()))
+        if scan_ok:
+            R = len(tabs)
+            E = max(len(srcs) for (srcs, _ss, _ds, _dl) in tabs)
+            srcs_t = np.zeros((R, E), dtype=np.int32)
+            ss_t = np.zeros((R, E), dtype=np.int32)
+            dsts_t = np.zeros((R, E), dtype=np.int32)
+            dslt_t = np.full((R, E), n_recv_slots, dtype=np.int32)  # trash
+            nbar_t = np.zeros((R,), dtype=np.int32)
+            for k, (srcs, ss, dsts, ds_) in enumerate(tabs):
+                e = len(srcs)
+                srcs_t[k, :e] = srcs
+                ss_t[k, :e] = ss
+                dsts_t[k, :e] = dsts
+                dslt_t[k, :e] = ds_
+                nbar_t[k] = barrier_rounds.get(round_ids[k], 0)
+            xs = tuple(jnp.asarray(t)
+                       for t in (srcs_t, ss_t, dsts_t, dslt_t, nbar_t))
+
+            def rep(send):
+                recv0 = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
+
+                def body(recv, x):
+                    srcs, ss, dsts, ds_, nbar = x
+                    vals = send[srcs, ss]
+                    recv = recv.at[dsts, ds_].set(vals)
+                    tok = jnp.sum(recv[:, :n_recv_slots, 0]
+                                  .astype(jnp.int32)).astype(jdt)
+                    cur = recv[:, n_recv_slots, 0]
+                    recv = recv.at[:, n_recv_slots, 0].set(
+                        jnp.where(nbar > 0, tok, cur))
+                    return recv, ()
+
+                recv, _ = lax.scan(body, recv0, xs, unroll=1)
+                return recv
+
+            return rep
+
         def rep(send):
             recv = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
             for k, (srcs, ss, dsts, ds_) in enumerate(tabs):
