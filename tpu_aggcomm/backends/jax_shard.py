@@ -257,37 +257,83 @@ class JaxShardBackend:
                 f"{sorted(orphans)}; the block lowering cannot represent "
                 f"a standalone fence")
 
-        pack_dev = [jax.device_put(pk, sharding) for (_r, pk, _sc, _m) in tabs]
-        scat_dev = [jax.device_put(sc, sharding) for (_r, _pk, sc, _m) in tabs]
         round_ids = [r for (r, *_rest) in tabs]
+        # Many-round schedules compile O(rounds) unrolled; barrier-free
+        # ones (the flagship sweep's m=1/m=2) scan instead: tables padded
+        # to the max block width, rounds sequenced by the scan carry (the
+        # -c fence), compile O(1) in round count. Barrier methods keep the
+        # unrolled body (an in-scan psum would add a collective to every
+        # round and distort what the benchmark measures).
+        scan_rounds = len(tabs) >= 32 and not barrier_rounds
+        if scan_rounds:
+            R = len(tabs)
+            Mmax = max(m for (_r, _pk, _sc, m) in tabs)
+            ndev_ = ndev
+            pk_t = np.full((R, ndev_, ndev_, Mmax), -1, dtype=np.int32)
+            sc_t = np.full((R, ndev_, ndev_, Mmax), F - 1, dtype=np.int32)
+            for k, (_r, pk, sc, m) in enumerate(tabs):
+                pk_t[k, :, :, :m] = pk
+                sc_t[k, :, :, :m] = sc
+            # device-major so P(AXIS) shards the per-device slice
+            pack_dev = [jax.device_put(pk_t.transpose(1, 0, 2, 3),
+                                       sharding)]
+            scat_dev = [jax.device_put(sc_t.transpose(1, 0, 2, 3),
+                                       sharding)]
 
-        def rep_body(flat_send, packs, scats):
-            # one whole rep on this device's shard: flat_send (Fs, w);
-            # packs/scats: list of (1, ndev, M)
-            recv = jnp.zeros((F, w), dtype=jdt)
-            for k in range(len(packs)):
-                pk = packs[k][0]            # (ndev, M)
-                sc = scats[k][0]
-                vals = jnp.where(
-                    (pk >= 0)[..., None],
-                    jnp.take(flat_send, jnp.maximum(pk, 0), axis=0),
-                    jnp.zeros((w,), jdt))
-                got = lax.all_to_all(vals, AXIS, 0, 0)   # (ndev, M, w)
-                recv = recv.at[sc.reshape(-1)].set(got.reshape(-1, w))
-                for _ in range(barrier_rounds.get(round_ids[k], 0)):
-                    tok = lax.psum(recv[0, 0].astype(jnp.int32), AXIS)
-                    recv = recv.at[F - 1, 0].set(tok.astype(jdt))
-                if k + 1 < len(packs):
-                    flat_send, recv = lax.optimization_barrier(
-                        (flat_send, recv))
-            return recv
+            def rep_body(flat_send, packs, scats):
+                pks = packs[0][0]           # (R, ndev, Mmax)
+                scs = scats[0][0]
+
+                def body(recv, x):
+                    pk, sc = x
+                    vals = jnp.where(
+                        (pk >= 0)[..., None],
+                        jnp.take(flat_send, jnp.maximum(pk, 0), axis=0),
+                        jnp.zeros((w,), jdt))
+                    got = lax.all_to_all(vals, AXIS, 0, 0)
+                    recv = recv.at[sc.reshape(-1)].set(
+                        got.reshape(-1, w))
+                    return recv, ()
+
+                recv0 = jnp.zeros((F, w), dtype=jdt)
+                # the all_to_all output is varying over the mesh axis; the
+                # constant initial carry must be cast to match
+                recv0 = lax.pcast(recv0, (AXIS,), to="varying")
+                recv, _ = lax.scan(body, recv0, (pks, scs), unroll=1)
+                return recv
+        else:
+            pack_dev = [jax.device_put(pk, sharding)
+                        for (_r, pk, _sc, _m) in tabs]
+            scat_dev = [jax.device_put(sc, sharding)
+                        for (_r, _pk, sc, _m) in tabs]
+
+            def rep_body(flat_send, packs, scats):
+                # one whole rep on this device's shard: flat_send (Fs, w);
+                # packs/scats: list of (1, ndev, M)
+                recv = jnp.zeros((F, w), dtype=jdt)
+                for k in range(len(packs)):
+                    pk = packs[k][0]            # (ndev, M)
+                    sc = scats[k][0]
+                    vals = jnp.where(
+                        (pk >= 0)[..., None],
+                        jnp.take(flat_send, jnp.maximum(pk, 0), axis=0),
+                        jnp.zeros((w,), jdt))
+                    got = lax.all_to_all(vals, AXIS, 0, 0)  # (ndev, M, w)
+                    recv = recv.at[sc.reshape(-1)].set(got.reshape(-1, w))
+                    for _ in range(barrier_rounds.get(round_ids[k], 0)):
+                        tok = lax.psum(recv[0, 0].astype(jnp.int32), AXIS)
+                        recv = recv.at[F - 1, 0].set(tok.astype(jdt))
+                    if k + 1 < len(packs):
+                        flat_send, recv = lax.optimization_barrier(
+                            (flat_send, recv))
+                return recv
 
         def local_fn(send, packs, scats):
             return rep_body(send[0], packs, scats)[None]
 
         sm = jax.shard_map(
             local_fn, mesh=mesh,
-            in_specs=(P(AXIS), [P(AXIS)] * len(tabs), [P(AXIS)] * len(tabs)),
+            in_specs=(P(AXIS), [P(AXIS)] * len(pack_dev), [P(AXIS)] * len(pack_dev)),
             out_specs=P(AXIS))
 
         @jax.jit
@@ -317,8 +363,8 @@ class JaxShardBackend:
 
             csm = jax.shard_map(
                 chain_local, mesh=mesh,
-                in_specs=(P(AXIS), [P(AXIS)] * len(tabs),
-                          [P(AXIS)] * len(tabs)),
+                in_specs=(P(AXIS), [P(AXIS)] * len(pack_dev),
+                          [P(AXIS)] * len(pack_dev)),
                 out_specs=P(AXIS))
 
             @jax.jit
