@@ -61,6 +61,19 @@ def main() -> int:
                       f"(cell wall {time.perf_counter() - t0:.0f}s)",
                       flush=True)
 
+    print("\n== repeatability (fresh re-measurement of spot cells) ==",
+          flush=True)
+    for n, a, c in ((32, 14, 8), (256, 16, 64), (1024, 64, 512)):
+        p = AggregatorPattern(nprocs=n, cb_nodes=a, data_size=D,
+                              comm_size=c)
+        sched = compile_method(1, p)
+        fresh = JaxSimBackend(device=dev)   # no chain cache: re-measures
+        r2 = fresh.measure_per_rep(sched)
+        r1 = backend.measure_per_rep(sched)  # cached from the grid
+        spread = abs(r2 - r1) / max(r1, 1e-12)
+        print(f"  n={n} c={c}: {r1 * 1e6:.1f} vs {r2 * 1e6:.1f} us/rep "
+              f"(|delta| = {spread * 100:.0f}%)", flush=True)
+
     print("\n== scaling summary (best cell per n, m) ==", flush=True)
     for (n, m), per_rep in sorted(best.items()):
         a = {32: 14, 256: 16, 1024: 64}[n]
