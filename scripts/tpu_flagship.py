@@ -1,0 +1,65 @@
+"""Theta-flagship shape on the ONE real chip (round 3 stretch).
+
+The reference's defining configuration is 16,384 ranks x 256 aggregators
+(script_theta_all_to_many_256.sh:3,11). This runs that EXACT rank and
+aggregator count on the single tunneled v5e via ``jax_shard`` on a
+degenerate 1-device mesh — its compacted send/recv layouts (rows only
+for ranks that send/receive) are what make the 4.19M-edge pattern fit
+one chip's HBM, where jax_sim's dense per-rank recv buffers would need
+~34 GB.
+
+Payload is d=256 (not the Theta d=2048): the flagship payload is
+2 x 8.6 GB of slab arenas plus exchange temporaries — a pod's aggregate
+HBM, not one chip's (DISTRIBUTED.md "Mapping the Theta flagship to a
+pod"). At d=256 the arenas are ~1 GB each and the full pattern executes,
+byte-verifies, and is chained-timed honestly.
+
+Cells: m=1 unthrottled, m=1 -c 2048 (the Theta grid's deep-throttle
+point: 8 distinct rounds), m=8 dense. Each --verify'd (4.19M slabs
+byte-checked); timing via the serial-chain differenced scaffold with
+reduced chain lengths (a flagship rep is ~ms, so short chains already
+swamp the dispatch RPC).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N, A, D = 16384, 256, 256
+CELLS = [(1, 999_999_999), (1, 2048), (8, 999_999_999)]
+
+
+def main() -> int:
+    import jax
+
+    from tpu_aggcomm.backends.jax_shard import JaxShardBackend
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({dev.platform})", flush=True)
+    backend = JaxShardBackend(devices=[dev])
+
+    for m, c in CELLS:
+        p = AggregatorPattern(nprocs=N, cb_nodes=A, data_size=D, comm_size=c)
+        sched = compile_method(m, p)
+        t0 = time.perf_counter()
+        recv, timers = backend.run(sched, ntimes=1, verify=True)
+        wall = time.perf_counter() - t0
+        print(f"m={m} c={c}: verified {N}x{A} d={D} "
+              f"(run+verify wall {wall:.0f}s)", flush=True)
+        t0 = time.perf_counter()
+        per_rep = backend.measure_per_rep(sched, iters_small=10,
+                                          iters_big=110, trials=2,
+                                          windows=2)
+        gbs = N * A * D / per_rep / 1e9
+        print(f"  chained: {per_rep * 1e3:.3f} ms/rep, {gbs:.1f} GB/s "
+              f"aggregate (measure wall {time.perf_counter() - t0:.0f}s)",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

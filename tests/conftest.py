@@ -37,5 +37,7 @@ def pytest_collection_modifyitems(config, items):
         reason="TPU_AGGCOMM_TEST_TPU=1: only *_on_tpu tests run against "
                "the real chip; unset the var for the CPU-mesh suite")
     for item in items:
-        if not item.name.endswith("_on_tpu"):
+        # originalname survives parameterization ("foo_on_tpu[1]")
+        name = getattr(item, "originalname", None) or item.name
+        if not name.endswith("_on_tpu"):
             item.add_marker(skip)
