@@ -42,30 +42,36 @@ def main() -> int:
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     import numpy as np
 
+    # the semaphore-sensitive family the backend exists for (m=1 plain
+    # Issend rounds; m=6/7/11/12 sync & half-sync; m=18 the CTS control
+    # signal — mpi_test.c:1665-1746, 1055-1114, 999-1053, 942-997,
+    # 1229-1336), each through the real Mosaic pipeline
     p = AggregatorPattern(nprocs=1, cb_nodes=1, data_size=2048, comm_size=1)
-    sched = compile_method(1, p)
     b = PallasDmaBackend(devices=[dev], interpret=False)
     mesh = Mesh(np.array([dev]), ("ranks",))
-    fn, pds, n_send_slots, n_recv_slots, tabs = b._lower(
-        sched, mesh, interpret=False)
-
     sharding = NamedSharding(mesh, P("ranks"))
-    send_shape = jax.ShapeDtypeStruct((1, n_send_slots + 1, 4, pds // 4),
-                                      np.uint8, sharding=sharding)
-    tab_shapes = [jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=sharding)
-                  for t in tabs]
-    t0 = time.perf_counter()
-    compiled = fn.lower(send_shape, *tab_shapes).compile()
-    print(f"MOSAIC ACCEPTED the semaphore kernel: compile-only OK in "
-          f"{time.perf_counter() - t0:.1f}s "
-          f"(steps={tabs[0].shape[1]}, pds={pds})", flush=True)
-    del compiled
-
-    if "--execute" in sys.argv:
+    for mid in (1, 6, 7, 11, 12, 18):
+        sched = compile_method(mid, p)
+        fn, pds, n_send_slots, n_recv_slots, tabs = b._lower(
+            sched, mesh, interpret=False)
+        send_shape = jax.ShapeDtypeStruct((1, n_send_slots + 1, 4, pds // 4),
+                                          np.uint8, sharding=sharding)
+        tab_shapes = [jax.ShapeDtypeStruct(t.shape, t.dtype,
+                                           sharding=sharding) for t in tabs]
         t0 = time.perf_counter()
-        recv, timers = b.run(sched, ntimes=1, verify=True)
-        print(f"EXECUTED + verified in {time.perf_counter() - t0:.1f}s; "
-              f"rep wall = {timers[0].total_time:.6f}s", flush=True)
+        compiled = fn.lower(send_shape, *tab_shapes).compile()
+        print(f"m={mid:>2} ({sched.name}): MOSAIC ACCEPTED in "
+              f"{time.perf_counter() - t0:.1f}s "
+              f"(steps={tabs[0].shape[1]}, pds={pds}, "
+              f"rendezvous={bool(sched.uses_rendezvous)})", flush=True)
+        del compiled
+
+        if "--execute" in sys.argv:
+            t0 = time.perf_counter()
+            recv, timers = b.run(sched, ntimes=1, verify=True)
+            print(f"        EXECUTED + verified in "
+                  f"{time.perf_counter() - t0:.1f}s; "
+                  f"rep wall = {timers[0].total_time:.6f}s", flush=True)
     return 0
 
 

@@ -233,6 +233,7 @@ def test_shard_single_device_mesh():
             if want is not None:
                 np.testing.assert_array_equal(got, want)
     b = JaxShardBackend(devices=one)
-    per = b.measure_per_rep(compile_method(1, p), iters_small=5,
-                            iters_big=25, trials=1, windows=1)
+    # default chain lengths/trials: short chains on a us-scale rep are
+    # inside host-timer noise and make the differenced diff go negative
+    per = b.measure_per_rep(compile_method(1, p))
     assert per > 0
