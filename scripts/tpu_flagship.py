@@ -27,8 +27,15 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# default: the exact Theta rank/aggregator shape at d=256 (HBM-feasible);
+# override with `N A D [c ...]` argv, e.g. `4096 256 2048 999999999 64`
+# for the full-payload n=4096 scaling point
 N, A, D = 16384, 256, 256
 CELLS = [(1, 999_999_999), (1, 2048), (8, 999_999_999)]
+if len(sys.argv) > 3:
+    N, A, D = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    cs = [int(c) for c in sys.argv[4:]] or [999_999_999]
+    CELLS = [(1, c) for c in cs]
 
 
 def main() -> int:
