@@ -36,6 +36,8 @@ if len(sys.argv) > 3:
     N, A, D = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     cs = [int(c) for c in sys.argv[4:]] or [999_999_999]
     CELLS = [(1, c) for c in cs]
+elif len(sys.argv) > 1:
+    sys.exit(f"usage: {sys.argv[0]} [N A D [c ...]] — need all of N A D")
 
 
 def main() -> int:
@@ -53,7 +55,7 @@ def main() -> int:
         p = AggregatorPattern(nprocs=N, cb_nodes=A, data_size=D, comm_size=c)
         sched = compile_method(m, p)
         t0 = time.perf_counter()
-        recv, timers = backend.run(sched, ntimes=1, verify=True)
+        backend.run(sched, ntimes=1, verify=True)
         wall = time.perf_counter() - t0
         print(f"m={m} c={c}: verified {N}x{A} d={D} "
               f"(run+verify wall {wall:.0f}s)", flush=True)
