@@ -66,3 +66,43 @@ def test_jax_too_few_devices():
     sched = compile_method(1, p)
     with pytest.raises(ValueError, match="devices"):
         JaxIciBackend().run(sched)
+
+
+@pytest.mark.parametrize("method", [1, 8, 17])
+def test_jax_chained_measurement(method):
+    """Serial-chained differenced per-rep measurement on the one-rank-per-
+    device tier (the honest mode through a tunneled dispatch path, as on
+    jax_sim/jax_shard): throttled rounds (m=1), the dense collective
+    (m=8), and in-round psum barriers (m=17) all measure positive,
+    attribute onto the phase buckets, and still deliver verified bytes."""
+    import numpy as np
+    p = AggregatorPattern(8, 3, data_size=16, comm_size=2)
+    b = JaxIciBackend()
+    sched = compile_method(method, p)
+    recv, timers = b.run(sched, verify=True, chained=True, ntimes=2)
+    assert timers[0].total_time > 0
+    per = b.measure_per_rep(sched)          # cached, no remeasure
+    assert np.isclose(timers[0].total_time, per * 2)
+
+
+def test_jax_chained_rejects_tam_and_profile():
+    p = AggregatorPattern(8, 3, data_size=16, comm_size=2, proc_node=2)
+    b = JaxIciBackend()
+    with pytest.raises(ValueError, match="TAM"):
+        b.run(compile_method(15, p), chained=True)
+    with pytest.raises(ValueError, match="exclusive"):
+        b.run(compile_method(1, p), chained=True, profile_rounds=True)
+
+
+def test_runner_rejects_chained_run_all_with_tam_upfront():
+    """-m 0 --chained on the mesh tiers must fail BEFORE any method runs
+    (not crash at m=15 mid-sweep leaving a partial CSV): the TAM engine
+    times whole reps, so chained run-all belongs to jax_sim."""
+    import io
+    from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+    for backend in ("jax_ici", "jax_shard"):
+        cfg = ExperimentConfig(nprocs=8, cb_nodes=3, data_size=16,
+                               comm_size=2, method=0, backend=backend,
+                               chained=True, results_csv=None)
+        with pytest.raises(ValueError, match="TAM methods"):
+            run_experiment(cfg, out=io.StringIO())

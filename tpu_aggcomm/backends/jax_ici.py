@@ -148,6 +148,7 @@ class JaxIciBackend:
     def __init__(self, devices=None):
         self._devices = devices
         self._segment_cache: dict = {}
+        self._chain_cache: dict = {}   # schedule key -> measured per-rep s
 
     @staticmethod
     def _cache_key(p, low: "_Lowered", profile_rounds: bool):
@@ -168,10 +169,18 @@ class JaxIciBackend:
 
     # ------------------------------------------------------------------
     def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
-            verify: bool = False, profile_rounds: bool = False):
+            verify: bool = False, profile_rounds: bool = False,
+            chained: bool = False):
         if ntimes < 1:
             raise ValueError("ntimes must be >= 1")
+        if chained and profile_rounds:
+            raise ValueError("chained and profile_rounds are exclusive "
+                             "(one program vs per-round programs)")
         from tpu_aggcomm.tam.engine import TamMethod, tam_two_level_jax
+        if isinstance(schedule, TamMethod) and chained:
+            raise ValueError("chained measurement for TAM runs on jax_sim "
+                             "(single-chip route); the two-level mesh "
+                             "engine times whole reps")
         if isinstance(schedule, TamMethod):
             p = schedule.pattern
             devs = (list(self._devices) if self._devices is not None
@@ -221,24 +230,9 @@ class JaxIciBackend:
         mesh = self._mesh(n)
         sharding = NamedSharding(mesh, P(AXIS))
 
-        if schedule.collective:
-            n_recv_slots = n if p.direction is Direction.ALL_TO_MANY else p.cb_nodes
-            n_send_slots = p.cb_nodes if p.direction is Direction.ALL_TO_MANY else n
-            key = (p, "dense")
-            if key not in self._segment_cache:
-                self._segment_cache[key] = ([self._build_dense(p, mesh)],
-                                            None)
-            segments, seg_rounds = self._segment_cache[key]
-            attr_w = None
-        else:
-            low = lower_schedule(schedule)
-            n_recv_slots, n_send_slots = low.n_recv_slots, low.n_send_slots
-            key = self._cache_key(p, low, profile_rounds)
-            if key not in self._segment_cache:
-                self._segment_cache[key] = self._build_ppermute(
-                    p, mesh, sharding, low, split_rounds=profile_rounds)
-            segments, seg_rounds = self._segment_cache[key]
-            attr_w = weights_for(schedule)
+        segments, seg_rounds, _mc, n_send_slots, n_recv_slots = \
+            self._segments_for(schedule, mesh, sharding, profile_rounds)
+        attr_w = None if schedule.collective else weights_for(schedule)
 
         send_g = self._global_send(p, iter_, n_send_slots)
         send_dev = jax.device_put(send_g, sharding)
@@ -256,6 +250,22 @@ class JaxIciBackend:
 
         timers = [Timer() for _ in range(n)]
         self.last_rep_timers = []  # [rep][rank] -> Timer (save_all_timing)
+        if chained:
+            # honest per-rep seconds from the serial-chained differenced
+            # scaffold (the multi-chip analog of jax_sim --chained);
+            # delivery comes from the warmed unchained program
+            per_rep = self.measure_per_rep(schedule)
+            rep_attr = attribute_total(schedule, per_rep, weights=attr_w)
+            for r, t in enumerate(timers):
+                t += Timer.from_array(rep_attr[r].as_array() * ntimes)
+            self.last_rep_timers = [rep_attr for _ in range(ntimes)]
+            recv_w = np.asarray(jax.device_get(warm))[:, :n_recv_slots, :]
+            recv_np = lanes_to_bytes(recv_w, p.data_size)
+            recv_bufs = self._split_recv(p, recv_np)
+            if verify:
+                from tpu_aggcomm.harness.verify import verify_recv
+                verify_recv(p, recv_bufs, iter_)
+            return recv_bufs, timers
         recv_dev = None
         for _ in range(ntimes):
             recv_dev = fresh_recv()
@@ -289,6 +299,69 @@ class JaxIciBackend:
             from tpu_aggcomm.harness.verify import verify_recv
             verify_recv(p, recv_bufs, iter_)
         return recv_bufs, timers
+
+    # ------------------------------------------------------------------
+    def _segments_for(self, schedule, mesh, sharding, profile_rounds):
+        """Cached (segments, seg_rounds, make_chain, n_send_slots,
+        n_recv_slots) for a schedule — the one place the segment cache is
+        keyed and built, shared by run() and measure_per_rep() so the
+        chained program can never be built differently from the program
+        run() executes."""
+        p = schedule.pattern
+        if schedule.collective:
+            n = p.nprocs
+            a2m = p.direction is Direction.ALL_TO_MANY
+            key = (p, "dense")
+            if key not in self._segment_cache:
+                fn, mc = self._build_dense(p, mesh)
+                self._segment_cache[key] = ([fn], None, mc)
+            segs, sr, mc = self._segment_cache[key]
+            return (segs, sr, mc, p.cb_nodes if a2m else n,
+                    n if a2m else p.cb_nodes)
+        low = lower_schedule(schedule)
+        key = self._cache_key(p, low, profile_rounds)
+        if key not in self._segment_cache:
+            self._segment_cache[key] = self._build_ppermute(
+                p, mesh, sharding, low, split_rounds=profile_rounds)
+        segs, sr, mc = self._segment_cache[key]
+        return segs, sr, mc, low.n_send_slots, low.n_recv_slots
+
+    # ------------------------------------------------------------------
+    def measure_per_rep(self, schedule, *, iters_small: int = 50,
+                        iters_big: int = 1050, trials: int = 3,
+                        windows: int = 3) -> float:
+        """Serial-chained differenced per-rep seconds over the device mesh
+        (harness/chained.py): reps run back-to-back inside one compiled
+        program, rep r+1's send perturbed by a psum over rep r's delivery
+        (every device depends on every other device's previous rep), and
+        the fixed dispatch overhead is differenced away — the honest
+        measurement through a tunneled or contended dispatch path, on the
+        one-rank-per-device tier. Cached per schedule."""
+        from tpu_aggcomm.core.schedule import schedule_shape_key
+        from tpu_aggcomm.harness.chained import differenced_per_rep
+        from tpu_aggcomm.tam.engine import TamMethod
+
+        if isinstance(schedule, TamMethod):
+            raise ValueError("chained measurement for TAM runs on jax_sim "
+                             "(single-chip route); the two-level mesh "
+                             "engine times whole reps")
+        key = (schedule_shape_key(schedule), iters_small, iters_big,
+               trials, windows)
+        if key in self._chain_cache:
+            return self._chain_cache[key]
+        p = schedule.pattern
+        mesh = self._mesh(p.nprocs)
+        sharding = NamedSharding(mesh, P(AXIS))
+        _segs, _sr, make_chain, n_send_slots, _nr = self._segments_for(
+            schedule, mesh, sharding, False)
+        send0 = jax.device_put(self._global_send(p, 0, n_send_slots),
+                               sharding)
+        per_rep = differenced_per_rep(make_chain, send0,
+                                      iters_small=iters_small,
+                                      iters_big=iters_big,
+                                      trials=trials, windows=windows)
+        self._chain_cache[key] = per_rep
+        return per_rep
 
     # ------------------------------------------------------------------
     def _global_send(self, p: AggregatorPattern, iter_: int,
@@ -332,45 +405,50 @@ class JaxIciBackend:
         ss_dev = jax.device_put(low.sslot_tab, sharding)
         rs_dev = jax.device_put(low.rslot_tab, sharding)
 
+        def rep_body(send, recv, sslot, rslot, c0, c1):
+            # one device's slice of color steps [c0, c1): send (S, w),
+            # recv (R+1, w), sslot/rslot (C,). Shared by the timed
+            # segments and the chained-measurement scan so the chained
+            # program cannot drift from the program it measures.
+            zero = jnp.zeros((w,), dtype=jdt)
+
+            def emit_barriers(recv, rnd):
+                # real barriers of this round (m=17 in-round, m=13/-b
+                # and m=19 after-round): an all-reduce over LIVE data,
+                # its result written into the trash row (which the
+                # program returns), so it can neither constant-fold nor
+                # be DCE'd. (A previous `& 0` version folded away —
+                # verified via optimized HLO.)
+                for _ in range(low.barrier_rounds.get(rnd, 0)):
+                    tok = lax.psum(recv[0, 0].astype(jnp.int32), AXIS)
+                    recv = recv.at[low.n_recv_slots, 0].set(
+                        tok.astype(jdt))
+                return recv
+
+            prev_round = None
+            for ci in range(c0, c1):
+                rnd = low.round_of_color[ci]
+                if prev_round is not None and rnd != prev_round:
+                    # throttle-round boundary: keep XLA from fusing across
+                    recv = emit_barriers(recv, prev_round)
+                    send, recv = lax.optimization_barrier((send, recv))
+                prev_round = rnd
+                ss = sslot[ci]
+                val = jnp.where(ss >= 0,
+                                jnp.take(send, jnp.maximum(ss, 0), axis=0,
+                                         mode="clip"),
+                                zero)
+                got = lax.ppermute(val, AXIS, low.perms[ci])
+                recv = lax.dynamic_update_index_in_dim(
+                    recv, got, rslot[ci], axis=0)
+            if prev_round is not None:
+                recv = emit_barriers(recv, prev_round)
+            return recv
+
         def make_segment(c0: int, c1: int):
             def local_fn(send, recv, sslot, rslot):
-                # send: (1, S, w)  recv: (1, R+1, w)  sslot/rslot: (1, C)
-                send = send[0]
-                recv = recv[0]
-                zero = jnp.zeros((w,), dtype=jdt)
-
-                def emit_barriers(recv, rnd):
-                    # real barriers of this round (m=17 in-round, m=13/-b
-                    # and m=19 after-round): an all-reduce over LIVE data,
-                    # its result written into the trash row (which the
-                    # program returns), so it can neither constant-fold nor
-                    # be DCE'd. (A previous `& 0` version folded away —
-                    # verified via optimized HLO.)
-                    for _ in range(low.barrier_rounds.get(rnd, 0)):
-                        tok = lax.psum(recv[0, 0].astype(jnp.int32), AXIS)
-                        recv = recv.at[low.n_recv_slots, 0].set(
-                            tok.astype(jdt))
-                    return recv
-
-                prev_round = None
-                for ci in range(c0, c1):
-                    rnd = low.round_of_color[ci]
-                    if prev_round is not None and rnd != prev_round:
-                        # throttle-round boundary: keep XLA from fusing across
-                        recv = emit_barriers(recv, prev_round)
-                        send, recv = lax.optimization_barrier((send, recv))
-                    prev_round = rnd
-                    ss = sslot[0, ci]
-                    val = jnp.where(ss >= 0,
-                                    jnp.take(send, jnp.maximum(ss, 0), axis=0,
-                                             mode="clip"),
-                                    zero)
-                    got = lax.ppermute(val, AXIS, low.perms[ci])
-                    recv = lax.dynamic_update_index_in_dim(
-                        recv, got, rslot[0, ci], axis=0)
-                if prev_round is not None:
-                    recv = emit_barriers(recv, prev_round)
-                return recv[None]
+                return rep_body(send[0], recv[0], sslot[0], rslot[0],
+                                c0, c1)[None]
 
             sm = jax.shard_map(
                 local_fn, mesh=mesh,
@@ -383,13 +461,32 @@ class JaxIciBackend:
 
             return seg
 
+        def make_chain(iters: int):
+            from tpu_aggcomm.harness.chained import scanned_chain
+
+            def chain_local(send, sslot, rslot):
+                rep = lambda s, recv0: rep_body(         # noqa: E731
+                    s, recv0, sslot[0], rslot[0], 0, low.n_colors)
+                inner = scanned_chain(rep, n_recv_slots=low.n_recv_slots,
+                                      w=w, jdt=jdt, axis=AXIS, iters=iters)
+                return inner(send[0])[None]
+
+            csm = jax.shard_map(chain_local, mesh=mesh,
+                                in_specs=(P(AXIS),) * 3, out_specs=P(AXIS))
+
+            @jax.jit
+            def chain(send):
+                return csm(send, ss_dev, rs_dev)
+
+            return chain
+
         segs = [make_segment(c0, c1) for c0, c1 in seg_bounds]
         # one segment per round in split mode -> its round id, for mapping
         # measured segment times onto TimerBucket weights; None for the
         # whole-rep single segment
         seg_rounds = ([low.round_of_color[c0] for c0, _c1 in seg_bounds]
                       if split_rounds and len(seg_bounds) > 1 else None)
-        return segs, seg_rounds
+        return segs, seg_rounds, make_chain
 
     # ------------------------------------------------------------------
     def _build_dense(self, p: AggregatorPattern, mesh: Mesh):
@@ -415,14 +512,29 @@ class JaxIciBackend:
         rslot_c = jnp.asarray(
             np.where(rslot_of >= 0, rslot_of, n_recv_slots), dtype=jnp.int32)
 
-        def local_fn(send, recv):
-            send = send[0]          # (S, w)
-            recv = recv[0]          # (R+1, w)
+        _, jdt, w = lane_layout(p.data_size)
+
+        def rep_body(send, recv):
             rows = jnp.take(send, sslot_c, axis=0) * smask   # (n, w) dst-major
             got = lax.all_to_all(rows, AXIS, split_axis=0, concat_axis=0)
-            recv = recv.at[rslot_c].set(got)
-            return recv[None]
+            return recv.at[rslot_c].set(got)
+
+        def local_fn(send, recv):
+            return rep_body(send[0], recv[0])[None]
 
         sm = jax.shard_map(local_fn, mesh=mesh,
                            in_specs=(P(AXIS), P(AXIS)), out_specs=P(AXIS))
-        return jax.jit(sm)
+
+        def make_chain(iters: int):
+            from tpu_aggcomm.harness.chained import scanned_chain
+
+            def chain_local(send):
+                inner = scanned_chain(rep_body, n_recv_slots=n_recv_slots,
+                                      w=w, jdt=jdt, axis=AXIS, iters=iters)
+                return inner(send[0])[None]
+
+            csm = jax.shard_map(chain_local, mesh=mesh,
+                                in_specs=(P(AXIS),), out_specs=P(AXIS))
+            return jax.jit(csm)
+
+        return jax.jit(sm), make_chain

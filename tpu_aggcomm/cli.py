@@ -63,7 +63,7 @@ def build_parser() -> argparse.ArgumentParser:
     bench.add_argument("--profile-rounds", action="store_true",
                        help="jax_ici: time each throttle round separately")
     bench.add_argument("--chained", action="store_true",
-                       help="jax_sim/jax_shard: serial-chained on-device per-rep "
+                       help="jax_sim/jax_shard/jax_ici: serial-chained on-device per-rep "
                             "measurement (cancels dispatch RPC overhead — "
                             "the honest mode on a tunneled TPU)")
     bench.add_argument("--results-csv", default="results.csv")
@@ -142,7 +142,8 @@ def build_parser() -> argparse.ArgumentParser:
     sw.add_argument("--backend", choices=BACKENDS, default="local")
     sw.add_argument("--verify", action="store_true")
     sw.add_argument("--chained", action="store_true",
-                    help="jax_sim/jax_shard: serial-chained per-rep measurement")
+                    help="jax_sim/jax_shard/jax_ici: serial-chained per-rep "
+                         "measurement")
     sw.add_argument("--resume", action="store_true",
                     help="skip throttle values already recorded in the "
                          "results CSV for this config (an interrupted sweep "

@@ -91,3 +91,38 @@ def differenced_per_rep(chain_factory, send0, *, iters_small: int,
     return statistics.median(differenced_trials(
         chain_factory, send0, iters_small=iters_small, iters_big=iters_big,
         trials=trials, windows=windows))
+
+
+def scanned_chain(rep, *, n_recv_slots: int, w: int, jdt, axis: str,
+                  iters: int):
+    """Shared scan scaffold for mesh-tier chained measurement (jax_ici):
+    returns ``chain_local(send_local) -> send_local`` running ``iters``
+    serially-dependent reps, rep r+1's send XOR-perturbed by a psum over
+    rep r's delivered rows — so reps can neither fuse nor elide, and
+    every device depends on every other device's previous rep.
+
+    ``rep(send_local, recv0_local) -> recv_local`` is one device's whole
+    rep (tables closed over). jax_sim/jax_shard keep layout-specific
+    variants of this scaffold (dense rank-axis / compacted flat layouts);
+    the token formula ``(psum(live rows) + r) % 251`` must stay identical
+    across all of them so chained numbers remain comparable between
+    backends."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    def chain_local(send_local):
+        def body(s, r):
+            recv0 = lax.pcast(
+                jnp.zeros((n_recv_slots + 1, w), dtype=jdt),
+                (axis,), to="varying")
+            recv = rep(s, recv0)
+            tok = (lax.psum(
+                jnp.sum(recv[:n_recv_slots, 0].astype(jnp.uint32)),
+                axis).astype(jnp.int32) + r) % 251
+            return s ^ xor_word(tok, jdt), ()
+
+        out, _ = lax.scan(body, send_local,
+                          jnp.arange(iters, dtype=jnp.int32), unroll=1)
+        return out
+
+    return chain_local

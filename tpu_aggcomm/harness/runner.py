@@ -36,7 +36,7 @@ class ExperimentConfig:
     verify: bool = False         # --verify
     results_csv: str | None = "results.csv"
     profile_rounds: bool = False
-    chained: bool = False        # jax_sim/jax_shard: chained per-rep timing
+    chained: bool = False        # jax_sim/jax_shard/jax_ici: chained timing
 
 
 def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
@@ -46,9 +46,10 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
         raise ValueError("data_size (-d) must be >= 1 "
                          "(the reference's -d 0 default sends empty messages; "
                          "pass an explicit size)")
-    if cfg.chained and cfg.backend not in ("jax_sim", "jax_shard"):
-        raise ValueError("--chained requires --backend jax_sim or "
-                         "jax_shard (serial-chained on-device measurement)")
+    if cfg.chained and cfg.backend not in ("jax_sim", "jax_shard",
+                                           "jax_ici"):
+        raise ValueError("--chained requires --backend jax_sim, jax_shard "
+                         "or jax_ici (serial-chained on-device measurement)")
     if cfg.chained and cfg.profile_rounds:
         raise ValueError("--chained and --profile-rounds are exclusive "
                          "(one program vs per-round programs)")
@@ -72,6 +73,16 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
         if m not in METHODS:
             raise ValueError(f"unknown method id {m}; valid ids: "
                              f"{sorted(METHODS)}")
+    if cfg.chained and cfg.backend in ("jax_ici", "jax_shard"):
+        # fail BEFORE any method runs: a run-all sweep must not crash
+        # mid-run (and leave a partial CSV) when it reaches m=15/16
+        tam_selected = [m for m in methods if METHODS[m].tam]
+        if tam_selected:
+            raise ValueError(
+                f"--chained on --backend {cfg.backend} does not support "
+                f"the TAM methods {tam_selected} (the two-level mesh "
+                f"engine times whole reps); use --backend jax_sim for a "
+                f"chained run-all, or pick a non-TAM method with -m")
     # schedules do not depend on the iteration (only the fill seed does):
     # compile once per method, reuse across iters
     compiled = {m: compile_method(m, pattern, barrier_type=cfg.barrier_type)
