@@ -237,3 +237,40 @@ def test_shard_single_device_mesh():
     # inside host-timer noise and make the differenced diff go negative
     per = b.measure_per_rep(compile_method(1, p))
     assert per > 0
+
+
+@pytest.mark.parametrize("method", [1, 17])
+def test_shard_profile_rounds(method):
+    """profile_rounds on the sharded tier: one timed dispatch per throttle
+    round (built from the same _apply_block_round as the whole-rep
+    program), per-round times mapped onto the phase buckets, delivery
+    byte-exact vs the oracle — including the barrier-carrying m=17."""
+    p = AggregatorPattern(16, 5, data_size=32, comm_size=4, proc_node=2)
+    b = JaxShardBackend()
+    sched = compile_method(method, p)
+    recv_s, timers = b.run(sched, verify=True, profile_rounds=True)
+    assert timers[0].total_time > 0
+    [round_times] = b.last_round_times
+    assert len(round_times) >= 2            # throttled: >= 2 rounds
+    assert all(t > 0 for t in round_times)
+    recv_o, _ = LocalBackend().run(sched, verify=True)
+    for got, want in zip(recv_s, recv_o):
+        if want is not None:
+            np.testing.assert_array_equal(got, want)
+    with pytest.raises(ValueError, match="exclusive"):
+        b.run(sched, chained=True, profile_rounds=True)
+
+
+def test_shard_profile_rounds_collective_fallback():
+    """Dense collective methods have one synthesized round — nothing to
+    decompose: profiled mode falls back to whole-rep timing with a single
+    segment per rep (jax_sim behavior), and last_round_times is fresh,
+    not stale from a previously profiled schedule."""
+    p = AggregatorPattern(16, 5, data_size=32, comm_size=4)
+    b = JaxShardBackend()
+    b.run(compile_method(1, p), profile_rounds=True)     # populates rounds
+    assert len(b.last_round_times[0]) > 1
+    recv, timers = b.run(compile_method(8, p), verify=True,
+                         profile_rounds=True, ntimes=2)
+    assert timers[0].total_time > 0
+    assert [len(rt) for rt in b.last_round_times] == [1, 1]

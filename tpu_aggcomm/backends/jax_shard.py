@@ -54,13 +54,35 @@ from tpu_aggcomm.backends.lanes import lane_layout, lanes_to_bytes, to_lanes
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import (Schedule, barrier_rounds_of,
                                        schedule_shape_key)
-from tpu_aggcomm.harness.attribution import attribute_total, weights_for
+from tpu_aggcomm.harness.attribution import (attribute_rounds,
+                                             attribute_total, weights_for)
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs, recv_slot_counts
 
 __all__ = ["JaxShardBackend", "block_round_tables"]
 
 AXIS = "dev"
+
+
+def _apply_block_round(flat_send, recv, pk, sc, nbar: int, F: int, w: int,
+                       jdt):
+    """One throttle round on one device's shard: gather the round's
+    outgoing blocks, one lax.all_to_all over the device axis, static
+    scatter of the landed payload, then the round's barriers as live psum
+    tokens into the trash row. Shared by the whole-rep program, the
+    scanned-round program, and the profile_rounds segments so the
+    profiled decomposition cannot drift from the program it decomposes
+    (the jax_sim `_apply_round` precedent)."""
+    vals = jnp.where(
+        (pk >= 0)[..., None],
+        jnp.take(flat_send, jnp.maximum(pk, 0), axis=0),
+        jnp.zeros((w,), jdt))
+    got = lax.all_to_all(vals, AXIS, 0, 0)          # (ndev, M, w)
+    recv = recv.at[sc.reshape(-1)].set(got.reshape(-1, w))
+    for _ in range(nbar):
+        tok = lax.psum(recv[0, 0].astype(jnp.int32), AXIS)
+        recv = recv.at[F - 1, 0].set(tok.astype(jdt))
+    return recv
 
 
 def _schedule_edges(schedule: Schedule) -> np.ndarray:
@@ -235,28 +257,8 @@ class JaxShardBackend:
             self._cache[key] = built
             return built
 
-        edges = _schedule_edges(schedule)
-        # compacted flat layouts: only ranks that send/receive get rows
-        # (a dense (n, nprocs)-slot layout would be n^2 at flagship scale)
-        counts = np.asarray(recv_slot_counts(p))
-        recv_base, F = recv_layout(counts, ndev, bsz)
-        if p.direction is Direction.ALL_TO_MANY:
-            scounts = np.full(n, p.cb_nodes, dtype=np.int64)
-        else:
-            scounts = np.where(np.asarray(p.agg_index) >= 0, n, 0)
-        send_base, Fs = recv_layout(scounts, ndev, bsz)
-        tabs = block_round_tables(edges, ndev=ndev, bsz=bsz,
-                                  send_base=send_base,
-                                  recv_base=recv_base, F=F)
-        barrier_rounds = barrier_rounds_of(schedule)
-        kept = {r for (r, *_rest) in tabs}
-        orphans = set(barrier_rounds) - kept
-        if orphans:
-            raise ValueError(
-                f"schedule {schedule.name!r} has barrier-only rounds "
-                f"{sorted(orphans)}; the block lowering cannot represent "
-                f"a standalone fence")
-
+        (counts, recv_base, F, send_base, Fs, tabs,
+         barrier_rounds) = self._layout_and_tabs(schedule, ndev, bsz)
         round_ids = [r for (r, *_rest) in tabs]
         # Many-round schedules compile O(rounds) unrolled; barrier-free
         # ones (the flagship sweep's m=1/m=2) scan instead: tables padded
@@ -286,13 +288,8 @@ class JaxShardBackend:
 
                 def body(recv, x):
                     pk, sc = x
-                    vals = jnp.where(
-                        (pk >= 0)[..., None],
-                        jnp.take(flat_send, jnp.maximum(pk, 0), axis=0),
-                        jnp.zeros((w,), jdt))
-                    got = lax.all_to_all(vals, AXIS, 0, 0)
-                    recv = recv.at[sc.reshape(-1)].set(
-                        got.reshape(-1, w))
+                    recv = _apply_block_round(flat_send, recv, pk, sc,
+                                              0, F, w, jdt)
                     return recv, ()
 
                 recv0 = jnp.zeros((F, w), dtype=jdt)
@@ -312,17 +309,9 @@ class JaxShardBackend:
                 # packs/scats: list of (1, ndev, M)
                 recv = jnp.zeros((F, w), dtype=jdt)
                 for k in range(len(packs)):
-                    pk = packs[k][0]            # (ndev, M)
-                    sc = scats[k][0]
-                    vals = jnp.where(
-                        (pk >= 0)[..., None],
-                        jnp.take(flat_send, jnp.maximum(pk, 0), axis=0),
-                        jnp.zeros((w,), jdt))
-                    got = lax.all_to_all(vals, AXIS, 0, 0)  # (ndev, M, w)
-                    recv = recv.at[sc.reshape(-1)].set(got.reshape(-1, w))
-                    for _ in range(barrier_rounds.get(round_ids[k], 0)):
-                        tok = lax.psum(recv[0, 0].astype(jnp.int32), AXIS)
-                        recv = recv.at[F - 1, 0].set(tok.astype(jdt))
+                    recv = _apply_block_round(
+                        flat_send, recv, packs[k][0], scats[k][0],
+                        barrier_rounds.get(round_ids[k], 0), F, w, jdt)
                     if k + 1 < len(packs):
                         flat_send, recv = lax.optimization_barrier(
                             (flat_send, recv))
@@ -379,6 +368,142 @@ class JaxShardBackend:
         return built
 
     # ------------------------------------------------------------------
+    def _layout_and_tabs(self, schedule, ndev: int, bsz: int):
+        """Shared host-side lowering for _compiled and _round_segments:
+        compacted flat layouts (only ranks that send/receive get rows — a
+        dense (n, nprocs)-slot layout would be n² at flagship scale),
+        per-round block tables, barrier rounds, and the orphan-barrier
+        check — one code path, so the profiled segments can never
+        decompose a different program than the whole-rep build runs, and
+        both modes accept exactly the same schedules."""
+        p = schedule.pattern
+        n = p.nprocs
+        counts = np.asarray(recv_slot_counts(p))
+        recv_base, F = recv_layout(counts, ndev, bsz)
+        if p.direction is Direction.ALL_TO_MANY:
+            scounts = np.full(n, p.cb_nodes, dtype=np.int64)
+        else:
+            scounts = np.where(np.asarray(p.agg_index) >= 0, n, 0)
+        send_base, Fs = recv_layout(scounts, ndev, bsz)
+        tabs = block_round_tables(_schedule_edges(schedule), ndev=ndev,
+                                  bsz=bsz, send_base=send_base,
+                                  recv_base=recv_base, F=F)
+        barrier_rounds = barrier_rounds_of(schedule)
+        orphans = set(barrier_rounds) - {r for (r, *_rest) in tabs}
+        if orphans:
+            raise ValueError(
+                f"schedule {schedule.name!r} has barrier-only rounds "
+                f"{sorted(orphans)}; the block lowering cannot represent "
+                f"a standalone fence")
+        return counts, recv_base, F, send_base, Fs, tabs, barrier_rounds
+
+    def _round_segments(self, schedule):
+        """Per-round jitted (send, recv) -> recv shard_map programs plus
+        their round ids and layout artifacts, for profile_rounds; None for
+        TAM (the 3-hop route has no throttle-round structure to split) and
+        for the dense collective methods (one synthesized round, nothing
+        to decompose — and jax_sim's profiled mode excludes them too).
+        Each segment is one `_apply_block_round` — the same function the
+        whole-rep program is built from."""
+        from tpu_aggcomm.tam.engine import TamMethod
+        if isinstance(schedule, TamMethod) or schedule.collective:
+            return None
+        key = (self._key(schedule), "segments")
+        if key in self._cache:
+            return self._cache[key]
+        p = schedule.pattern
+        n = p.nprocs
+        mesh, ndev = self._mesh(n)
+        bsz = n // ndev
+        _, jdt, w = lane_layout(p.data_size)
+        sharding = NamedSharding(mesh, P(AXIS))
+        (counts, recv_base, F, send_base, Fs, tabs,
+         barrier_rounds) = self._layout_and_tabs(schedule, ndev, bsz)
+        segs, round_ids = [], []
+        for (r, pk, sc, _M) in tabs:
+            pk_dev = jax.device_put(pk, sharding)
+            sc_dev = jax.device_put(sc, sharding)
+
+            def make_seg(pk_dev=pk_dev, sc_dev=sc_dev,
+                         nbar=barrier_rounds.get(r, 0)):
+                def local(send, recv, pkl, scl):
+                    return _apply_block_round(send[0], recv[0], pkl[0],
+                                              scl[0], nbar, F, w, jdt)[None]
+
+                sm = jax.shard_map(local, mesh=mesh,
+                                   in_specs=(P(AXIS),) * 4,
+                                   out_specs=P(AXIS))
+
+                @jax.jit
+                def seg(send, recv):
+                    return sm(send, recv, pk_dev, sc_dev)
+
+                return seg
+
+            segs.append(make_seg())
+            round_ids.append(r)
+        self._cache[key] = (segs, round_ids, mesh, ndev, bsz, F, Fs,
+                            send_base, recv_base, counts)
+        return self._cache[key]
+
+    def _run_profiled(self, schedule, iter_: int, verify: bool,
+                      ntimes: int, profiled):
+        """profile_rounds execution: one dispatch per throttle round, each
+        synced and timed, mapped onto the TimerBucket structure — exactly
+        jax_sim's profiled mode on the sharded tier (per-dispatch sync
+        overhead included; schedule-shape analysis, not headline numbers)."""
+        (segs, round_ids, mesh, ndev, bsz, F, Fs, send_base, recv_base,
+         counts) = profiled
+        p = schedule.pattern
+        n = p.nprocs
+        ndt, jdt, w = lane_layout(p.data_size)
+        sharding = NamedSharding(mesh, P(AXIS))
+        send_dev = jax.device_put(
+            self._global_send_flat(p, iter_, ndev, bsz, send_base, Fs),
+            sharding)
+        # one zeros template, reused as every rep's initial carry (arrays
+        # are immutable; re-uploading fresh zeros per rep would add an
+        # H2D transfer per rep through the tunnel)
+        recv0 = jax.device_put(np.zeros((ndev, F, w), dtype=ndt), sharding)
+
+        recv = recv0
+        for seg in segs:                   # warm-up compile every segment
+            recv = seg(send_dev, recv)
+        recv.block_until_ready()
+
+        timers = [Timer() for _ in range(n)]
+        self.last_rep_timers = []
+        self.last_round_times = []
+        attr_w = weights_for(schedule)
+        out = None
+        for _ in range(ntimes):
+            recv = recv0
+            round_times = []
+            for seg in segs:
+                ts = time.perf_counter()
+                recv = seg(send_dev, recv)
+                recv.block_until_ready()
+                round_times.append(time.perf_counter() - ts)
+            out = recv
+            self.last_round_times.append(round_times)
+            rep_attr = attribute_rounds(
+                schedule, dict(zip(round_ids, round_times)), weights=attr_w)
+            for r, t in enumerate(timers):
+                t += rep_attr[r]
+            self.last_rep_timers.append(rep_attr)
+
+        got_b = lanes_to_bytes(np.asarray(jax.device_get(out)), p.data_size)
+        recv_bufs = [
+            got_b[r // bsz,
+                  int(recv_base[r]):int(recv_base[r]) + int(counts[r])]
+            if counts[r] else None
+            for r in range(n)]
+        if verify:
+            from tpu_aggcomm.harness.verify import verify_recv
+            verify_recv(p, recv_bufs, iter_)
+        return recv_bufs, timers
+
+    # ------------------------------------------------------------------
     def _global_send_flat(self, p: AggregatorPattern, iter_: int,
                           ndev: int, bsz: int, send_base: np.ndarray,
                           Fs: int) -> np.ndarray:
@@ -424,11 +549,21 @@ class JaxShardBackend:
         return per_rep
 
     def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
-            verify: bool = False, chained: bool = False):
+            verify: bool = False, chained: bool = False,
+            profile_rounds: bool = False):
         from tpu_aggcomm.tam.engine import TamMethod
 
         if ntimes < 1:
             raise ValueError("ntimes must be >= 1")
+        if chained and profile_rounds:
+            raise ValueError("chained and profile_rounds are exclusive "
+                             "(one program vs per-round programs)")
+        if profile_rounds:
+            profiled = self._round_segments(schedule)
+            if profiled is not None:
+                return self._run_profiled(schedule, iter_, verify, ntimes,
+                                          profiled)
+            # TAM: no round structure to split — whole-rep timing below
         p = schedule.pattern
         n = p.nprocs
         n_send_slots, n_recv_slots = self._slots(p)
@@ -454,6 +589,7 @@ class JaxShardBackend:
 
         timers = [Timer() for _ in range(n)]
         self.last_rep_timers = []
+        self.last_round_times = []         # [rep] -> [per-round seconds]
         attr_w = weights_for(schedule)
         if chained:
             per_rep = self.measure_per_rep(schedule)
@@ -471,6 +607,11 @@ class JaxShardBackend:
                 for r, t in enumerate(timers):
                     t += rep_attr[r]
                 self.last_rep_timers.append(rep_attr)
+                if profile_rounds:
+                    # TAM/collective fallback: no round structure to split
+                    # — the whole rep is the single profiled segment, as
+                    # on jax_sim
+                    self.last_round_times.append([dt])
 
         got = np.asarray(jax.device_get(out))
         if is_tam:

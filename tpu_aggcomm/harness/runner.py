@@ -53,11 +53,12 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
     if cfg.chained and cfg.profile_rounds:
         raise ValueError("--chained and --profile-rounds are exclusive "
                          "(one program vs per-round programs)")
-    if cfg.profile_rounds and cfg.backend not in ("jax_ici", "jax_sim"):
+    if cfg.profile_rounds and cfg.backend not in ("jax_ici", "jax_sim",
+                                                  "jax_shard"):
         raise ValueError(
-            "--profile-rounds requires --backend jax_ici or jax_sim "
-            "(per-round fenced segments exist only there; local/native "
-            "time each op directly, jax_shard/pallas_dma attribute "
+            "--profile-rounds requires --backend jax_ici, jax_sim or "
+            "jax_shard (per-round fenced segments exist only there; "
+            "local/native time each op directly, pallas_dma attributes "
             "whole-rep time)")
     backend = get_backend(cfg.backend)
     pattern = AggregatorPattern(
@@ -93,7 +94,8 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             spec = METHODS[m]
             sched = compiled[m]
             kwargs = {}
-            if cfg.profile_rounds and backend.name in ("jax_ici", "jax_sim"):
+            if cfg.profile_rounds and backend.name in ("jax_ici", "jax_sim",
+                                                       "jax_shard"):
                 kwargs["profile_rounds"] = True
             if cfg.chained:
                 kwargs["chained"] = True
