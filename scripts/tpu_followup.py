@@ -1,0 +1,83 @@
+"""Round-3 follow-up evidence batch, one serial TPU client.
+
+Run detached (``nohup python scripts/tpu_followup.py > log 2>&1 &``) and
+poll the log — NEVER under ``timeout``/a kill-prone wrapper (a SIGTERM
+mid-kernel wedges the axon tunnel; CLAUDE.md gotchas). Stages, each
+printing as it completes:
+
+1. bench sanity — the headline number still reproduces post-recovery.
+2. jax_sim vs jax_shard(1-device) cross-check at n=1024 a=64 d=2048
+   m=1 unthrottled: two independent lowerings of the same schedule on
+   the same chip (dense rank-axis gather/scatter vs compacted block
+   all_to_all) — consistency bound + which lowering is faster at scale.
+3. per-round profile artifact — the README config (-m 1 -c 3) with
+   --profile-rounds on the real chip: per-round wall times for the 11
+   throttle rounds (schedule-shape analysis, dispatch sync included).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    # 1. headline sanity — BEFORE this process imports jax: bench.py must
+    # be the only client attached to the chip while it measures (two
+    # concurrent clients skew differenced numbers 2-7x, CLAUDE.md)
+    out = subprocess.run([sys.executable, "bench.py"], capture_output=True,
+                         text=True, cwd=os.path.dirname(
+                             os.path.dirname(os.path.abspath(__file__))))
+    print("bench:", out.stdout.strip().splitlines()[-1] if out.stdout
+          else out.stderr.strip()[-200:], flush=True)
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({dev.platform})", flush=True)
+
+    from tpu_aggcomm.backends.jax_shard import JaxShardBackend
+    from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+
+    # 2. cross-lowering consistency at scale
+    p = AggregatorPattern(nprocs=1024, cb_nodes=64, data_size=2048,
+                          comm_size=999_999_999)
+    sched = compile_method(1, p)
+    vol = 1024 * 64 * 2048
+    bshard = JaxShardBackend(devices=[dev])
+    t0 = time.perf_counter()
+    bshard.run(sched, ntimes=1, verify=True)
+    print(f"jax_shard n=1024 verified ({time.perf_counter() - t0:.0f}s)",
+          flush=True)
+    per_shard = bshard.measure_per_rep(sched, iters_small=20, iters_big=220,
+                                       trials=3, windows=2)
+    print(f"jax_shard(1dev): {per_shard * 1e3:.3f} ms/rep, "
+          f"{vol / per_shard / 1e9:.1f} GB/s", flush=True)
+    per_sim = JaxSimBackend(device=dev).measure_per_rep(sched)
+    print(f"jax_sim:         {per_sim * 1e3:.3f} ms/rep, "
+          f"{vol / per_sim / 1e9:.1f} GB/s", flush=True)
+
+    # 3. per-round profile of the README config (one rep, so the timer
+    # line and the per-round line describe the same rep)
+    from tpu_aggcomm.harness.timer import max_reduce
+    p3 = AggregatorPattern(nprocs=32, cb_nodes=14, data_size=2048,
+                           comm_size=3)
+    b3 = JaxSimBackend(device=dev)
+    _, timers = b3.run(compile_method(1, p3), ntimes=1, verify=True,
+                       profile_rounds=True)
+    rounds = b3.last_round_times[-1]
+    mx = max_reduce(timers)
+    print(f"profile -m 1 -c 3: {len(rounds)} rounds, per-round us = "
+          f"{[round(t * 1e6) for t in rounds]}", flush=True)
+    print(f"  max timer: post={mx.post_request_time:.6f} "
+          f"recv_wait={mx.recv_wait_all_time:.6f} "
+          f"total={mx.total_time:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
