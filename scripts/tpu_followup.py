@@ -13,6 +13,9 @@ printing as it completes:
 3. per-round profile artifact — the README config (-m 1 -c 3) with
    --profile-rounds on the real chip: per-round wall times for the 11
    throttle rounds (schedule-shape analysis, dispatch sync included).
+4. winner-table refresh — all 20 dispatched methods at the README
+   config, chained + verified, quiet chip (the RESULTS_TPU.md method
+   ranking re-measured on the current code).
 """
 
 import os
@@ -76,6 +79,20 @@ def main() -> int:
     print(f"  max timer: post={mx.post_request_time:.6f} "
           f"recv_wait={mx.recv_wait_all_time:.6f} "
           f"total={mx.total_time:.6f}", flush=True)
+
+    # 4. winner table: every dispatched method, README config, chained
+    # (jax_sim's serial-chain measurement covers TAM too — _one_rep
+    # lowers the 3-hop route like any other rep function)
+    from tpu_aggcomm.core.methods import METHODS, method_ids
+    results = []
+    for mid in method_ids():
+        sched_m = compile_method(mid, p3)
+        b3.run(sched_m, ntimes=1, verify=True)          # delivery check
+        per = b3.measure_per_rep(sched_m)
+        results.append((per, METHODS[mid].name))
+        print(f"  m={mid:>2} {METHODS[mid].name:<32} {per:.6f}", flush=True)
+    results.sort()
+    print(f"winner: {results[0][1]} ({results[0][0]:.6f}s)", flush=True)
     return 0
 
 
