@@ -250,7 +250,7 @@ class JaxShardBackend:
             # XLA-partitioned 3-hop TAM route: same program as jax_sim,
             # rank axis sharded; SPMD inserts the cross-device collectives
             from tpu_aggcomm.backends.jax_sim import JaxSimBackend
-            rep = JaxSimBackend()._one_rep(schedule)
+            rep = JaxSimBackend().one_rep(schedule)
             fn = jax.jit(rep, in_shardings=sharding,
                          out_shardings=sharding)
             built = (fn, mesh, ndev, bsz, None)

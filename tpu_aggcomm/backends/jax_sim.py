@@ -202,6 +202,13 @@ class JaxSimBackend:
         """Lane layout for this pattern's slabs (backends/lanes.py)."""
         return lane_layout(p.data_size)
 
+    def one_rep(self, schedule):
+        """Public rep builder: rep(send) -> recv, a pure jittable function
+        over the dense rank-axis layout (``dense_send_lanes``). External
+        consumers: the driver's ``entry()`` and jax_shard's sharded TAM
+        route."""
+        return self._one_rep(schedule)
+
     def _one_rep(self, schedule):
         """Build rep(send) -> recv, a pure jittable function."""
         from tpu_aggcomm.tam.engine import TamMethod
