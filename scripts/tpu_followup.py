@@ -33,8 +33,9 @@ def main() -> int:
     out = subprocess.run([sys.executable, "bench.py"], capture_output=True,
                          text=True, cwd=os.path.dirname(
                              os.path.dirname(os.path.abspath(__file__))))
-    print("bench:", out.stdout.strip().splitlines()[-1] if out.stdout
-          else out.stderr.strip()[-200:], flush=True)
+    lines = out.stdout.strip().splitlines()
+    print("bench:", lines[-1] if lines else out.stderr.strip()[-200:],
+          flush=True)
 
     import jax
 

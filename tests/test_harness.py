@@ -160,13 +160,19 @@ class TestPt2pt:
         assert elapsed < 60, f"scan chain should compile fast, took {elapsed:.0f}s"
 
     def test_chained_mode(self, tmp_path, monkeypatch):
+        # VERDICT r3 item 7: each rep is an INDEPENDENT differenced
+        # window, so rows are real samples (reference output is mean/std
+        # over reps, mpi_sendrecv_test.c:52-64) — distinct values with
+        # overwhelming probability, never synthetic copies of one mean.
         from tpu_aggcomm.harness.pt2pt import pt2pt_statistics
 
         monkeypatch.chdir(tmp_path)
         r = pt2pt_statistics(64, 3, 10, chained=True, out=io.StringIO())
         assert len(r["times"]) == 3
         assert all(t > 0 for t in r["times"])
-        assert r["times"][0] == r["times"][1] == r["times"][2]
+        assert len(set(r["times"])) > 1, \
+            "chained reps must be independent measurements, not copies"
+        assert r["std"] > 0
 
     def test_cli_chained_flag(self, tmp_path, capsys, monkeypatch):
         monkeypatch.chdir(tmp_path)

@@ -336,7 +336,11 @@ class JaxIciBackend:
         (every device depends on every other device's previous rep), and
         the fixed dispatch overhead is differenced away — the honest
         measurement through a tunneled or contended dispatch path, on the
-        one-rank-per-device tier. Cached per schedule."""
+        one-rank-per-device tier. Cached per schedule.
+
+        The chain is always seeded with the iter-0 fill regardless of any
+        ``run(iter_=k)`` that preceded it — timing does not depend on
+        payload values, matching the jax_sim/jax_shard chained paths."""
         from tpu_aggcomm.core.schedule import schedule_shape_key
         from tpu_aggcomm.harness.chained import differenced_per_rep
         from tpu_aggcomm.tam.engine import TamMethod

@@ -20,7 +20,8 @@ from __future__ import annotations
 import statistics
 import time
 
-__all__ = ["differenced_per_rep", "differenced_trials", "xor_word"]
+__all__ = ["differenced_per_rep", "differenced_trials", "scanned_chain",
+           "xor_word"]
 
 
 def xor_word(tok, lane_dtype):
@@ -67,7 +68,10 @@ def differenced_trials(chain_factory, send0, *, iters_small: int,
     int(jax.device_get(checksum(f_small(send0))))    # compile + warm
     int(jax.device_get(checksum(f_big(send0))))
     per = []
-    retries = trials  # noise budget: a jittery link can invert one diff
+    # noise budget: a jittery link can invert a diff; keep a floor so
+    # small-trials windows=1 callers (chained pt2pt with -k 1) are not
+    # one bad window away from aborting
+    retries = max(trials, 3)
     while len(per) < trials:
         t_s = timed(f_small)
         t_b = timed(f_big)

@@ -93,10 +93,17 @@ def pt2pt_statistics(data_size: int, ntimes: int, runs: int, *,
         sharding)
 
     if chained:
-        from tpu_aggcomm.harness.chained import differenced_per_rep
-        per_transfer = differenced_per_rep(make_chain, buf,
-                                           iters_small=50, iters_big=1050)
-        times = [per_transfer * runs] * max(ntimes, 1)
+        # Each rep is an INDEPENDENT differenced window (one fresh
+        # T(big)-T(small) pair), so the CSV rows are real samples and the
+        # reported std is the actual spread of the link measurement — the
+        # reference's output IS mean/std over reps
+        # (mpi_sendrecv_test.c:52-64). Chains compile once; only the
+        # re-timed windows repeat.
+        from tpu_aggcomm.harness.chained import differenced_trials
+        per_transfers = differenced_trials(make_chain, buf,
+                                           iters_small=50, iters_big=1050,
+                                           trials=max(ntimes, 1), windows=1)
+        times = [p * runs for p in per_transfers]
         total = sum(times)
     else:
         fn = make_chain(runs)
