@@ -181,7 +181,15 @@ class JaxIciBackend:
             raise ValueError("chained measurement for TAM runs on jax_sim "
                              "(single-chip route); the two-level mesh "
                              "engine times whole reps")
+        self.last_provenance = (
+            "jax_ici",
+            "attributed-chained" if chained
+            else "attributed-rounds" if profile_rounds
+            else "attributed")
         if isinstance(schedule, TamMethod):
+            # the two-level engine times whole reps (attribute_tam_total)
+            # regardless of profile_rounds — no per-round split to claim
+            self.last_provenance = ("jax_ici", "attributed")
             p = schedule.pattern
             devs = (list(self._devices) if self._devices is not None
                     else jax.devices())
@@ -207,6 +215,7 @@ class JaxIciBackend:
                                              iter_=iter_, verify=verify)
                 self.last_rep_timers = getattr(self._sim_delegate,
                                                "last_rep_timers", [])
+                self.last_provenance = self._sim_delegate.last_provenance
                 return out
             recv_bufs, rep_times = tam_two_level_jax(schedule, devs,
                                                      iter_, ntimes)
@@ -233,6 +242,10 @@ class JaxIciBackend:
         segments, seg_rounds, _mc, n_send_slots, n_recv_slots = \
             self._segments_for(schedule, mesh, sharding, profile_rounds)
         attr_w = None if schedule.collective else weights_for(schedule)
+        if profile_rounds and (seg_rounds is None or len(segments) <= 1):
+            # no round structure to split (collective / single-round):
+            # whole-rep attribution, and the sidecar must say so
+            self.last_provenance = ("jax_ici", "attributed")
 
         send_g = self._global_send(p, iter_, n_send_slots)
         send_dev = jax.device_put(send_g, sharding)

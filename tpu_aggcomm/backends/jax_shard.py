@@ -558,9 +558,13 @@ class JaxShardBackend:
         if chained and profile_rounds:
             raise ValueError("chained and profile_rounds are exclusive "
                              "(one program vs per-round programs)")
+        self.last_provenance = ("jax_shard",
+                                "attributed-chained" if chained
+                                else "attributed")
         if profile_rounds:
             profiled = self._round_segments(schedule)
             if profiled is not None:
+                self.last_provenance = ("jax_shard", "attributed-rounds")
                 return self._run_profiled(schedule, iter_, verify, ntimes,
                                           profiled)
             # TAM: no round structure to split — whole-rep timing below

@@ -375,6 +375,11 @@ class JaxSimBackend:
         # program — don't compile it (22 wasted compiles on a method sweep)
         profiled_segs = (self._round_segments(schedule) if profile_rounds
                          else None)
+        self.last_provenance = (
+            "jax_sim",
+            "attributed-chained" if chained
+            else "attributed-rounds" if profiled_segs is not None
+            else "attributed")
         out = None
         if not (profile_rounds and profiled_segs is not None):
             fn = self._compiled(schedule)

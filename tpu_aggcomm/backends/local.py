@@ -57,6 +57,10 @@ class LocalBackend:
     def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
             verify: bool = False):
         from tpu_aggcomm.tam.engine import TamMethod, tam_oracle
+        # rep wall time only; phase columns stay zero (the oracle times
+        # whole reps, not ops) — recorded so report sidecars can't read
+        # the zeros as measured phases
+        self.last_provenance = ("local", "total-only")
         p = schedule.pattern
         if isinstance(schedule, TamMethod):
             run_rep = lambda bufs: tam_oracle(schedule, iter_)  # noqa: E731

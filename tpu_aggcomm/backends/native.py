@@ -311,7 +311,9 @@ class NativeBackend:
             lb = self._local_delegate
             out = lb.run(schedule, ntimes=ntimes, iter_=iter_, verify=verify)
             self.last_rep_timers = getattr(lb, "last_rep_timers", [])
+            self.last_provenance = lb.last_provenance
             return out
+        self.last_provenance = ("native", "measured")
         lib = _load()
         p = schedule.pattern
         n, ds = p.nprocs, p.data_size

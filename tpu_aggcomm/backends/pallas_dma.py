@@ -129,6 +129,7 @@ class PallasDmaBackend:
             sb = self._sim_delegate
             out = sb.run(schedule, ntimes=ntimes, iter_=iter_, verify=verify)
             self.last_rep_timers = getattr(sb, "last_rep_timers", [])
+            self.last_provenance = sb.last_provenance
             return out
         if schedule.collective:
             # dense vendor-collective methods belong to lax.all_to_all;
@@ -139,8 +140,10 @@ class PallasDmaBackend:
             jb = self._ici_delegate
             out = jb.run(schedule, ntimes=ntimes, iter_=iter_, verify=verify)
             self.last_rep_timers = jb.last_rep_timers
+            self.last_provenance = jb.last_provenance
             return out
 
+        self.last_provenance = ("pallas_dma", "attributed")
         p = schedule.pattern
         n = p.nprocs
         devs = list(self._devices) if self._devices is not None else jax.devices()

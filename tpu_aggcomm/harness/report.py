@@ -6,6 +6,14 @@
 - :func:`save_all_timing` — the per-rank per-rep CSV dumps
   (``{prefix}{send_wait_all_times,total_times,post_request_time,
   barrier_time}_{comm_size}.csv``; mpi_test.c:2008-2066).
+- :func:`append_provenance` — a sidecar ``*.provenance.csv`` row per
+  results.csv row recording which backend actually executed the method
+  (``--backend pallas_dma`` delegates TAM methods to jax_sim and dense
+  collectives to jax_ici) and whether the four phase columns are direct
+  per-op measurements or an attribution of a measured total
+  (harness/attribution.py). The main CSV stays byte-compatible with the
+  reference (mpi_test.c:2068-2118) — provenance rides alongside, so
+  attributed rows can never be mistaken for measured ones downstream.
 """
 
 from __future__ import annotations
@@ -14,7 +22,8 @@ import os
 
 from tpu_aggcomm.harness.timer import Timer
 
-__all__ = ["summarize_results", "save_all_timing", "config_banner"]
+__all__ = ["summarize_results", "save_all_timing", "config_banner",
+           "append_provenance", "provenance_path"]
 
 _CSV_HEADER = (
     "Method,# of processes,# of aggregators,data size,max comm,ntimes,"
@@ -71,6 +80,46 @@ def summarize_results(procs: int, cb_nodes: int, data_size: int,
                 f"{_f(max_timer.post_request_time)},{_f(max_timer.send_wait_all_time)},"
                 f"{_f(max_timer.recv_wait_all_time)},{_f(max_timer.total_time)}\n")
     return block
+
+
+_PROV_HEADER = ("Method,backend requested,backend executed,phase columns\n")
+
+#: phase-column provenance vocabulary (the third sidecar column):
+#:   measured            direct per-op host timing (native)
+#:   total-only          only total_time measured; phase columns zero (local)
+#:   attributed          whole-rep measured total split by the
+#:                       fenced-segment model (harness/attribution.py)
+#:   attributed-rounds   per-round measured totals split within each round
+#:   attributed-chained  differenced serial-chain total, then attributed
+PHASE_SOURCES = ("measured", "total-only", "attributed",
+                 "attributed-rounds", "attributed-chained")
+
+
+def provenance_path(filename: str) -> str:
+    """Sidecar path for a results CSV: ``results.csv`` ->
+    ``results.provenance.csv``."""
+    stem = filename[:-4] if filename.endswith(".csv") else filename
+    return stem + ".provenance.csv"
+
+
+def append_provenance(filename: str, method_name: str, requested: str,
+                      executed: str, phases: str) -> str:
+    """Append one provenance row alongside a results.csv row.
+
+    ``requested`` is the --backend the user selected; ``executed`` the
+    backend that actually ran the rep (delegation makes them differ);
+    ``phases`` one of :data:`PHASE_SOURCES`. Append-mode with auto-header,
+    like the main CSV, so sweeps accumulate both files in lockstep."""
+    if phases not in PHASE_SOURCES:
+        raise ValueError(f"unknown phase source {phases!r}; "
+                         f"expected one of {PHASE_SOURCES}")
+    path = provenance_path(filename)
+    write_header = not os.path.exists(path)
+    with open(path, "a") as fh:
+        if write_header:
+            fh.write(_PROV_HEADER)
+        fh.write(f"{method_name},{requested},{executed},{phases}\n")
+    return path
 
 
 def save_all_timing(procs: int, ntimes: int, comm_size: int,

@@ -9,8 +9,8 @@ from dataclasses import dataclass, field
 from tpu_aggcomm.backends import get_backend
 from tpu_aggcomm.core.methods import METHODS, compile_method, method_ids
 from tpu_aggcomm.core.pattern import AggregatorPattern
-from tpu_aggcomm.harness.report import (config_banner, save_all_timing,
-                                        summarize_results)
+from tpu_aggcomm.harness.report import (append_provenance, config_banner,
+                                        save_all_timing, summarize_results)
 from tpu_aggcomm.harness.timer import Timer, max_reduce
 
 __all__ = ["ExperimentConfig", "run_experiment"]
@@ -106,6 +106,15 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
                               cfg.comm_size, cfg.ntimes, cfg.agg_type,
                               cfg.results_csv, spec.name, timers[0],
                               max_timer, out=out)
+            # provenance sidecar, one row per results row (VERDICT r3
+            # item 8): which backend executed (delegation differs from
+            # the request) and whether phase columns are measured or
+            # attributed — the main CSV stays reference-byte-compatible
+            executed, phases = getattr(backend, "last_provenance",
+                                       (backend.name, "total-only"))
+            if cfg.results_csv:
+                append_provenance(cfg.results_csv, spec.name, cfg.backend,
+                                  executed, phases)
             if m == 13:
                 rep_timers = getattr(backend, "last_rep_timers", None)
                 if rep_timers:
@@ -114,6 +123,7 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             records.append({
                 "iter": i, "method": m, "name": spec.name,
                 "timer0": timers[0], "max_timer": max_timer,
+                "backend_executed": executed, "phase_source": phases,
             })
         print("| --------------------------------------", file=out)
     return records
