@@ -123,3 +123,37 @@ class TestDistributedInitIdempotency:
         monkeypatch.setattr(jax.distributed, "initialize", boom)
         assert par.distributed_init() is False
         self._reset()
+
+
+def test_bringup_single_process_degenerate():
+    """run_rep_across_processes on the single-process 8-device CPU mesh:
+    every shard is addressable, so the multi-controller code path
+    (put_global shard feeding + addressable_shards verification) runs in
+    its degenerate form — delivery byte-verified for every aggregator."""
+    import jax
+
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+    from tpu_aggcomm.parallel.bringup import run_rep_across_processes
+
+    p = AggregatorPattern(nprocs=8, cb_nodes=3, data_size=256, comm_size=2)
+    stats = run_rep_across_processes(p, 1, devices=jax.devices()[:8])
+    assert stats["process_count"] == 1
+    assert stats["ranks_verified"] == [0, 3, 6]   # placement-1 aggregators
+
+
+def test_two_process_bringup_end_to_end():
+    """VERDICT r3 item 5: the multi-host path end-to-end — two REAL
+    processes joined via jax.distributed (coordinator on localhost), a
+    global 8-device mesh, the hierarchical (node x local) mesh from live
+    topology, one m=1 rep over cross-process collectives, per-process
+    local-shard verification (scripts/two_process_bringup.py)."""
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "two_process_bringup.py")
+    out = subprocess.run([sys.executable, script], capture_output=True,
+                         text=True, timeout=600)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "TWO-PROCESS BRING-UP: OK" in out.stdout

@@ -75,6 +75,22 @@ def test_jax_sim_marks_attribution_modes(tmp_path):
     assert rows[-1]["phase columns"] == "attributed-rounds"
 
 
+@pytest.mark.parametrize("backend", ["jax_sim", "jax_ici", "jax_shard"])
+def test_single_round_profile_downgrades_everywhere(tmp_path, backend):
+    # unthrottled m=1 on a small pattern compiles to ONE round: there is
+    # no multi-round split to measure, so every tier must label the row
+    # whole-rep "attributed" — backends may not disagree for the same
+    # schedule (code-review r4 finding)
+    cfg = ExperimentConfig(
+        nprocs=8, cb_nodes=3, data_size=64, comm_size=200_000_000,
+        method=1, backend=backend, verify=True, profile_rounds=True,
+        results_csv=str(tmp_path / "results.csv"))
+    import io
+    run_experiment(cfg, out=io.StringIO())
+    rows = _rows(provenance_path(str(tmp_path / "results.csv")))
+    assert rows[-1]["phase columns"] == "attributed"
+
+
 def test_pallas_dma_records_delegation(tmp_path):
     # semaphore transport proper
     _, rows = _run(tmp_path, "pallas_dma", 1)
@@ -111,7 +127,23 @@ def test_run_all_rows_align_with_results_csv(tmp_path):
     assert len(main_rows) == len(prov_rows) > 10
     assert [r["Method"] for r in main_rows] == \
         [r["Method"] for r in prov_rows]
+    # the join key is explicit: row k of the sidecar names data row k
+    assert [r["results row"] for r in prov_rows] == \
+        [str(k + 1) for k in range(len(main_rows))]
     assert all(r["phase columns"] in PHASE_SOURCES for r in prov_rows)
+
+
+def test_preexisting_results_csv_cannot_shift_labels(tmp_path):
+    # a results.csv that predates the sidecar (append mode accumulates
+    # across framework versions): the explicit row key must point at the
+    # row actually described, never re-aligned from 1
+    csv_path = tmp_path / "results.csv"
+    with open(csv_path, "w") as fh:
+        fh.write("Method,# of processes,x\n")
+        fh.write("Old row,32,1\nOld row,32,2\n")      # 2 legacy data rows
+    _, rows = _run(tmp_path, "local", 1)
+    assert rows[-1]["results row"] == "3"
+    assert rows[-1]["Method"] == "All to many"
 
 
 def test_main_csv_stays_reference_compatible(tmp_path):

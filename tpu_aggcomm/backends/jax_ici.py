@@ -51,9 +51,22 @@ from tpu_aggcomm.harness.attribution import (attribute_rounds,
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs
 
-__all__ = ["JaxIciBackend", "color_rounds", "lower_schedule"]
+__all__ = ["JaxIciBackend", "color_rounds", "lower_schedule", "put_global"]
 
 AXIS = "ranks"
+
+
+def put_global(arr: np.ndarray, sharding) -> jax.Array:
+    """``device_put`` that also works when the sharding spans processes.
+
+    On a multi-controller runtime every process holds the same host value
+    (schedules and fills are pure functions of the config — the MAP_DATA
+    discipline) and contributes its addressable shards; single-process is
+    the plain device_put fast path."""
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
 
 
 def color_rounds(edges: np.ndarray) -> list[list[tuple[int, int]]]:
@@ -419,8 +432,8 @@ class JaxIciBackend:
         else:
             seg_bounds.append((0, low.n_colors))
 
-        ss_dev = jax.device_put(low.sslot_tab, sharding)
-        rs_dev = jax.device_put(low.rslot_tab, sharding)
+        ss_dev = put_global(low.sslot_tab, sharding)
+        rs_dev = put_global(low.rslot_tab, sharding)
 
         def rep_body(send, recv, sslot, rslot, c0, c1):
             # one device's slice of color steps [c0, c1): send (S, w),
@@ -471,10 +484,14 @@ class JaxIciBackend:
                 local_fn, mesh=mesh,
                 in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
                 out_specs=P(AXIS))
+            jf = jax.jit(sm)
 
-            @jax.jit
             def seg(send, recv):
-                return sm(send, recv, ss_dev, rs_dev)
+                # tables ride as ARGUMENTS, not jit closures: closing
+                # over an array spanning non-addressable devices is
+                # rejected on multi-controller runtimes (the 2-process
+                # bring-up path, parallel/bringup.py)
+                return jf(send, recv, ss_dev, rs_dev)
 
             return seg
 
@@ -490,10 +507,10 @@ class JaxIciBackend:
 
             csm = jax.shard_map(chain_local, mesh=mesh,
                                 in_specs=(P(AXIS),) * 3, out_specs=P(AXIS))
+            cjf = jax.jit(csm)
 
-            @jax.jit
             def chain(send):
-                return csm(send, ss_dev, rs_dev)
+                return cjf(send, ss_dev, rs_dev)
 
             return chain
 

@@ -564,7 +564,11 @@ class JaxShardBackend:
         if profile_rounds:
             profiled = self._round_segments(schedule)
             if profiled is not None:
-                self.last_provenance = ("jax_shard", "attributed-rounds")
+                # single-segment split = whole-rep attribution (same
+                # downgrade rule as jax_sim/jax_ici)
+                self.last_provenance = (
+                    "jax_shard", "attributed-rounds"
+                    if len(profiled[0]) > 1 else "attributed")
                 return self._run_profiled(schedule, iter_, verify, ntimes,
                                           profiled)
             # TAM: no round structure to split — whole-rep timing below

@@ -375,10 +375,14 @@ class JaxSimBackend:
         # program — don't compile it (22 wasted compiles on a method sweep)
         profiled_segs = (self._round_segments(schedule) if profile_rounds
                          else None)
+        # "attributed-rounds" only when a real multi-round split was
+        # measured — a single segment is whole-rep attribution whatever
+        # machinery ran it (same downgrade rule on jax_ici/jax_shard)
         self.last_provenance = (
             "jax_sim",
             "attributed-chained" if chained
-            else "attributed-rounds" if profiled_segs is not None
+            else "attributed-rounds" if (profiled_segs is not None
+                                         and len(profiled_segs[0]) > 1)
             else "attributed")
         out = None
         if not (profile_rounds and profiled_segs is not None):

@@ -82,7 +82,8 @@ def summarize_results(procs: int, cb_nodes: int, data_size: int,
     return block
 
 
-_PROV_HEADER = ("Method,backend requested,backend executed,phase columns\n")
+_PROV_HEADER = ("results row,Method,backend requested,backend executed,"
+                "phase columns\n")
 
 #: phase-column provenance vocabulary (the third sidecar column):
 #:   measured            direct per-op host timing (native)
@@ -104,21 +105,27 @@ def provenance_path(filename: str) -> str:
 
 def append_provenance(filename: str, method_name: str, requested: str,
                       executed: str, phases: str) -> str:
-    """Append one provenance row alongside a results.csv row.
+    """Append one provenance row describing the LAST results.csv row.
 
     ``requested`` is the --backend the user selected; ``executed`` the
     backend that actually ran the rep (delegation makes them differ);
     ``phases`` one of :data:`PHASE_SOURCES`. Append-mode with auto-header,
-    like the main CSV, so sweeps accumulate both files in lockstep."""
+    like the main CSV. The join key is explicit — the ``results row``
+    column carries the 1-based data-row index of the main CSV at append
+    time — so a results.csv that predates the sidecar (append mode
+    accumulates across invocations and framework versions) can never
+    silently shift labels onto the wrong rows."""
     if phases not in PHASE_SOURCES:
         raise ValueError(f"unknown phase source {phases!r}; "
                          f"expected one of {PHASE_SOURCES}")
+    with open(filename) as fh:
+        nrows = sum(1 for _ in fh) - 1   # minus the auto-header
     path = provenance_path(filename)
     write_header = not os.path.exists(path)
     with open(path, "a") as fh:
         if write_header:
             fh.write(_PROV_HEADER)
-        fh.write(f"{method_name},{requested},{executed},{phases}\n")
+        fh.write(f"{nrows},{method_name},{requested},{executed},{phases}\n")
     return path
 
 
