@@ -146,6 +146,21 @@ def test_preexisting_results_csv_cannot_shift_labels(tmp_path):
     assert rows[-1]["Method"] == "All to many"
 
 
+def test_old_schema_sidecar_is_rotated_not_appended(tmp_path):
+    # a sidecar from an older framework version (different header) must
+    # never have current-schema rows appended beneath it — columns would
+    # silently shift; it is rotated aside and a fresh file started
+    sidecar = provenance_path(str(tmp_path / "results.csv"))
+    with open(sidecar, "w") as fh:
+        fh.write("Method,backend requested,backend executed,phase columns\n")
+        fh.write("Old row,local,local,attributed\n")
+    _, rows = _run(tmp_path, "local", 1)
+    assert rows[-1]["results row"] == "1"
+    assert rows[-1]["phase columns"] == "total-only"
+    with open(sidecar + ".old-schema") as fh:
+        assert "Old row" in fh.read()
+
+
 def test_main_csv_stays_reference_compatible(tmp_path):
     # the provenance sidecar must not touch the main CSV's header
     # (byte-compat with mpi_test.c:2068-2118 is a CLAUDE.md invariant)

@@ -131,3 +131,95 @@ class TestRaggedNodeMaps:
             recv, timers = JaxIciBackend().run(sched, verify=True)
         assert any("jax_sim" in str(w.message) for w in rec)
         assert timers[0].total_time > 0
+
+
+class TestShardedTwoLevel:
+    """Blocked two-level engine (VERDICT r3 item 9): B logical ranks per
+    device on a (Dn, Dl) grid — the collective_write relay as two padded
+    block all_to_alls (tam_two_level_sharded), the flagship TAM tier."""
+
+    def test_grid_selection(self):
+        from tpu_aggcomm.tam.engine import sharded_grid
+
+        assert sharded_grid(8, 8, 8) == (4, 2)       # balanced, node-major
+        assert sharded_grid(256, 64, 8) == (4, 2)    # the flagship shape
+        assert sharded_grid(4, 16, 8) == (4, 2)
+        assert sharded_grid(2, 4, 8) == (2, 4)       # only split
+        with pytest.raises(ValueError, match="no .Dn, Dl. grid"):
+            sharded_grid(3, 5, 8)
+
+    @pytest.mark.parametrize("method", [15, 16])
+    @pytest.mark.parametrize("grid", [(1, 8), (8, 1), (4, 2), (2, 4)])
+    def test_matches_oracle_bytewise(self, method, grid):
+        import jax
+
+        from tpu_aggcomm.tam.engine import tam_two_level_sharded
+
+        p = AggregatorPattern(nprocs=64, cb_nodes=6, data_size=52,
+                              proc_node=8)          # u8 lane path (52%4!=0)
+        sched = compile_method(method, p)
+        recv, times = tam_two_level_sharded(sched, jax.devices(), iter_=2,
+                                            ntimes=1, mesh_shape=grid)
+        oracle = tam_oracle(sched, 2)
+        for r in range(64):
+            if oracle[r] is None:
+                assert recv[r] is None
+            else:
+                np.testing.assert_array_equal(recv[r], oracle[r])
+        assert all(t > 0 for t in times)
+
+    @pytest.mark.parametrize("method", [15, 16])
+    def test_jax_shard_routes_through_blocked_engine(self, method):
+        from tpu_aggcomm.backends.jax_shard import JaxShardBackend
+
+        p = AggregatorPattern(nprocs=64, cb_nodes=6, data_size=64,
+                              proc_node=8)
+        b = JaxShardBackend()
+        recv, timers = b.run(compile_method(method, p), verify=True)
+        assert b.last_provenance == ("jax_shard", "attributed")
+        assert timers[0].total_time > 0
+        # the sharded-one-rep fallback would also verify — pin the route:
+        # a blocked grid exists for (N=8, L=8, ndev=8), so the engine ran
+        assert b._run_tam_sharded(compile_method(method, p), 0, 1,
+                                  False, False) is not None
+
+    def test_invalid_explicit_split_raises_like_every_route(self):
+        from tpu_aggcomm.backends.jax_shard import JaxShardBackend
+
+        # _mesh raises on non-dividing ranks_per_device for every other
+        # method; the blocked TAM route must not silently floor-divide
+        p = AggregatorPattern(nprocs=64, cb_nodes=6, data_size=64,
+                              proc_node=8)
+        b = JaxShardBackend(ranks_per_device=48)
+        with pytest.raises(ValueError, match="must divide nprocs"):
+            b.run(compile_method(15, p))
+
+    def test_ragged_node_falls_back(self):
+        from tpu_aggcomm.backends.jax_shard import JaxShardBackend
+
+        # nprocs % proc_node != 0: no exact N*L blocking; jax_shard must
+        # fall back to the sharded-one-rep route and still verify
+        p = AggregatorPattern(nprocs=10, cb_nodes=3, data_size=64,
+                              proc_node=3)
+        b = JaxShardBackend()
+        assert b._run_tam_sharded(compile_method(15, p), 0, 1,
+                                  False, False) is None
+        recv, timers = b.run(compile_method(15, p), verify=True)
+        assert timers[0].total_time > 0
+
+    @pytest.mark.parametrize("method", [15, 16])
+    def test_flagship_16384_ranks_on_8_devices(self, method):
+        """The reference's defining TAM configuration — 16,384 ranks on
+        256 nodes x 64 ranks (script_theta_all_to_many_256.sh:3,11) —
+        through the EXPLICIT blocked two-level engine on the 8-device
+        mesh (2048 logical ranks per device), byte-verified."""
+        from tpu_aggcomm.backends.jax_shard import JaxShardBackend
+
+        p = AggregatorPattern(nprocs=16384, cb_nodes=256, data_size=64,
+                              proc_node=64)
+        b = JaxShardBackend()
+        sched = compile_method(method, p)
+        recv, timers = b.run(sched, verify=True, ntimes=1)
+        assert b.last_provenance == ("jax_shard", "attributed")
+        n_recv = sum(1 for r in recv if r is not None)
+        assert n_recv == (256 if method == 15 else 16384)

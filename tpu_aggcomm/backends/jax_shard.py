@@ -222,6 +222,65 @@ class JaxShardBackend:
         return Mesh(np.array(devs[:d]), (AXIS,)), d
 
     # ------------------------------------------------------------------
+    def _run_tam_sharded(self, schedule, iter_: int, ntimes: int,
+                         verify: bool, profile_rounds: bool):
+        """m=15/16 through the explicit blocked two-level engine
+        (tam_two_level_sharded): B logical ranks per device on a
+        (node, local) grid — the collective_write relay as two padded
+        block all_to_alls, NOT the sharded-jax_sim one-rep route. Returns
+        None when the node map doesn't block onto a grid (ragged node, or
+        no (Dn, Dl) split of the device pool divides (N, L)); the caller
+        then falls back."""
+        from tpu_aggcomm.parallel import host_major_devices
+        from tpu_aggcomm.tam.engine import (sharded_grid,
+                                            tam_two_level_sharded)
+
+        p = schedule.pattern
+        na = schedule.assignment
+        L, N = int(na.node_sizes[0]), na.nnodes
+        devs = host_major_devices(self._devices)
+        if p.nprocs != N * L:
+            return None                     # ragged last node
+        if self._ranks_per_device and p.nprocs % self._ranks_per_device:
+            # same contract as _mesh on every other route: an invalid
+            # explicit split raises, it is never silently floor-divided
+            raise ValueError(
+                f"ranks_per_device={self._ranks_per_device} must divide "
+                f"nprocs={p.nprocs}")
+        ndev = (p.nprocs // self._ranks_per_device
+                if self._ranks_per_device else min(len(devs), p.nprocs))
+        while ndev > 0:
+            try:
+                grid = sharded_grid(N, L, ndev)
+                break
+            except ValueError:
+                if self._ranks_per_device:
+                    return None             # explicit split doesn't fit
+                ndev -= 1
+        if ndev <= 0 or ndev > len(devs):
+            return None
+        recv_bufs, rep_times = tam_two_level_sharded(
+            schedule, devs[:ndev], iter_, ntimes, mesh_shape=grid,
+            cache=self._cache)
+        attr_w = weights_for(schedule)
+        timers = [Timer() for _ in range(p.nprocs)]
+        self.last_rep_timers = []
+        self.last_round_times = []
+        for dt in rep_times:
+            rep_attr = attribute_total(schedule, dt, weights=attr_w)
+            for r, t in enumerate(timers):
+                t += rep_attr[r]
+            self.last_rep_timers.append(rep_attr)
+            if profile_rounds:
+                # whole rep = the single profiled segment (no round
+                # structure in the 3-hop route), as on jax_sim
+                self.last_round_times.append([dt])
+        if verify:
+            from tpu_aggcomm.harness.verify import verify_recv
+            verify_recv(p, recv_bufs, iter_)
+        return recv_bufs, timers
+
+    # ------------------------------------------------------------------
     def _slots(self, p: AggregatorPattern) -> tuple[int, int]:
         from tpu_aggcomm.harness.verify import slot_shapes
         return slot_shapes(p)
@@ -574,16 +633,23 @@ class JaxShardBackend:
             # TAM: no round structure to split — whole-rep timing below
         p = schedule.pattern
         n = p.nprocs
+        is_tam = isinstance(schedule, TamMethod)
+        if is_tam and chained:
+            raise ValueError("chained measurement for TAM runs on "
+                             "jax_sim/jax_ici, not jax_shard")
+        if is_tam:
+            out = self._run_tam_sharded(schedule, iter_, ntimes, verify,
+                                        profile_rounds)
+            if out is not None:
+                return out
+            # node map doesn't block onto a (Dn, Dl) grid: the sharded-
+            # one-rep route below still covers it
         n_send_slots, n_recv_slots = self._slots(p)
         _, jdt, w = lane_layout(p.data_size)
         fn, mesh, ndev, bsz, extra = self._compiled(schedule)
         sharding = NamedSharding(mesh, P(AXIS))
 
-        is_tam = isinstance(schedule, TamMethod)
         if is_tam:
-            if chained:
-                raise ValueError("chained measurement for TAM runs on "
-                                 "jax_sim/jax_ici, not jax_shard")
             from tpu_aggcomm.backends.jax_sim import dense_send_lanes
             send_dev = jax.device_put(dense_send_lanes(p, iter_), sharding)
         else:

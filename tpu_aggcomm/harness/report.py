@@ -122,6 +122,14 @@ def append_provenance(filename: str, method_name: str, requested: str,
         nrows = sum(1 for _ in fh) - 1   # minus the auto-header
     path = provenance_path(filename)
     write_header = not os.path.exists(path)
+    if not write_header:
+        # a sidecar written under an older schema must never get rows of
+        # the current schema appended beneath its header (columns would
+        # silently shift) — rotate it aside and start fresh
+        with open(path) as fh:
+            if fh.readline() != _PROV_HEADER:
+                os.replace(path, path + ".old-schema")
+                write_header = True
     with open(path, "a") as fh:
         if write_header:
             fh.write(_PROV_HEADER)
