@@ -52,7 +52,7 @@ def main() -> int:
     sharding = NamedSharding(mesh, P("ranks"))
     for mid in (1, 6, 7, 11, 12, 18):
         sched = compile_method(mid, p)
-        fn, pds, n_send_slots, _n_recv_slots, tabs = b._lower(
+        fn, pds, n_send_slots, _n_recv_slots, tabs, _waves = b._lower(
             sched, mesh, interpret=False)
         send_shape = jax.ShapeDtypeStruct((1, n_send_slots + 1, 4, pds // 4),
                                           np.uint8, sharding=sharding)

@@ -83,7 +83,7 @@ def test_barrier_step_count_is_logarithmic():
     sched = compile_method(1, p)
     b = PallasDmaBackend()
     mesh = Mesh(np.array(jax.devices()[:8]), ("ranks",))
-    _fn, _pds, _ns, _nr, tabs = b._lower(sched, mesh, interpret=True)
+    _fn, _pds, _ns, _nr, tabs, _waves = b._lower(sched, mesh, interpret=True)
     from tpu_aggcomm.backends.jax_ici import lower_schedule
     C = lower_schedule(sched).n_colors
     assert tabs[0].shape[1] == 3 + 2 * C
@@ -109,4 +109,90 @@ def test_pallas_compiled_on_tpu():
     p = AggregatorPattern(1, 1, data_size=2048, comm_size=1)
     sched = compile_method(1, p)
     b = PallasDmaBackend(devices=[jax.devices()[0]], interpret=False)
+    recv, _ = b.run(sched, ntimes=1, verify=True)
+
+
+class TestConcurrentMode:
+    """Concurrent posting discipline (VERDICT r3 item 3): a round's DMAs
+    are all in flight before any wait — in-flight per round = throttle c
+    (the Issend storm then Waitall, mpi_test.c:1789-1815). Lockstep stays
+    the deterministic baseline; both must deliver identical bytes."""
+
+    @pytest.mark.parametrize("method", [1, 6, 7, 11, 12, 17, 18])
+    def test_delivery_matches_lockstep(self, method):
+        import numpy as np
+
+        p = AggregatorPattern(8, 3, data_size=52, comm_size=2, proc_node=2)
+        sched = compile_method(method, p)
+        r_lock, _ = PallasDmaBackend().run(sched, verify=True, iter_=3)
+        r_conc, _ = PallasDmaBackend(concurrent=True).run(sched,
+                                                          verify=True,
+                                                          iter_=3)
+        for a, b in zip(r_lock, r_conc):
+            assert (a is None) == (b is None)
+            if a is not None:
+                np.testing.assert_array_equal(a, b)
+
+    def test_wave_structure(self):
+        from jax.sharding import Mesh
+        import jax
+        import numpy as np
+
+        p = AggregatorPattern(8, 3, data_size=64, comm_size=1)
+        sched = compile_method(1, p)   # c=1: many single-color rounds
+        mesh = Mesh(np.array(jax.devices()[:8]), ("ranks",))
+        *_, w_lock = PallasDmaBackend()._lower(sched, mesh, True)
+        *_, w_conc = PallasDmaBackend(concurrent=True)._lower(sched, mesh,
+                                                              True)
+        # lockstep: every wave is exactly one step
+        assert all(s1 - s0 == 1 for s0, s1 in w_lock)
+        # same total step count: concurrency changes posting, not steps
+        assert sum(s1 - s0 for s0, s1 in w_lock) == \
+            sum(s1 - s0 for s0, s1 in w_conc)
+        # m=1 is rendezvous: each data wave is preceded by a grant wave
+        # of the same width; multi-step waves appear only in conc mode
+        assert len(w_conc) <= len(w_lock)
+
+    def test_throttle_widens_concurrent_waves(self):
+        from jax.sharding import Mesh
+        import jax
+        import numpy as np
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("ranks",))
+        widths = {}
+        for c in (1, 8):
+            p = AggregatorPattern(8, 4, data_size=64, comm_size=c)
+            sched = compile_method(1, p)
+            *_, waves = PallasDmaBackend(concurrent=True)._lower(
+                sched, mesh, True)
+            widths[c] = max(s1 - s0 for s0, s1 in waves)
+        # a deeper throttle admits more concurrent copies per round: the
+        # widest wave grows with c — the property the mode exists for.
+        # (Small c is floor-bounded by sender-side serialization: each
+        # sender's a slabs of a round need a colors regardless of the
+        # receiver-side c bound, so compare the unthrottled end.)
+        assert widths[8] > widths[1]
+
+    def test_registry_and_provenance(self):
+        from tpu_aggcomm.backends import get_backend
+
+        b = get_backend("pallas_dma_conc")
+        assert b.name == "pallas_dma_conc"
+        p = AggregatorPattern(8, 3, data_size=64, comm_size=2)
+        b.run(compile_method(1, p), verify=True)
+        assert b.last_provenance == ("pallas_dma_conc", "attributed")
+
+
+def test_pallas_concurrent_compiled_on_tpu():
+    """Platform-gated: the concurrent (round-wide wave) kernel through
+    the real Mosaic pipeline on the degenerate 1-device mesh, verified —
+    the compile proof VERDICT r3 item 3 asks for alongside the interpret
+    equality pins."""
+    import jax
+    if jax.devices()[0].platform != "tpu":
+        pytest.skip("needs a real TPU (see scripts/tpu_pallas_probe.py)")
+    p = AggregatorPattern(1, 1, data_size=2048, comm_size=1)
+    sched = compile_method(1, p)
+    b = PallasDmaBackend(devices=[jax.devices()[0]], interpret=False,
+                         concurrent=True)
     recv, _ = b.run(sched, ntimes=1, verify=True)

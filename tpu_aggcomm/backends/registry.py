@@ -6,7 +6,7 @@ __all__ = ["BACKENDS", "DEVICE_FREE_BACKENDS", "SHARDED_RANK_BACKENDS",
            "SINGLE_DEVICE_BACKENDS", "get_backend"]
 
 BACKENDS = ("local", "jax_ici", "jax_sim", "jax_shard", "pallas_dma",
-            "native")
+            "pallas_dma_conc", "native")
 
 # backends that execute without accelerator devices (pure host runtimes)
 DEVICE_FREE_BACKENDS = ("local", "native")
@@ -37,6 +37,12 @@ def get_backend(name: str):
         if name == "pallas_dma":
             from tpu_aggcomm.backends.pallas_dma import PallasDmaBackend
             return PallasDmaBackend()
+        if name == "pallas_dma_conc":
+            # concurrent posting discipline: a round's remote copies are
+            # all in flight together (in-flight = throttle c), waits
+            # drain at round end — the Issend-storm mode
+            from tpu_aggcomm.backends.pallas_dma import PallasDmaBackend
+            return PallasDmaBackend(concurrent=True)
         if name == "native":
             from tpu_aggcomm.backends.native import NativeBackend
             return NativeBackend()

@@ -128,7 +128,11 @@ def append_provenance(filename: str, method_name: str, requested: str,
         # silently shift) — rotate it aside and start fresh
         with open(path) as fh:
             if fh.readline() != _PROV_HEADER:
-                os.replace(path, path + ".old-schema")
+                k, bak = 0, path + ".old-schema"
+                while os.path.exists(bak):   # never clobber a prior backup
+                    k += 1
+                    bak = f"{path}.old-schema.{k}"
+                os.replace(path, bak)
                 write_header = True
     with open(path, "a") as fh:
         if write_header:
