@@ -16,6 +16,9 @@ printing as it completes:
 4. winner-table refresh — all 20 dispatched methods at the README
    config, chained + verified, quiet chip (the RESULTS_TPU.md method
    ranking re-measured on the current code).
+5. measured phase split (round 4) — the truncation-differenced
+   post/deliver boundary on the real chip for 5 round-structured
+   methods, printed next to the attribution model's share.
 """
 
 import os
@@ -94,6 +97,20 @@ def main() -> int:
         print(f"  m={mid:>2} {METHODS[mid].name:<32} {per:.6f}", flush=True)
     results.sort()
     print(f"winner: {results[0][1]} ({results[0][0]:.6f}s)", flush=True)
+
+    # 5. measured phase split vs the attribution model, on the chip
+    from tpu_aggcomm.core.schedule import TimerBucket
+    from tpu_aggcomm.harness.attribution import weights_for
+    for mid in (1, 2, 3, 11, 13):
+        sched_m = compile_method(mid, p3)
+        s = b3.measure_phase_split(sched_m)
+        wts = weights_for(sched_m)
+        pw = sum(v for acc in wts for (_r, bkt), v in acc.items()
+                 if bkt is TimerBucket.POST)
+        tw = sum(v for acc in wts for v in acc.values())
+        print(f"  split m={mid:>2} total={s['total'] * 1e6:7.1f}us "
+              f"measured_post_share={s['post'] / s['total']:.3f} "
+              f"model_share={pw / tw:.3f}", flush=True)
     return 0
 
 

@@ -1,0 +1,119 @@
+"""Measured phase split (VERDICT r3 item 2, adapted).
+
+This Pallas release exposes no in-kernel device clock, so per-phase
+device timestamps are impossible; the framework instead MEASURES the
+post/deliver boundary by chained program-truncation differencing
+(jax_sim.measure_phase_split): the scatters-only rep is timed with the
+same differenced serial-chain scaffold as the full rep, and the
+preparation side is the difference. No model parameter is involved —
+these tests validate the POST_COST_BYTES attribution model against the
+measured splits (and the native backend's directly-measured splits)
+across >= 5 methods, with bounds loose enough for the one-core CI host
+(the real-chip capture runs at 0-1% noise, scripts/tpu_followup.py).
+"""
+
+import io
+
+import pytest
+
+from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+from tpu_aggcomm.core.methods import compile_method
+from tpu_aggcomm.core.pattern import AggregatorPattern
+from tpu_aggcomm.core.schedule import TimerBucket
+from tpu_aggcomm.harness.attribution import weights_for
+from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+
+README = dict(nprocs=32, cb_nodes=14, data_size=2048, comm_size=3)
+
+METHODS_5 = [1, 2, 3, 11, 13]          # >= 5 round-structured methods
+
+
+def _model_post_share(sched) -> float:
+    w = weights_for(sched)
+    pw = sum(v for acc in w for (_r, b), v in acc.items()
+             if b is TimerBucket.POST)
+    tw = sum(v for acc in w for v in acc.values())
+    return pw / tw
+
+
+@pytest.fixture(scope="module")
+def backend():
+    return JaxSimBackend()             # shared chain cache across tests
+
+
+def test_split_is_additive_and_nonnegative(backend):
+    sched = compile_method(1, AggregatorPattern(**README))
+    s = backend.measure_phase_split(sched)
+    assert s["total"] > 0
+    assert s["post"] >= 0 and s["deliver"] >= 0
+    assert s["post"] + s["deliver"] == pytest.approx(s["total"])
+
+
+@pytest.mark.parametrize("method", METHODS_5)
+def test_model_vs_measured_agreement_bounds(backend, method):
+    """The calibration VERDICT r3 flagged as single-point-with-
+    circularity: POST_COST_BYTES reproduces the REFERENCE's post share
+    (MPI per-call posting cost); the measured split reports this tier's
+    real boundary, where preparation is cheap gathers. Pin both within
+    honest bounds: the measured post share must be small-to-moderate
+    (preparation never dominates a gather/scatter program) and the model
+    must stay within 0.35 absolute of the measurement — it models a
+    costlier posting regime, documentedly so."""
+    sched = compile_method(method, AggregatorPattern(**README))
+    s = backend.measure_phase_split(sched)
+    measured = s["post"] / s["total"]
+    model = _model_post_share(sched)
+    assert 0.0 <= measured <= 0.5, (method, measured)
+    assert abs(model - measured) <= 0.35, (method, model, measured)
+
+
+def test_native_measured_split_brackets_model():
+    """The native backend times every op directly on the host — its
+    post share is a real measurement of a post-then-wait runtime (closer
+    to the reference's regime than the on-device gather/scatter split).
+    The model must land within honest bounds of it across methods."""
+    from tpu_aggcomm.backends.native import NativeBackend
+
+    b = NativeBackend()
+    for method in METHODS_5:
+        p = AggregatorPattern(nprocs=16, cb_nodes=6, data_size=512,
+                              comm_size=3)
+        sched = compile_method(method, p)
+        _, timers = b.run(sched, ntimes=3)
+        tot = sum(t.total_time for t in timers)
+        post = sum(t.post_request_time for t in timers)
+        assert tot > 0
+        measured = post / tot
+        model = _model_post_share(sched)
+        assert abs(model - measured) <= 0.5, (method, model, measured)
+
+
+def test_run_measured_phases_row(backend, tmp_path):
+    from tpu_aggcomm.harness.report import provenance_path
+
+    cfg = ExperimentConfig(
+        **README, method=1, backend="jax_sim", verify=True,
+        measured_phases=True, results_csv=str(tmp_path / "r.csv"))
+    recs = run_experiment(cfg, out=io.StringIO())
+    assert recs[0]["phase_source"] == "measured-split"
+    t0 = recs[0]["timer0"]
+    # rank columns are built from the measured split: they sum to the
+    # measured total (double-charged non-agg waitalls may exceed it)
+    s = t0.post_request_time + t0.send_wait_all_time + \
+        t0.recv_wait_all_time + t0.barrier_time
+    assert s >= t0.total_time * 0.99
+    with open(provenance_path(str(tmp_path / "r.csv"))) as fh:
+        assert "measured-split" in fh.read()
+
+
+def test_unsupported_methods_fail_upfront(tmp_path):
+    for method in (8, 15):             # dense collective / TAM
+        cfg = ExperimentConfig(
+            **README, method=method, backend="jax_sim", verify=True,
+            measured_phases=True, results_csv=None)
+        with pytest.raises(ValueError, match="measured-phases does not"):
+            run_experiment(cfg, out=io.StringIO())
+    cfg = ExperimentConfig(**README, method=1, backend="local",
+                           measured_phases=True, results_csv=None)
+    with pytest.raises(ValueError, match="requires --backend jax_sim"):
+        run_experiment(cfg, out=io.StringIO())

@@ -362,12 +362,16 @@ class JaxSimBackend:
 
     def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
             verify: bool = False, chained: bool = False,
-            profile_rounds: bool = False):
+            profile_rounds: bool = False, measured_phases: bool = False):
         if ntimes < 1:
             raise ValueError("ntimes must be >= 1")
         if chained and profile_rounds:
             raise ValueError("chained and profile_rounds are exclusive "
                              "(one program vs per-round programs)")
+        if measured_phases and profile_rounds:
+            raise ValueError("measured_phases and profile_rounds are "
+                             "exclusive (truncation-differenced split vs "
+                             "per-round dispatch timing)")
         p = schedule.pattern
         dev = self._dev()
         send_dev = jax.device_put(self._global_send(p, iter_), dev)
@@ -380,7 +384,8 @@ class JaxSimBackend:
         # machinery ran it (same downgrade rule on jax_ici/jax_shard)
         self.last_provenance = (
             "jax_sim",
-            "attributed-chained" if chained
+            "measured-split" if measured_phases
+            else "attributed-chained" if chained
             else "attributed-rounds" if (profiled_segs is not None
                                          and len(profiled_segs[0]) > 1)
             else "attributed")
@@ -394,7 +399,19 @@ class JaxSimBackend:
         self.last_rep_timers = []
         self.last_round_times = []         # [rep] -> [per-round seconds]
         attr_w = self._attr_weights(schedule)
-        if chained:
+        if measured_phases:
+            # both phase quantities are differenced measurements
+            # (measure_phase_split); only the distribution of the
+            # delivery side among a rank's wait buckets is structural
+            from tpu_aggcomm.harness.attribution import \
+                attribute_measured_split
+            split = self.measure_phase_split(schedule)
+            rep_attr = attribute_measured_split(
+                schedule, split["post"], split["deliver"], weights=attr_w)
+            for r, t in enumerate(timers):
+                t += Timer.from_array(rep_attr[r].as_array() * ntimes)
+            self.last_rep_timers = [rep_attr for _ in range(ntimes)]
+        elif chained:
             per_rep = self.measure_per_rep(schedule)
             rep_attr = attribute_total(schedule, per_rep, weights=attr_w)
             for r, t in enumerate(timers):
@@ -510,6 +527,168 @@ class JaxSimBackend:
         return out
 
     # ------------------------------------------------------------------
+    def _one_rep_scatters(self, schedule):
+        """The rep truncated to its delivery side: every round scatters
+        into exactly the recv slots the real round writes, but the
+        scattered values are ONE gathered row broadcast across the
+        round's edges — the per-edge gather (message preparation) never
+        runs. ``measure_phase_split`` differences this against the full
+        rep: T(full) - T(scatters) is the measured preparation-side
+        time. (The inverse truncation — gathers consumed by a reduce —
+        is confounded: the reduce costs as much as the scatter it
+        replaces, measured on both CPU and TPU tiers.) The single-row
+        read keeps the chain's serial dependence on ``send``."""
+        from tpu_aggcomm.tam.engine import TamMethod
+
+        if isinstance(schedule, TamMethod) or schedule.collective:
+            raise ValueError(
+                "measured phase split needs a round-structured schedule "
+                "(TAM and the dense collectives have no gather/deliver "
+                "round decomposition to truncate)")
+        p = schedule.pattern
+        n = p.nprocs
+        _, n_recv_slots = self._slots(p)
+        _, jdt, w = self._words(p)
+        rounds, barrier_rounds = _round_tables(schedule)
+        tabs = [(srcs, ss, dsts, ds_)
+                for (_r, srcs, ss, dsts, ds_) in rounds]
+        round_ids = [r for (r, *_rest) in rounds]
+
+        # mirror _one_rep's lowering choice EXACTLY (scan for many-round
+        # schedules, unrolled otherwise): differencing a scan-lowered
+        # full rep against an unrolled truncation would measure the
+        # lowering asymmetry, not the removed gathers
+        scan_ok = (len(tabs) >= 32
+                   and all(v <= 1 for v in barrier_rounds.values()))
+        if scan_ok:
+            R = len(tabs)
+            E = max(len(srcs) for (srcs, _ss, _ds, _dl) in tabs)
+            srcs_t = np.zeros((R, E), dtype=np.int32)
+            ss_t = np.zeros((R, E), dtype=np.int32)
+            dsts_t = np.zeros((R, E), dtype=np.int32)
+            dslt_t = np.full((R, E), n_recv_slots, dtype=np.int32)
+            nbar_t = np.zeros((R,), dtype=np.int32)
+            for k, (srcs, ss, dsts, ds_) in enumerate(tabs):
+                e = len(srcs)
+                srcs_t[k, :e] = srcs
+                ss_t[k, :e] = ss
+                dsts_t[k, :e] = dsts
+                dslt_t[k, :e] = ds_
+                nbar_t[k] = barrier_rounds.get(round_ids[k], 0)
+            xs = tuple(jnp.asarray(t)
+                       for t in (srcs_t, ss_t, dsts_t, dslt_t, nbar_t))
+
+            def rep(send):
+                recv0 = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
+
+                def body(recv, x):
+                    srcs, ss, dsts, ds_, nbar = x
+                    one = send[srcs[0], ss[0]]
+                    vals = jnp.broadcast_to(one, (E, w))
+                    recv = recv.at[dsts, ds_].set(vals)
+                    tok = jnp.sum(recv[:, :n_recv_slots, 0]
+                                  .astype(jnp.int32)).astype(jdt)
+                    cur = recv[:, n_recv_slots, 0]
+                    recv = recv.at[:, n_recv_slots, 0].set(
+                        jnp.where(nbar > 0, tok, cur))
+                    return recv, ()
+
+                recv, _ = lax.scan(body, recv0, xs, unroll=1)
+                return recv
+
+            return rep
+
+        def rep(send):
+            recv = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
+            for k, (srcs, ss, dsts, ds_) in enumerate(tabs):
+                one = send[int(srcs[0]), int(ss[0])]
+                vals = jnp.broadcast_to(one, (len(srcs), w))
+                recv = recv.at[jnp.asarray(dsts), jnp.asarray(ds_)].set(vals)
+                # keep the barrier token reductions: the truncation must
+                # drop ONLY the per-edge gathers, so barrier cost stays
+                # on the deliver side where attribute_measured_split's
+                # BARRIER bucket draws from
+                for _ in range(barrier_rounds.get(round_ids[k], 0)):
+                    tok = jnp.sum(recv[:, :n_recv_slots, 0]
+                                  .astype(jnp.int32))
+                    recv = recv.at[:, n_recv_slots, 0].set(
+                        tok.astype(jdt))
+                if k + 1 < len(tabs):
+                    send, recv = lax.optimization_barrier((send, recv))
+            return recv
+
+        return rep
+
+    def _chain_factory(self, rep, p):
+        """THE serial-chain scaffold shared by measure_per_rep and
+        measure_phase_split: iters reps of ``rep`` back-to-back in one
+        lax.scan (unroll=1), rep r+1's send XOR-perturbed by
+        ``(sum of rep r's recv data rows + r) % 251``. One definition so
+        the full-rep and truncated-rep chains can never drift apart —
+        the differencing premise is that dispatch overhead and scaffold
+        cost cancel identically between them."""
+        _, n_recv_slots = self._slots(p)
+        _, jdt, _w = self._words(p)
+        from tpu_aggcomm.harness.chained import xor_word
+
+        def make_chain(iters: int):
+            @jax.jit
+            def chain(send0):
+                def body(send, r):
+                    recv = rep(send)
+                    tok = (jnp.sum(recv[:, :n_recv_slots, 0]
+                                   .astype(jnp.int32)) + r) % 251
+                    return send ^ xor_word(tok, jdt), ()
+                out, _ = lax.scan(body, send0,
+                                  jnp.arange(iters, dtype=jnp.int32),
+                                  unroll=1)
+                return out
+            return chain
+
+        return make_chain
+
+    def measure_phase_split(self, schedule, *, iters_small: int = 50,
+                            iters_big: int = 1050, trials: int = 3,
+                            windows: int = 3) -> dict:
+        """MEASURED two-way decomposition of one rep via chained
+        program-truncation differencing (no in-kernel clock exists in
+        this Pallas release, and host brackets inside one XLA program are
+        impossible — but a *truncated program* is measurable):
+
+        - ``deliver`` — differenced per-rep seconds of the scatters-only
+          rep (landing every round's rows in their recv slots), clamped
+          to the full-rep time;
+        - ``post``    — T(full) - T(scatters): the message-preparation
+          (per-edge gather) side;
+        - ``total``   — the full-rep differenced time (== post+deliver).
+
+        Both quantities are differenced on-device measurements with no
+        free parameter — unlike the POST_COST_BYTES weight model this
+        split VALIDATES. Both chains ride the same serial-scan +
+        differencing scaffold as measure_per_rep, so dispatch overhead
+        cancels identically. Cached per schedule."""
+        key = (self._key(schedule), "phase_split", iters_small, iters_big,
+               trials, windows)
+        if key in self._chain_cache:
+            return self._chain_cache[key]
+        from tpu_aggcomm.harness.chained import differenced_per_rep
+
+        per_full = self.measure_per_rep(schedule, iters_small=iters_small,
+                                        iters_big=iters_big, trials=trials,
+                                        windows=windows)
+        p = schedule.pattern
+        make_chain = self._chain_factory(self._one_rep_scatters(schedule), p)
+        send0 = jax.device_put(self._global_send(p, 0), self._dev())
+        per_s = differenced_per_rep(make_chain, send0,
+                                    iters_small=iters_small,
+                                    iters_big=iters_big, trials=trials,
+                                    windows=windows)
+        deliver = min(per_s, per_full)
+        out = {"total": per_full, "post": per_full - deliver,
+               "deliver": deliver}
+        self._chain_cache[key] = out
+        return out
+
     def measure_per_rep(self, schedule, *, iters_small: int = 50,
                         iters_big: int = 1050, trials: int = 3,
                         windows: int = 3) -> float:

@@ -66,6 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
                        help="jax_sim/jax_shard/jax_ici: serial-chained on-device per-rep "
                             "measurement (cancels dispatch RPC overhead — "
                             "the honest mode on a tunneled TPU)")
+    bench.add_argument("--measured-phases", action="store_true",
+                       help="jax_sim, round-structured methods: MEASURED "
+                            "post/deliver phase split via chained program-"
+                            "truncation differencing (no model parameter); "
+                            "phase columns marked 'measured-split' in the "
+                            "provenance sidecar")
     bench.add_argument("--results-csv", default="results.csv")
 
     pt = sub.add_parser("pt2pt", help="2-rank latency microbenchmark "
@@ -600,7 +606,7 @@ def main(argv=None) -> int:
         prefix=args.prefix, barrier_type=args.barrier_type,
         backend=args.backend, verify=args.verify,
         results_csv=args.results_csv, profile_rounds=args.profile_rounds,
-        chained=args.chained)
+        chained=args.chained, measured_phases=args.measured_phases)
     run_experiment(cfg)
     return 0
 

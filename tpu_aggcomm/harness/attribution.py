@@ -58,8 +58,8 @@ from tpu_aggcomm.core.schedule import OpKind, Schedule, TimerBucket
 from tpu_aggcomm.harness.timer import Timer
 
 __all__ = ["POST_COST_BYTES", "attribute_total", "attribute_rounds",
-           "rank_round_weights", "tam_rank_weights", "attribute_tam_total",
-           "weights_for"]
+           "attribute_measured_split", "rank_round_weights",
+           "tam_rank_weights", "attribute_tam_total", "weights_for"]
 
 #: Per-call overhead of posting one nonblocking op / one pure-sync wait /
 #: one barrier, expressed in byte-equivalents of transfer time. See module
@@ -153,6 +153,51 @@ def attribute_total(schedule, total_seconds: float,
         if wsum > 0:
             for (_rnd, bucket), w in acc.items():
                 t.add(bucket, total_seconds * w / wsum)
+        timers.append(t)
+    return timers
+
+
+def attribute_measured_split(schedule, post_seconds: float,
+                             deliver_seconds: float,
+                             weights=None) -> list[Timer]:
+    """Per-rank timers from a MEASURED two-way rep decomposition.
+
+    ``post_seconds`` / ``deliver_seconds`` come from chained
+    prefix-differencing (jax_sim.measure_phase_split): the rep's
+    message-preparation (gather) side and its delivery (scatter) side,
+    each a differenced on-device measurement. Unlike
+    :func:`attribute_total`, the post-vs-wait BOUNDARY is measured here —
+    only the distribution of the delivery side among a rank's wait
+    buckets still uses the op weights (which wait a rank was in during
+    the delivery window is structural, not observable from outside the
+    program).
+
+    Per rank: the post column gets the measured gather time if the rank
+    posts at all (on a fused program every rank shares the same wall
+    windows — during the gather window the posting ranks are posting,
+    everyone else is already waiting); the rest of the rank's total is
+    distributed over its wait/barrier buckets by weight, with the
+    RECV_AND_SEND_WAIT both-columns convention preserved.
+    """
+    total = post_seconds + deliver_seconds
+    timers = []
+    for acc in (weights if weights is not None
+                else rank_round_weights(schedule)):
+        t = Timer(total_time=total)
+        post_w = sum(w for (_r, b), w in acc.items()
+                     if b is TimerBucket.POST)
+        waits = {k: w for k, w in acc.items()
+                 if k[1] is not TimerBucket.POST}
+        p_r = post_seconds if post_w > 0 else 0.0
+        if p_r:
+            t.add(TimerBucket.POST, p_r)
+        rest = total - p_r
+        wsum = sum(waits.values())
+        if wsum > 0:
+            for (_rnd, bucket), w in waits.items():
+                t.add(bucket, rest * w / wsum)
+        elif post_w > 0:
+            t.add(TimerBucket.POST, rest)   # post-only rank
         timers.append(t)
     return timers
 

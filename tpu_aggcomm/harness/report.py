@@ -87,12 +87,16 @@ _PROV_HEADER = ("results row,Method,backend requested,backend executed,"
 
 #: phase-column provenance vocabulary (the third sidecar column):
 #:   measured            direct per-op host timing (native)
+#:   measured-split      truncation-differenced on-device measurement of
+#:                       the post/deliver boundary (jax_sim
+#:                       measure_phase_split); delivery distributed among
+#:                       wait buckets by op weights
 #:   total-only          only total_time measured; phase columns zero (local)
 #:   attributed          whole-rep measured total split by the
 #:                       fenced-segment model (harness/attribution.py)
 #:   attributed-rounds   per-round measured totals split within each round
 #:   attributed-chained  differenced serial-chain total, then attributed
-PHASE_SOURCES = ("measured", "total-only", "attributed",
+PHASE_SOURCES = ("measured", "measured-split", "total-only", "attributed",
                  "attributed-rounds", "attributed-chained")
 
 

@@ -37,6 +37,7 @@ class ExperimentConfig:
     results_csv: str | None = "results.csv"
     profile_rounds: bool = False
     chained: bool = False        # jax_sim/jax_shard/jax_ici: chained timing
+    measured_phases: bool = False  # jax_sim: truncation-differenced split
 
 
 def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
@@ -60,6 +61,15 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             "jax_shard (per-round fenced segments exist only there; "
             "local/native time each op directly, pallas_dma attributes "
             "whole-rep time)")
+    if cfg.measured_phases:
+        if cfg.backend != "jax_sim":
+            raise ValueError(
+                "--measured-phases requires --backend jax_sim (the "
+                "truncation-differenced split runs on the single-device "
+                "rank-axis program)")
+        if cfg.profile_rounds:
+            raise ValueError("--measured-phases and --profile-rounds are "
+                             "exclusive")
     backend = get_backend(cfg.backend)
     pattern = AggregatorPattern(
         nprocs=cfg.nprocs, cb_nodes=cfg.cb_nodes,
@@ -88,6 +98,17 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
     # compile once per method, reuse across iters
     compiled = {m: compile_method(m, pattern, barrier_type=cfg.barrier_type)
                 for m in methods}
+    if cfg.measured_phases:
+        # fail upfront, like the chained TAM guard: the truncation split
+        # exists only for round-structured schedules
+        bad = [m for m in methods
+               if METHODS[m].tam or compiled[m].collective]
+        if bad:
+            raise ValueError(
+                f"--measured-phases does not support methods {bad} (TAM "
+                f"and the dense collectives have no gather/deliver round "
+                f"decomposition to truncate); pick round-structured "
+                f"methods with -m")
     records = []
     for i in range(cfg.iters):
         for m in methods:
@@ -99,6 +120,8 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
                 kwargs["profile_rounds"] = True
             if cfg.chained:
                 kwargs["chained"] = True
+            if cfg.measured_phases:
+                kwargs["measured_phases"] = True
             recv, timers = backend.run(sched, ntimes=cfg.ntimes, iter_=i,
                                        verify=cfg.verify, **kwargs)
             max_timer = max_reduce(timers)
