@@ -1,15 +1,23 @@
-"""Measured phase split (VERDICT r3 item 2, adapted).
+"""Measured phase split + measured per-round times (VERDICT r3 item 2 /
+r4 item 3).
 
 This Pallas release exposes no in-kernel device clock, so per-phase
-device timestamps are impossible; the framework instead MEASURES the
-post/deliver boundary by chained program-truncation differencing
-(jax_sim.measure_phase_split): the scatters-only rep is timed with the
-same differenced serial-chain scaffold as the full rep, and the
-preparation side is the difference. No model parameter is involved —
-these tests validate the POST_COST_BYTES attribution model against the
-measured splits (and the native backend's directly-measured splits)
-across >= 5 methods, with bounds loose enough for the one-core CI host
-(the real-chip capture runs at 0-1% noise, scripts/tpu_followup.py).
+device timestamps are impossible; the framework instead MEASURES
+program boundaries by chained truncation differencing:
+
+- the post/deliver boundary (jax_sim.measure_phase_split): the
+  scatters-only rep timed with the same differenced serial-chain
+  scaffold as the full rep, the preparation side is the difference;
+- per-round durations (measure_round_times, jax_sim AND jax_shard):
+  the rep truncated to round prefixes 0..k at full fidelity, round k's
+  time the differenced increment — zero per-round dispatch sync, the
+  accuracy upgrade over --profile-rounds.
+
+No model parameter is involved in either measurement — these tests
+validate the POST_COST_BYTES attribution model against the measured
+splits (and the native backend's directly-measured splits) across >= 5
+methods, with bounds loose enough for the one-core CI host (the
+real-chip capture runs at 0-1% noise, scripts/tpu_followup.py).
 """
 
 import io
@@ -88,6 +96,28 @@ def test_native_measured_split_brackets_model():
         assert abs(model - measured) <= 0.5, (method, model, measured)
 
 
+def test_round_times_additive_and_complete(backend):
+    """The per-round measured times cover every round id of the schedule
+    and sum EXACTLY to the full-rep differenced time (the rescaling
+    contract measure_round_times documents)."""
+    sched = compile_method(1, AggregatorPattern(**README))
+    rt = backend.measure_round_times(sched)
+    assert sorted(rt) == list(range(11))      # ceil(32/3) throttle rounds
+    assert all(v >= 0 for v in rt.values())
+    assert sum(rt.values()) == pytest.approx(
+        backend.measure_per_rep(sched), rel=1e-9)
+
+
+def test_round_times_guard_rails(backend):
+    sched = compile_method(1, AggregatorPattern(**README))
+    with pytest.raises(ValueError, match="max_rounds"):
+        backend.measure_round_times(sched, max_rounds=5)
+    for bad in (8, 15):                       # dense collective / TAM
+        with pytest.raises(ValueError, match="round-structured"):
+            backend.measure_round_times(
+                compile_method(bad, AggregatorPattern(**README)))
+
+
 def test_run_measured_phases_row(backend, tmp_path):
     from tpu_aggcomm.harness.report import provenance_path
 
@@ -95,15 +125,80 @@ def test_run_measured_phases_row(backend, tmp_path):
         **README, method=1, backend="jax_sim", verify=True,
         measured_phases=True, results_csv=str(tmp_path / "r.csv"))
     recs = run_experiment(cfg, out=io.StringIO())
-    assert recs[0]["phase_source"] == "measured-split"
+    assert recs[0]["phase_source"] == "measured-rounds+attributed(buckets)"
     t0 = recs[0]["timer0"]
-    # rank columns are built from the measured split: they sum to the
-    # measured total (double-charged non-agg waitalls may exceed it)
+    # rank 0 (an aggregator) charges buckets in every round, so its
+    # columns sum to the measured total (double-charged non-agg waitalls
+    # may exceed it)
     s = t0.post_request_time + t0.send_wait_all_time + \
         t0.recv_wait_all_time + t0.barrier_time
     assert s >= t0.total_time * 0.99
     with open(provenance_path(str(tmp_path / "r.csv"))) as fh:
-        assert "measured-split" in fh.read()
+        assert "measured-rounds+attributed(buckets)" in fh.read()
+
+
+def test_single_round_falls_back_to_measured_split(backend, tmp_path):
+    """comm_size >= nprocs makes m=1 a single unthrottled round: the
+    prefix decomposition is trivial, so the row keeps the (strictly more
+    informative) measured post/deliver boundary, column-accurately
+    labelled."""
+    cfg = ExperimentConfig(
+        nprocs=8, cb_nodes=4, data_size=256, comm_size=8, method=1,
+        backend="jax_sim", verify=True, measured_phases=True,
+        results_csv=str(tmp_path / "r.csv"))
+    recs = run_experiment(cfg, out=io.StringIO())
+    assert recs[0]["phase_source"] == \
+        "measured-split(post,deliver)+attributed(waits)"
+
+
+def test_m2_send_wait_column_is_measured(backend):
+    """m=2 charges each round's Waitall to send_wait (mpi_test.c:
+    1909-1918): under measured-rounds those column entries come from
+    measured round durations — the send-wait column is a measurement on
+    this tier (VERDICT r4 item 3). The aggregator's send_wait must
+    carry most of its measured total."""
+    sched = compile_method(2, AggregatorPattern(**README))
+    b = JaxSimBackend()
+    recv, timers = b.run(sched, measured_phases=True)
+    assert b.last_provenance == (
+        "jax_sim", "measured-rounds+attributed(buckets)")
+    agg = int(sched.pattern.rank_list[0])
+    t = timers[agg]
+    assert t.send_wait_all_time > 0
+    assert t.send_wait_all_time > t.recv_wait_all_time
+
+
+def test_jax_shard_measured_rounds(tmp_path):
+    """The sharded tier's per-round measured times: same prefix
+    truncation through the shard_map chain scaffold, same additivity
+    contract, same provenance label."""
+    from tpu_aggcomm.backends.jax_shard import JaxShardBackend
+
+    p = AggregatorPattern(nprocs=16, cb_nodes=6, data_size=256,
+                          comm_size=4)
+    sched = compile_method(1, p)
+    b = JaxShardBackend()
+    rt = b.measure_round_times(sched)
+    assert sorted(rt) == list(range(4))       # ceil(16/4) rounds
+    assert sum(rt.values()) == pytest.approx(
+        b.measure_per_rep(sched), rel=1e-9)
+    recv, timers = b.run(sched, measured_phases=True, verify=True)
+    assert b.last_provenance == (
+        "jax_shard", "measured-rounds+attributed(buckets)")
+    assert timers[0].total_time > 0
+
+
+def test_deep_schedule_fails_upfront(tmp_path):
+    """The pairwise methods are always nprocs rounds regardless of -c;
+    deeper than MAX_MEASURED_ROUNDS must be rejected BEFORE any method
+    runs (not mid-sweep with a partial CSV)."""
+    cfg = ExperimentConfig(
+        nprocs=128, cb_nodes=14, data_size=64, comm_size=3, method=9,
+        backend="jax_sim", measured_phases=True,
+        results_csv=str(tmp_path / "r.csv"))
+    with pytest.raises(ValueError, match="profile-rounds"):
+        run_experiment(cfg, out=io.StringIO())
+    assert not (tmp_path / "r.csv").exists()   # nothing partial written
 
 
 def test_unsupported_methods_fail_upfront(tmp_path):

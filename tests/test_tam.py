@@ -145,8 +145,14 @@ class TestShardedTwoLevel:
         assert sharded_grid(256, 64, 8) == (4, 2)    # the flagship shape
         assert sharded_grid(4, 16, 8) == (4, 2)
         assert sharded_grid(2, 4, 8) == (2, 4)       # only split
+        # non-dividing topologies pad instead of raising (ragged analog,
+        # lustre_driver_test.c:374-386): the only fit for (3, 5) on 8
+        # devices is (2, 4), blocks padded to ceil(3/2) x ceil(5/4)
+        assert sharded_grid(3, 5, 8) == (2, 4)
+        # exact grids still beat padded ones: (1, 8) wastes nothing
+        assert sharded_grid(171, 96, 8) == (1, 8)
         with pytest.raises(ValueError, match="no .Dn, Dl. grid"):
-            sharded_grid(3, 5, 8)
+            sharded_grid(1, 1, 8)                    # 8 devices, 1 rank
 
     @pytest.mark.parametrize("method", [15, 16])
     @pytest.mark.parametrize("grid", [(1, 8), (8, 1), (4, 2), (2, 4)])
@@ -194,18 +200,50 @@ class TestShardedTwoLevel:
         with pytest.raises(ValueError, match="must divide nprocs"):
             b.run(compile_method(15, p))
 
-    def test_ragged_node_falls_back(self):
+    @pytest.mark.parametrize("method", [15, 16])
+    def test_ragged_node_runs_blocked_route(self, method):
         from tpu_aggcomm.backends.jax_shard import JaxShardBackend
 
-        # nprocs % proc_node != 0: no exact N*L blocking; jax_shard must
-        # fall back to the sharded-one-rep route and still verify
+        # nprocs % proc_node != 0 (ragged last node,
+        # lustre_driver_test.c:374-386): the blocked engine pads the
+        # block tables instead of falling back (VERDICT r4 item 5)
         p = AggregatorPattern(nprocs=10, cb_nodes=3, data_size=64,
                               proc_node=3)
         b = JaxShardBackend()
-        assert b._run_tam_sharded(compile_method(15, p), 0, 1,
-                                  False, False) is None
-        recv, timers = b.run(compile_method(15, p), verify=True)
+        assert b._run_tam_sharded(compile_method(method, p), 0, 1,
+                                  False, False) is not None
+        recv, timers = b.run(compile_method(method, p), verify=True)
         assert timers[0].total_time > 0
+        oracle = tam_oracle(compile_method(method, p), 0)
+        for r in range(10):
+            if oracle[r] is None:
+                assert recv[r] is None
+            else:
+                np.testing.assert_array_equal(recv[r], oracle[r])
+
+    def test_round_robin_map_matches_oracle(self):
+        """The engine accepts ANY node map, not just contiguous type-0:
+        a round-robin (kind=1) assignment — where a node's ranks are not
+        adjacent — lands byte-identical to the oracle (ADVICE r4 item 2:
+        wiring kind=1 must not crash the sharded route)."""
+        import jax
+
+        from tpu_aggcomm.core.topology import static_node_assignment
+        from tpu_aggcomm.tam.engine import (TamMethod,
+                                            tam_two_level_sharded)
+
+        p = AggregatorPattern(nprocs=24, cb_nodes=4, data_size=64,
+                              proc_node=6)
+        na = static_node_assignment(24, 6, 1)       # round-robin
+        sched = TamMethod(p, 15, "All to many TAM", na)
+        recv, _ = tam_two_level_sharded(sched, jax.devices(), iter_=1,
+                                        ntimes=1)
+        oracle = tam_oracle(sched, 1)
+        for r in range(24):
+            if oracle[r] is None:
+                assert recv[r] is None
+            else:
+                np.testing.assert_array_equal(recv[r], oracle[r])
 
     @pytest.mark.parametrize("method", [15, 16])
     def test_flagship_16384_ranks_on_8_devices(self, method):
@@ -223,3 +261,20 @@ class TestShardedTwoLevel:
         assert b.last_provenance == ("jax_shard", "attributed")
         n_recv = sum(1 for r in recv if r is not None)
         assert n_recv == (256 if method == 15 else 16384)
+
+    def test_flagship_ragged_16384_ranks(self):
+        """A RAGGED 16,384-rank cell — proc_node=96 does not divide, so
+        170 full nodes carry a 64-rank last node
+        (lustre_driver_test.c:374-386) — through the blocked engine,
+        byte-verified (VERDICT r4 item 5)."""
+        from tpu_aggcomm.backends.jax_shard import JaxShardBackend
+
+        p = AggregatorPattern(nprocs=16384, cb_nodes=256, data_size=64,
+                              proc_node=96)
+        b = JaxShardBackend()
+        recv, timers = b.run(compile_method(15, p), verify=True, ntimes=1)
+        assert b.last_provenance == ("jax_shard", "attributed")
+        assert sum(1 for r in recv if r is not None) == 256
+        # pin the route: the blocked engine's build landed in the cache
+        assert any(isinstance(k, tuple) and k and k[0] == "tam2l_sharded"
+                   for k in b._cache)

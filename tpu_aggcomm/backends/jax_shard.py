@@ -227,20 +227,21 @@ class JaxShardBackend:
         """m=15/16 through the explicit blocked two-level engine
         (tam_two_level_sharded): B logical ranks per device on a
         (node, local) grid — the collective_write relay as two padded
-        block all_to_alls, NOT the sharded-jax_sim one-rep route. Returns
-        None when the node map doesn't block onto a grid (ragged node, or
-        no (Dn, Dl) split of the device pool divides (N, L)); the caller
-        then falls back."""
+        block all_to_alls, NOT the sharded-jax_sim one-rep route. Ragged
+        node maps run this route too (the engine pads blocks to
+        ceil(N/Dn) x ceil(Lmax/Dl), lustre_driver_test.c:374-386 analog);
+        the only remaining fallback (return None) is an explicit
+        ranks_per_device split whose device count has no factorization
+        fitting inside the (N, Lmax) topology."""
         from tpu_aggcomm.parallel import host_major_devices
         from tpu_aggcomm.tam.engine import (sharded_grid,
                                             tam_two_level_sharded)
 
         p = schedule.pattern
         na = schedule.assignment
-        L, N = int(na.node_sizes[0]), na.nnodes
+        N = na.nnodes
+        L = int(na.node_sizes.max())        # Lmax: ragged maps allowed
         devs = host_major_devices(self._devices)
-        if p.nprocs != N * L:
-            return None                     # ragged last node
         if self._ranks_per_device and p.nprocs % self._ranks_per_device:
             # same contract as _mesh on every other route: an invalid
             # explicit split raises, it is never silently floor-divided
@@ -341,9 +342,14 @@ class JaxShardBackend:
             scat_dev = [jax.device_put(sc_t.transpose(1, 0, 2, 3),
                                        sharding)]
 
-            def rep_body(flat_send, packs, scats):
+            def rep_body(flat_send, packs, scats, upto=None):
+                # ``upto`` (static) truncates to the first upto rounds —
+                # the prefix programs measure_round_times differences;
+                # both prefixes and the full rep share this one lowering
                 pks = packs[0][0]           # (R, ndev, Mmax)
                 scs = scats[0][0]
+                if upto is not None:
+                    pks, scs = pks[:upto], scs[:upto]
 
                 def body(recv, x):
                     pk, sc = x
@@ -363,15 +369,16 @@ class JaxShardBackend:
             scat_dev = [jax.device_put(sc, sharding)
                         for (_r, _pk, sc, _m) in tabs]
 
-            def rep_body(flat_send, packs, scats):
+            def rep_body(flat_send, packs, scats, upto=None):
                 # one whole rep on this device's shard: flat_send (Fs, w);
-                # packs/scats: list of (1, ndev, M)
+                # packs/scats: list of (1, ndev, M); ``upto`` as above
+                kk = len(packs) if upto is None else upto
                 recv = jnp.zeros((F, w), dtype=jdt)
-                for k in range(len(packs)):
+                for k in range(kk):
                     recv = _apply_block_round(
                         flat_send, recv, packs[k][0], scats[k][0],
                         barrier_rounds.get(round_ids[k], 0), F, w, jdt)
-                    if k + 1 < len(packs):
+                    if k + 1 < kk:
                         flat_send, recv = lax.optimization_barrier(
                             (flat_send, recv))
                 return recv
@@ -388,15 +395,18 @@ class JaxShardBackend:
         def fn(send):
             return sm(send, pack_dev, scat_dev)
 
-        def make_chain(iters: int):
+        def make_chain(iters: int, upto: int | None = None):
             """iters serially-dependent reps in ONE program (the chained
             differenced-measurement scaffold, harness/chained.py): rep
             r+1's send is XOR-perturbed by a psum over rep r's delivered
             state, so reps can neither fuse nor elide and every device
-            depends on every other device's previous rep."""
+            depends on every other device's previous rep. ``upto``
+            truncates every rep to its first upto rounds (the
+            measure_round_times prefixes) through this SAME scaffold, so
+            dispatch and scaffold cost cancel identically."""
             def chain_local(send, packs, scats):
                 def body(flat_send, r):
-                    recv = rep_body(flat_send, packs, scats)
+                    recv = rep_body(flat_send, packs, scats, upto)
                     # token = cross-device checksum of the delivered state
                     # (psum makes rep r+1 depend on EVERY device's rep r)
                     tok = (lax.psum(
@@ -422,7 +432,7 @@ class JaxShardBackend:
             return chain
 
         built = (fn, mesh, ndev, bsz,
-                 (Fs, send_base, recv_base, counts, make_chain))
+                 (Fs, send_base, recv_base, counts, make_chain, round_ids))
         self._cache[key] = built
         return built
 
@@ -595,7 +605,7 @@ class JaxShardBackend:
             return self._chain_cache[key]
         p = schedule.pattern
         fn, mesh, ndev, bsz, extra = self._compiled(schedule)
-        (Fs, send_base, _recv_base, _counts, make_chain) = extra
+        (Fs, send_base, _recv_base, _counts, make_chain, _rids) = extra
         sharding = NamedSharding(mesh, P(AXIS))
         send0 = jax.device_put(
             self._global_send_flat(p, 0, ndev, bsz, send_base, Fs),
@@ -607,9 +617,58 @@ class JaxShardBackend:
         self._chain_cache[key] = per_rep
         return per_rep
 
+    def measure_round_times(self, schedule, *, iters_small: int = 50,
+                            iters_big: int = 1050, trials: int = 3,
+                            windows: int = 3,
+                            max_rounds: int = 64) -> dict:
+        """MEASURED per-round durations on the sharded tier by chained
+        round-prefix truncation differencing — jax_sim's
+        ``measure_round_times`` riding the shard_map chain scaffold: for
+        each k the chain runs reps truncated to rounds 0..k-1 (full
+        fidelity, same lowering, same psum perturbation), and round k's
+        duration is the differenced increment. Increments are clamped at
+        0 and rescaled to sum exactly to the full-rep chain time (the
+        additivity contract). Zero per-round dispatch sync — the accuracy
+        upgrade over ``--profile-rounds`` (VERDICT r4 item 3). Returns
+        ``{round id: seconds}``; cached per schedule."""
+        from tpu_aggcomm.tam.engine import TamMethod
+
+        if isinstance(schedule, TamMethod) or schedule.collective:
+            raise ValueError(
+                "measured round times need a round-structured schedule "
+                "(TAM and the dense collectives have no gather/deliver "
+                "round decomposition to truncate)")
+        p = schedule.pattern
+        fn, mesh, ndev, bsz, extra = self._compiled(schedule)
+        (Fs, send_base, _recv_base, _counts, make_chain, round_ids) = extra
+        R = len(round_ids)
+        if R > max_rounds:
+            raise ValueError(
+                f"{R} rounds exceeds max_rounds={max_rounds} (one chain "
+                f"family is compiled per round); use profile_rounds for "
+                f"very deep schedules")
+        key = (self._key(schedule), "round_times", iters_small, iters_big,
+               trials, windows)
+        if key in self._chain_cache:
+            return self._chain_cache[key]
+        per_full = self.measure_per_rep(schedule, iters_small=iters_small,
+                                        iters_big=iters_big, trials=trials,
+                                        windows=windows)
+        sharding = NamedSharding(mesh, P(AXIS))
+        send0 = jax.device_put(
+            self._global_send_flat(p, 0, ndev, bsz, send_base, Fs),
+            sharding)
+        from tpu_aggcomm.harness.chained import differenced_round_times
+        out = differenced_round_times(
+            lambda k: (lambda iters: make_chain(iters, upto=k)),
+            send0, round_ids, per_full, iters_small=iters_small,
+            iters_big=iters_big, trials=trials, windows=windows)
+        self._chain_cache[key] = out
+        return out
+
     def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
             verify: bool = False, chained: bool = False,
-            profile_rounds: bool = False):
+            profile_rounds: bool = False, measured_phases: bool = False):
         from tpu_aggcomm.tam.engine import TamMethod
 
         if ntimes < 1:
@@ -617,6 +676,10 @@ class JaxShardBackend:
         if chained and profile_rounds:
             raise ValueError("chained and profile_rounds are exclusive "
                              "(one program vs per-round programs)")
+        if measured_phases and profile_rounds:
+            raise ValueError("measured_phases and profile_rounds are "
+                             "exclusive (truncation-differenced rounds vs "
+                             "per-round dispatch timing)")
         self.last_provenance = ("jax_shard",
                                 "attributed-chained" if chained
                                 else "attributed")
@@ -637,6 +700,11 @@ class JaxShardBackend:
         if is_tam and chained:
             raise ValueError("chained measurement for TAM runs on "
                              "jax_sim/jax_ici, not jax_shard")
+        if measured_phases and (is_tam or schedule.collective):
+            raise ValueError(
+                "measured phases need a round-structured schedule "
+                "(TAM and the dense collectives have no gather/deliver "
+                "round decomposition to truncate)")
         if is_tam:
             out = self._run_tam_sharded(schedule, iter_, ntimes, verify,
                                         profile_rounds)
@@ -653,7 +721,7 @@ class JaxShardBackend:
             from tpu_aggcomm.backends.jax_sim import dense_send_lanes
             send_dev = jax.device_put(dense_send_lanes(p, iter_), sharding)
         else:
-            (Fs, send_base, recv_base, counts, _make_chain) = extra
+            (Fs, send_base, recv_base, counts, _make_chain, _rids) = extra
             send_dev = jax.device_put(
                 self._global_send_flat(p, iter_, ndev, bsz, send_base, Fs),
                 sharding)
@@ -665,12 +733,37 @@ class JaxShardBackend:
         self.last_rep_timers = []
         self.last_round_times = []         # [rep] -> [per-round seconds]
         attr_w = weights_for(schedule)
-        if chained:
+        if measured_phases:
+            # per-round durations MEASURED by prefix truncation on the
+            # device mesh; in-round bucket split structural (same contract
+            # and provenance label as jax_sim). Single-round schedules
+            # have no boundary jax_shard can measure (the 2-way
+            # post/deliver split lives on jax_sim) — the trivial
+            # decomposition downgrades the label to attributed-chained.
+            rt = self.measure_round_times(schedule)
+            if len(rt) >= 2:
+                rep_attr = attribute_rounds(schedule, rt, weights=attr_w)
+                self.last_provenance = (
+                    "jax_shard", "measured-rounds+attributed(buckets)")
+                self.last_round_times = [list(rt.values())
+                                         for _ in range(ntimes)]
+            else:
+                rep_attr = attribute_total(
+                    schedule, sum(rt.values()), weights=attr_w)
+                self.last_provenance = ("jax_shard", "attributed-chained")
+            for r, t in enumerate(timers):
+                t += Timer.from_array(rep_attr[r].as_array() * ntimes)
+            self.last_rep_timers = [
+                [Timer.from_array(t.as_array()) for t in rep_attr]
+                for _ in range(ntimes)]
+        elif chained:
             per_rep = self.measure_per_rep(schedule)
             rep_attr = attribute_total(schedule, per_rep, weights=attr_w)
             for r, t in enumerate(timers):
                 t += Timer.from_array(rep_attr[r].as_array() * ntimes)
-            self.last_rep_timers = [rep_attr for _ in range(ntimes)]
+            self.last_rep_timers = [
+                [Timer.from_array(t.as_array()) for t in rep_attr]
+                for _ in range(ntimes)]
         else:
             for _ in range(ntimes):
                 t0 = time.perf_counter()

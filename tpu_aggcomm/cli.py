@@ -67,10 +67,13 @@ def build_parser() -> argparse.ArgumentParser:
                             "measurement (cancels dispatch RPC overhead — "
                             "the honest mode on a tunneled TPU)")
     bench.add_argument("--measured-phases", action="store_true",
-                       help="jax_sim, round-structured methods: MEASURED "
-                            "post/deliver phase split via chained program-"
-                            "truncation differencing (no model parameter); "
-                            "phase columns marked 'measured-split' in the "
+                       help="jax_sim/jax_shard, round-structured methods: "
+                            "MEASURED per-round durations via chained "
+                            "round-prefix truncation differencing (no "
+                            "model parameter; single-round schedules fall "
+                            "back to the measured post/deliver split); "
+                            "phase columns marked "
+                            "'measured-rounds+attributed(buckets)' in the "
                             "provenance sidecar")
     bench.add_argument("--results-csv", default="results.csv")
 

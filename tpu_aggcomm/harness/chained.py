@@ -20,8 +20,15 @@ from __future__ import annotations
 import statistics
 import time
 
-__all__ = ["differenced_per_rep", "differenced_trials", "scanned_chain",
-           "xor_word"]
+__all__ = ["differenced_per_rep", "differenced_trials",
+           "differenced_round_times", "scanned_chain", "xor_word",
+           "MAX_MEASURED_ROUNDS"]
+
+#: Round-count guard for measured per-round times: one chain family is
+#: compiled per round, so an n=1024 c=1 schedule (1024 rounds) would
+#: compile for hours — callers reject such schedules upfront and point
+#: at --profile-rounds instead.
+MAX_MEASURED_ROUNDS = 64
 
 
 def xor_word(tok, lane_dtype):
@@ -95,6 +102,38 @@ def differenced_per_rep(chain_factory, send0, *, iters_small: int,
     return statistics.median(differenced_trials(
         chain_factory, send0, iters_small=iters_small, iters_big=iters_big,
         trials=trials, windows=windows))
+
+
+def differenced_round_times(make_prefix_chain, send0, round_ids,
+                            per_full: float, *, iters_small: int,
+                            iters_big: int, trials: int = 3,
+                            windows: int = 3) -> dict:
+    """Shared tail of ``measure_round_times`` (jax_sim AND jax_shard —
+    one definition, so the additivity contract the tests pin cannot
+    drift between tiers): difference the round-prefix chains.
+
+    ``make_prefix_chain(k)`` returns a ``chain_factory`` whose reps run
+    only rounds 0..k-1 (full fidelity, same lowering and scaffold as the
+    full rep); ``per_full`` is the full-rep differenced time. Round k's
+    duration is the increment between consecutive prefix times; noise
+    handling clamps increments at 0 and rescales so they sum EXACTLY to
+    ``per_full`` (the uniform fallback covers the degenerate all-zero
+    case). Returns ``{round id: seconds}`` in program order."""
+    import numpy as np
+
+    R = len(round_ids)
+    if R == 1:
+        return {round_ids[0]: per_full}
+    bounds = []
+    for k in range(1, R):
+        bounds.append(differenced_per_rep(
+            make_prefix_chain(k), send0, iters_small=iters_small,
+            iters_big=iters_big, trials=trials, windows=windows))
+    bounds.append(per_full)
+    inc = np.maximum(np.diff(np.asarray([0.0] + bounds)), 0.0)
+    s = float(inc.sum())
+    inc = inc * (per_full / s) if s > 0 else np.full(R, per_full / R)
+    return dict(zip(round_ids, inc.tolist()))
 
 
 def scanned_chain(rep, *, n_recv_slots: int, w: int, jdt, axis: str,

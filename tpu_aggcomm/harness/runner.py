@@ -37,7 +37,10 @@ class ExperimentConfig:
     results_csv: str | None = "results.csv"
     profile_rounds: bool = False
     chained: bool = False        # jax_sim/jax_shard/jax_ici: chained timing
-    measured_phases: bool = False  # jax_sim: truncation-differenced split
+    measured_phases: bool = False  # jax_sim/jax_shard: measured per-round
+    #                                times (round-prefix truncation
+    #                                differencing; single-round schedules
+    #                                fall back to the post/deliver split)
 
 
 def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
@@ -62,11 +65,12 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             "local/native time each op directly, pallas_dma attributes "
             "whole-rep time)")
     if cfg.measured_phases:
-        if cfg.backend != "jax_sim":
+        if cfg.backend not in ("jax_sim", "jax_shard"):
             raise ValueError(
-                "--measured-phases requires --backend jax_sim (the "
-                "truncation-differenced split runs on the single-device "
-                "rank-axis program)")
+                "--measured-phases requires --backend jax_sim or "
+                "jax_shard (truncation-differenced round/phase "
+                "measurement exists only on the chained rank-axis "
+                "programs)")
         if cfg.profile_rounds:
             raise ValueError("--measured-phases and --profile-rounds are "
                              "exclusive")
@@ -109,6 +113,20 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
                 f"and the dense collectives have no gather/deliver round "
                 f"decomposition to truncate); pick round-structured "
                 f"methods with -m")
+        # ... and only for schedules shallow enough to compile one prefix
+        # chain per round — fail BEFORE any method runs, not mid-sweep
+        # with a partial CSV (the pairwise methods are always nprocs
+        # rounds regardless of -c)
+        from tpu_aggcomm.harness.chained import MAX_MEASURED_ROUNDS
+        deep = [m for m in methods
+                if len({int(e[4]) for e in compiled[m].data_edges()})
+                > MAX_MEASURED_ROUNDS]
+        if deep:
+            raise ValueError(
+                f"--measured-phases does not support methods {deep} here: "
+                f"more than {MAX_MEASURED_ROUNDS} throttle rounds (one "
+                f"prefix chain is compiled per round); use "
+                f"--profile-rounds for very deep schedules")
     records = []
     for i in range(cfg.iters):
         for m in methods:

@@ -366,23 +366,32 @@ def _group_slots(key: np.ndarray) -> tuple[np.ndarray, int]:
 
 
 def sharded_grid(N: int, L: int, ndev: int) -> tuple[int, int]:
-    """Pick the (Dn, Dl) device grid for a (N nodes x L ranks) logical
-    topology on ndev devices: Dn | N, Dl | L, Dn*Dl = ndev, most balanced
-    (largest min(Dn, Dl); ties prefer the node axis, which is the DCN
-    boundary worth spreading). Raises if no split exists."""
+    """Pick the (Dn, Dl) device grid for a (N nodes x L max-ranks/node)
+    logical topology on ndev devices: Dn*Dl = ndev, Dn <= N, Dl <= L.
+    Non-dividing splits are allowed — blocks pad to Bn = ceil(N/Dn),
+    Bl = ceil(L/Dl) and the phantom coordinates ride the engine's zero
+    sentinel rows (the device-grid analog of the reference's ragged last
+    node, lustre_driver_test.c:374-386). Preference: least padded
+    capacity first, then most balanced (largest min(Dn, Dl); ties prefer
+    the node axis, which is the DCN boundary worth spreading). Raises
+    when no factorization of ndev fits inside (N, L)."""
     best = None
     for dl in range(1, ndev + 1):
-        if ndev % dl or L % dl or N % (ndev // dl):
+        if ndev % dl:
             continue
         dn = ndev // dl
-        cand = (min(dn, dl), dn, (dn, dl))
+        if dn > N or dl > L:
+            continue
+        bn, bl = -(-N // dn), -(-L // dl)
+        pad = dn * bn * dl * bl - N * L
+        cand = (-pad, min(dn, dl), dn, (dn, dl))
         if best is None or cand > best:
             best = cand
     if best is None:
         raise ValueError(
-            f"no (Dn, Dl) grid: need Dn | {N} nodes and Dl | {L} "
-            f"ranks-per-node with Dn*Dl = {ndev} devices")
-    return best[2]
+            f"no (Dn, Dl) grid: no factorization of ndev={ndev} fits "
+            f"Dn <= {N} nodes and Dl <= {L} ranks-per-node")
+    return best[3]
 
 
 def tam_two_level_sharded(tam: TamMethod, devices, iter_: int = 0,
@@ -409,9 +418,12 @@ def tam_two_level_sharded(tam: TamMethod, devices, iter_: int = 0,
     (pack1, pack2, scat) computed vectorized over all n*a slabs; padding
     rides zero rows, per-device tables are sharded over the grid, and
     both hops stay single collectives per rep — no per-slab control flow
-    reaches the device. Requires the exact contiguous type-0 map
-    (n == N*L, no ragged node); callers fall back to the sharded-jax_sim
-    route otherwise. Returns (per-rank recv slabs, per-rep seconds).
+    reaches the device. Accepts ANY node map: a rank's grid coordinate is
+    (its node, its index within that node), which for the contiguous
+    type-0 map reduces to (r // L, r % L); ragged last nodes
+    (l_d_t.c:374-386) and round-robin maps pad to Bn = ceil(N/Dn) x
+    Bl = ceil(Lmax/Dl) blocks whose phantom coordinates simply never
+    appear in the tables. Returns (per-rank recv slabs, per-rep seconds).
 
     ``cache`` (a dict, e.g. the calling backend's compile cache) memoizes
     the iter-independent build — slab enumeration, the three index
@@ -433,36 +445,40 @@ def tam_two_level_sharded(tam: TamMethod, devices, iter_: int = 0,
     p = tam.pattern
     na = tam.assignment
     n, ds, a = p.nprocs, p.data_size, p.cb_nodes
-    L = int(na.node_sizes[0])
     N = na.nnodes
-    if n != N * L or not np.array_equal(na.node_of, np.arange(n) // L):
-        raise ValueError(
-            "sharded two-level engine needs the exact contiguous type-0 "
-            f"node map with no ragged node (n == N*L); got n={n}, "
-            f"N={N}, L={L}")
+    node_of = np.asarray(na.node_of, dtype=np.int64)
+    # index of each rank within its node (ascending-rank order) — equals
+    # r % L on the contiguous map, and is well-defined for ragged and
+    # round-robin maps alike
+    local_of, Lmax = _group_slots(node_of)
     devices = list(devices)
     Dn, Dl = mesh_shape if mesh_shape is not None else sharded_grid(
-        N, L, len(devices))
+        N, Lmax, len(devices))
     if Dn * Dl > len(devices):
         raise ValueError(f"grid {(Dn, Dl)} needs {Dn * Dl} devices, "
                          f"have {len(devices)}")
-    Bn, Bl = N // Dn, L // Dl
-    R = Bn * Bl                      # logical ranks per device
+    if Dn > N or Dl > Lmax:
+        raise ValueError(
+            f"grid {(Dn, Dl)} exceeds the ({N} nodes x {Lmax} "
+            "max-ranks/node) topology")
+    Bn, Bl = -(-N // Dn), -(-Lmax // Dl)    # padded block sizes
+    R = Bn * Bl                      # logical rank slots per device
 
     rank_list = np.asarray(p.rank_list, dtype=np.int64)
 
     def dev_i(r):                    # device row of rank r
-        return (r // L) // Bn
+        return node_of[r] // Bn
 
     def dev_j(r):                    # device column of rank r
-        return (r % L) // Bl
+        return local_of[r] // Bl
 
-    def dev_u(r):                    # local rank index within its device
-        return ((r // L) % Bn) * Bl + ((r % L) % Bl)
+    def dev_u(r):                    # local rank slot within its device
+        return (node_of[r] % Bn) * Bl + (local_of[r] % Bl)
 
     from tpu_aggcomm.parallel import host_major_devices
     devs = host_major_devices(devices)[:Dn * Dl]
-    key = ("tam2l_sharded", p, tam.method_id, Dn, Dl, tuple(devs))
+    key = ("tam2l_sharded", p, tam.method_id, Dn, Dl, tuple(devs),
+           node_of.tobytes())
     st = None if cache is None else cache.get(key)
     if st is None:
         # ---- iter-independent build: enumeration, tables, program ----
