@@ -37,15 +37,17 @@ VMEM-resident — the single-chip analog of the reference's cache-resident
 ``vs_baseline`` = baseline_time / our_time (higher is better; >1 beats the
 reference).
 
-Robustness (VERDICT r1 item 1a): the tunneled TPU can hang *forever* at
-``jax.devices()`` or fail with UNAVAILABLE when the tunnel is down, so the
-parent process NEVER imports jax. All jax work happens in child processes
-with hard timeouts: a cheap device probe (retried), then the measurement.
-If the TPU is unreachable the measurement falls back to a scrubbed-env CPU
-child so a real number is still produced (annotated with ``platform`` and
-``tpu_error``). Whatever happens, stdout carries exactly one JSON line —
-on total failure it is ``{"metric": ..., "error": ...}`` — never a bare
-traceback, never a hang.
+Robustness (VERDICT r1 item 1a, r4 item 7a): the tunneled TPU can hang
+*forever* at ``jax.devices()`` or fail with UNAVAILABLE when the tunnel is
+down, so the parent process NEVER imports jax. All jax work happens in
+child processes with hard timeouts: a cheap device probe, then the
+measurement. Probes retry with backoff across a ``PROBE_WINDOW_S`` budget
+(default 10 min, env-overridable) — a transient tunnel blip must not cost
+a round its TPU headline — and only then does the measurement fall back to
+a scrubbed-env CPU child so a real number is still produced (annotated
+with ``platform``, ``tpu_error`` and ``tpu_attempts``). Whatever happens,
+stdout carries exactly one JSON line — on total failure it is
+``{"metric": ..., "error": ...}`` — never a bare traceback, never a hang.
 """
 
 import json
@@ -64,7 +66,14 @@ TRIALS = 5
 VERIFY_ITERS = 9
 
 PROBE_TIMEOUT_S = 120
-PROBE_RETRIES = 2
+#: The tunnel historically recovers (rounds 2-3: up, round 4: a multi-hour
+#: outage) — a transient blip must not cost a round its TPU headline
+#: (VERDICT r4 item 7a). Probes retry with backoff until this much wall
+#: time has been spent before the headline surrenders to CPU fallback;
+#: override with TPU_AGGCOMM_BENCH_PROBE_WINDOW (seconds).
+PROBE_WINDOW_S = float(os.environ.get("TPU_AGGCOMM_BENCH_PROBE_WINDOW",
+                                      600))
+PROBE_BACKOFF_S = (0, 15, 30, 60, 120)   # then 120 s between later probes
 MEASURE_TIMEOUT_S = 720
 CPU_TIMEOUT_S = 600
 RC_CORRECTNESS = 3   # child exit code: the exchange produced wrong bytes
@@ -212,41 +221,69 @@ def supervise() -> int:
         }))
         return 1
 
+    import time
+
     tpu_error = ""
-    tpu_ok = False
-    for attempt in range(PROBE_RETRIES):
+    attempts = 0
+    deadline = time.monotonic() + PROBE_WINDOW_S
+    while True:
+        # one probe -> (on success) one measurement; an infra failure of
+        # the measurement re-enters the probe loop while budget remains,
+        # so a blip between probe and measure doesn't forfeit the headline
         rc, out, note = _run_child("--probe", PROBE_TIMEOUT_S)
+        attempts += 1
         if rc == 0 and out.strip():
-            print(f"# probe {attempt + 1}: platform={out.strip()}",
+            platform = out.strip().splitlines()[-1]
+            print(f"# probe {attempts}: platform={platform}",
                   file=sys.stderr)
-            tpu_ok = out.strip() == "tpu"
-            if not tpu_ok:
-                tpu_error = f"probe returned platform={out.strip()}"
+            if platform == "tpu":
+                rc, out, note = _run_child("--measure", MEASURE_TIMEOUT_S)
+                if rc == 0 and out.strip():
+                    try:
+                        line = json.loads(out.strip().splitlines()[-1])
+                        line["tpu_attempts"] = attempts
+                        print(json.dumps(line))
+                    except ValueError:
+                        # never trade the one-JSON-line contract for the
+                        # attempts stamp — pass the child line through
+                        sys.stdout.write(out)
+                    return 0
+                if rc == RC_CORRECTNESS:
+                    # a real bug on the TPU path — surface, do NOT fall back
+                    sys.stdout.write(out)
+                    return 1
+                tpu_error = note or f"measure exited rc={rc}"
+                print(f"# tpu measurement failed: {tpu_error}",
+                      file=sys.stderr)
+            else:
+                # a SUCCESSFUL probe reporting a non-TPU platform is a
+                # deterministic answer, not a tunnel blip — fall back now
+                tpu_error = f"probe returned platform={platform}"
+                break
+        else:
+            tpu_error = note or f"probe exited rc={rc}"
+            print(f"# probe {attempts} failed: {tpu_error}",
+                  file=sys.stderr)
+        backoff = PROBE_BACKOFF_S[min(attempts - 1,
+                                      len(PROBE_BACKOFF_S) - 1)]
+        if time.monotonic() + backoff >= deadline:
             break
-        tpu_error = note or f"probe exited rc={rc}"
-        print(f"# probe {attempt + 1}/{PROBE_RETRIES} failed: {tpu_error}",
+        print(f"# retrying in {backoff}s "
+              f"({deadline - time.monotonic():.0f}s of probe window left)",
               file=sys.stderr)
+        time.sleep(backoff)
 
-    if tpu_ok:
-        rc, out, note = _run_child("--measure", MEASURE_TIMEOUT_S)
-        if rc == 0 and out.strip():
-            sys.stdout.write(out)
-            return 0
-        if rc == RC_CORRECTNESS:
-            # a real bug on the TPU path — surface it, do NOT fall back
-            sys.stdout.write(out)
-            return 1
-        tpu_error = note or f"measure exited rc={rc}"
-        print(f"# tpu measurement failed: {tpu_error}", file=sys.stderr)
-
-    # TPU unreachable or its measurement failed on infra — produce a real
-    # number on CPU, annotated so the outage stays visible
-    print(f"# falling back to cpu (tpu: {tpu_error})", file=sys.stderr)
+    # TPU unreachable (or kept failing on infra) for the whole probe
+    # window — produce a real number on CPU, annotated so the outage and
+    # the retry effort stay visible
+    print(f"# falling back to cpu after {attempts} attempts "
+          f"(tpu: {tpu_error})", file=sys.stderr)
     rc, out, note = _run_child("--measure", CPU_TIMEOUT_S,
                                env=scrubbed_cpu_env())
     if rc == 0 and out.strip():
         line = json.loads(out.strip().splitlines()[-1])
         line["tpu_error"] = tpu_error
+        line["tpu_attempts"] = attempts
         print(json.dumps(line))
         return 0
     if rc == RC_CORRECTNESS and out.strip():

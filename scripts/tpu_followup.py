@@ -19,6 +19,12 @@ printing as it completes:
 5. measured phase split (round 4) — the truncation-differenced
    post/deliver boundary on the real chip for 5 round-structured
    methods, printed next to the attribution model's share.
+6. measured per-round times (round 5) — prefix-truncation round
+   durations for the README config, printed next to stage 3's
+   dispatch-timed rounds (the accuracy upgrade they supersede).
+7. roofline (round 5) — the flagship d=2048 cells (n=4096 a=256)
+   re-measured on the fused single-dev lowering, printed against the
+   bytes-touched model's optimistic and fenced HBM floors.
 """
 
 import os
@@ -111,6 +117,33 @@ def main() -> int:
         print(f"  split m={mid:>2} total={s['total'] * 1e6:7.1f}us "
               f"measured_post_share={s['post'] / s['total']:.3f} "
               f"model_share={pw / tw:.3f}", flush=True)
+
+    # 6. measured per-round times (prefix truncation, zero dispatch sync)
+    # next to stage 3's dispatch-timed rounds
+    rt = b3.measure_round_times(compile_method(1, p3))
+    print(f"measured rounds -m 1 -c 3: per-round us = "
+          f"{[round(t * 1e6, 1) for t in rt.values()]} "
+          f"(sum {sum(rt.values()) * 1e6:.1f}us)", flush=True)
+
+    # 7. roofline: flagship d=2048 cells vs the bytes-touched HBM floors
+    from tpu_aggcomm.harness.roofline import HBM_V5E_GBPS, rep_bytes
+    for cs, label in ((999_999_999, "unthrottled"), (1024, "-c 1024"),
+                      (64, "-c 64")):
+        pf = AggregatorPattern(nprocs=4096, cb_nodes=256, data_size=2048,
+                               comm_size=cs)
+        sf = compile_method(1, pf)
+        bf = JaxShardBackend(devices=[dev])
+        bf.run(sf, ntimes=1, verify=True)               # delivery check
+        per = bf.measure_per_rep(sf, iters_small=5, iters_big=35,
+                                 trials=3, windows=2)
+        rb = rep_bytes(sf, lowering="jax_shard", ndev=1)
+        lo = rb.floor_seconds(HBM_V5E_GBPS)
+        hi = rb.floor_seconds(HBM_V5E_GBPS, fenced=True)
+        vol_f = 4096 * 256 * 2048
+        print(f"roofline m=1 {label:<12} {per * 1e3:7.2f} ms/rep "
+              f"({vol_f / per / 1e9:5.1f} GB/s pattern) vs floors "
+              f"[{lo * 1e3:.2f}, {hi * 1e3:.2f}] ms "
+              f"-> {per / lo:.2f}x optimistic floor", flush=True)
     return 0
 
 

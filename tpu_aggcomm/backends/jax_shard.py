@@ -65,20 +65,33 @@ AXIS = "dev"
 
 
 def _apply_block_round(flat_send, recv, pk, sc, nbar: int, F: int, w: int,
-                       jdt):
+                       jdt, single_dev: bool = False):
     """One throttle round on one device's shard: gather the round's
     outgoing blocks, one lax.all_to_all over the device axis, static
     scatter of the landed payload, then the round's barriers as live psum
     tokens into the trash row. Shared by the whole-rep program, the
     scanned-round program, and the profile_rounds segments so the
     profiled decomposition cannot drift from the program it decomposes
-    (the jax_sim `_apply_round` precedent)."""
-    vals = jnp.where(
-        (pk >= 0)[..., None],
-        jnp.take(flat_send, jnp.maximum(pk, 0), axis=0),
-        jnp.zeros((w,), jdt))
-    got = lax.all_to_all(vals, AXIS, 0, 0)          # (ndev, M, w)
-    recv = recv.at[sc.reshape(-1)].set(got.reshape(-1, w))
+    (the jax_sim `_apply_round` precedent).
+
+    ``single_dev``: on a 1-device mesh (the single-chip flagship tier,
+    RESULTS_TPU.md) the all_to_all is the identity — skip it AND the
+    padding mask, so XLA can fuse the round into ONE gather-scatter pass
+    instead of materializing the packed blocks around a collective
+    boundary (roofline: drops two arena walks per round; padded entries
+    scatter into the trash row, which is never read back, so the mask is
+    semantically dead here). Byte-equality with the general path is
+    pinned by tests."""
+    if single_dev:
+        got = jnp.take(flat_send, jnp.maximum(pk, 0).reshape(-1), axis=0)
+        recv = recv.at[sc.reshape(-1)].set(got)
+    else:
+        vals = jnp.where(
+            (pk >= 0)[..., None],
+            jnp.take(flat_send, jnp.maximum(pk, 0), axis=0),
+            jnp.zeros((w,), jdt))
+        got = lax.all_to_all(vals, AXIS, 0, 0)      # (ndev, M, w)
+        recv = recv.at[sc.reshape(-1)].set(got.reshape(-1, w))
     for _ in range(nbar):
         tok = lax.psum(recv[0, 0].astype(jnp.int32), AXIS)
         recv = recv.at[F - 1, 0].set(tok.astype(jdt))
@@ -354,7 +367,8 @@ class JaxShardBackend:
                 def body(recv, x):
                     pk, sc = x
                     recv = _apply_block_round(flat_send, recv, pk, sc,
-                                              0, F, w, jdt)
+                                              0, F, w, jdt,
+                                              single_dev=ndev == 1)
                     return recv, ()
 
                 recv0 = jnp.zeros((F, w), dtype=jdt)
@@ -377,7 +391,8 @@ class JaxShardBackend:
                 for k in range(kk):
                     recv = _apply_block_round(
                         flat_send, recv, packs[k][0], scats[k][0],
-                        barrier_rounds.get(round_ids[k], 0), F, w, jdt)
+                        barrier_rounds.get(round_ids[k], 0), F, w, jdt,
+                        single_dev=ndev == 1)
                     if k + 1 < kk:
                         flat_send, recv = lax.optimization_barrier(
                             (flat_send, recv))
@@ -497,7 +512,8 @@ class JaxShardBackend:
                          nbar=barrier_rounds.get(r, 0)):
                 def local(send, recv, pkl, scl):
                     return _apply_block_round(send[0], recv[0], pkl[0],
-                                              scl[0], nbar, F, w, jdt)[None]
+                                              scl[0], nbar, F, w, jdt,
+                                              single_dev=ndev == 1)[None]
 
                 sm = jax.shard_map(local, mesh=mesh,
                                    in_specs=(P(AXIS),) * 4,

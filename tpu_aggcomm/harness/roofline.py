@@ -1,0 +1,170 @@
+"""Bytes-touched roofline model for the compiled rank-axis lowerings
+(VERDICT r4 item 4).
+
+The question the model answers: is a measured per-rep time the HBM
+memory-bound floor, or multiples off it? The reference publishes raw
+times with no floor analysis (README.md:40-71); on a TPU the floor is
+computable because every rep is a fixed set of arena passes. One rep of
+a round-structured schedule touches:
+
+- ``gather_read``    — every payload edge's slab read from the send
+  arena (sum over rounds of E_r * d);
+- ``scatter_write``  — the same bytes landed in the recv arena;
+- ``zero_init``      — the recv arena zeroed once per rep (XLA may fold
+  this into the first scatter; kept as its own term because the
+  measured programs materialize the zeros when rounds are fenced);
+- ``intermediate``   — the packed blocks materialized around the
+  ``lax.all_to_all`` boundary in the jax_shard block lowering: one
+  write + one read of the round's padded block volume (ndev^2 * M_r *
+  d, padding included — the collective is a fusion barrier, so these
+  are real HBM passes). Zero for jax_sim (no collective inside a rep)
+  and zero for jax_shard on a 1-device mesh since the single-dev round
+  specialization (``_apply_block_round(single_dev=True)``) skips the
+  identity all_to_all and its mask, letting XLA fuse the round into one
+  gather-scatter pass;
+- ``refence_walks`` — the conservative extra for fenced multi-round
+  programs: every ``optimization_barrier`` / scan-carry step may force
+  a full recv-arena copy (read + write), which is exactly the "each
+  round re-walks the full recv arena" behavior RESULTS_TPU.md measured
+  (the -c 2048 cell costing 4x the unthrottled cell at the same
+  pattern volume).
+
+``total(fenced=False)`` is the optimistic floor (rounds touch only
+their own bytes); ``total(fenced=True)`` the conservative bound. A
+measured time between the two floors at HBM bandwidth is memory-bound;
+a time above the fenced bound is overhead (index walks, small rows,
+dispatch) — the distinction the flagship analysis needs.
+
+Chained measurement adds ``chain_overhead_bytes`` per rep (the XOR
+perturbation's send-arena read+write and the checksum's recv read) —
+exposed separately so differenced chain numbers can be compared
+honestly against run() numbers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["RepBytes", "rep_bytes", "chain_overhead_bytes",
+           "floor_seconds", "HBM_V5E_GBPS"]
+
+#: TPU v5e (the chip behind the tunnel) peak HBM bandwidth, GB/s
+#: (public spec: 819 GB/s per chip).
+HBM_V5E_GBPS = 819.0
+
+
+@dataclass
+class RepBytes:
+    """Per-rep bytes-touched breakdown (all plain ints, host-computed)."""
+
+    gather_read: int
+    scatter_write: int
+    zero_init: int
+    intermediate: int
+    refence_walks: int
+    rounds: int
+    edges: int
+
+    def total(self, *, fenced: bool = False) -> int:
+        t = (self.gather_read + self.scatter_write + self.zero_init
+             + self.intermediate)
+        return t + (self.refence_walks if fenced else 0)
+
+    def floor_seconds(self, bandwidth_gbps: float = HBM_V5E_GBPS, *,
+                      fenced: bool = False) -> float:
+        return floor_seconds(self.total(fenced=fenced), bandwidth_gbps)
+
+
+def floor_seconds(nbytes: int, bandwidth_gbps: float = HBM_V5E_GBPS
+                  ) -> float:
+    """Seconds to move ``nbytes`` at ``bandwidth_gbps`` GB/s."""
+    return nbytes / (bandwidth_gbps * 1e9)
+
+
+def _recv_arena_bytes(p, lowering: str, ndev: int) -> int:
+    """Recv-arena footprint of the lowering (incl. trash rows)."""
+    from tpu_aggcomm.harness.verify import recv_slot_counts, slot_shapes
+
+    if lowering == "jax_sim":
+        _, n_recv_slots = slot_shapes(p)
+        return p.nprocs * (n_recv_slots + 1) * p.data_size
+    counts = np.asarray(recv_slot_counts(p))
+    from tpu_aggcomm.backends.jax_shard import recv_layout
+    bsz = -(-p.nprocs // ndev)
+    _, F = recv_layout(counts, ndev, bsz)
+    return ndev * F * p.data_size
+
+
+def rep_bytes(schedule, *, lowering: str = "jax_sim", ndev: int = 1
+              ) -> RepBytes:
+    """Model one rep of ``schedule`` under a lowering.
+
+    ``lowering``: "jax_sim" (dense rank-axis, one device) or "jax_shard"
+    (compacted block lowering over ``ndev`` devices; ndev == 1 is the
+    single-chip flagship tier with the fused single-dev rounds). TAM
+    schedules are out of scope (the 3-hop engine has its own byte
+    accounting, tam_phase_bytes)."""
+    from tpu_aggcomm.backends.jax_shard import _schedule_edges
+    from tpu_aggcomm.tam.engine import TamMethod
+
+    if isinstance(schedule, TamMethod):
+        raise ValueError("TAM reps are modeled by tam_phase_bytes, "
+                         "not the rank-axis roofline")
+    if lowering not in ("jax_sim", "jax_shard"):
+        raise ValueError(f"unknown lowering {lowering!r}")
+    if lowering == "jax_sim" and ndev != 1:
+        raise ValueError("jax_sim is single-device by construction")
+
+    p = schedule.pattern
+    d = p.data_size
+    edges = _schedule_edges(schedule)
+    nedges = len(edges)
+    round_ids = sorted({int(r) for r in edges[:, 4]}) if nedges else []
+    R = max(len(round_ids), 1)
+
+    gather_read = nedges * d
+    scatter_write = nedges * d
+    zero_init = _recv_arena_bytes(p, lowering, ndev)
+
+    intermediate = 0
+    if lowering == "jax_shard" and ndev > 1:
+        # padded block volume around the all_to_all, one write + one read
+        bsz = -(-p.nprocs // ndev)
+        for r in round_ids:
+            sel = edges[edges[:, 4] == r]
+            pair = (sel[:, 0] // bsz) * ndev + (sel[:, 1] // bsz)
+            M = int(np.bincount(pair, minlength=ndev * ndev).max())
+            intermediate += 2 * ndev * ndev * M * d
+
+    # every inter-round fence may re-walk the recv arena (read + write)
+    refence_walks = 2 * (R - 1) * zero_init
+    return RepBytes(gather_read=gather_read, scatter_write=scatter_write,
+                    zero_init=zero_init, intermediate=intermediate,
+                    refence_walks=refence_walks, rounds=R, edges=nedges)
+
+
+def chain_overhead_bytes(schedule, *, lowering: str = "jax_sim",
+                         ndev: int = 1) -> int:
+    """Extra bytes per rep added by the chained-measurement scaffold: the
+    XOR perturbation reads + writes the whole send arena and the checksum
+    reads the recv arena's live rows."""
+    from tpu_aggcomm.harness.verify import slot_shapes
+
+    p = schedule.pattern
+    if lowering == "jax_sim":
+        n_send_slots, _ = slot_shapes(p)
+        send_arena = p.nprocs * n_send_slots * p.data_size
+    else:
+        from tpu_aggcomm.backends.jax_shard import recv_layout
+        from tpu_aggcomm.core.pattern import Direction
+        n = p.nprocs
+        if p.direction is Direction.ALL_TO_MANY:
+            scounts = np.full(n, p.cb_nodes, dtype=np.int64)
+        else:
+            scounts = np.where(np.asarray(p.agg_index) >= 0, n, 0)
+        bsz = -(-n // ndev)
+        _, Fs = recv_layout(scounts, ndev, bsz)
+        send_arena = ndev * Fs * p.data_size
+    return 2 * send_arena + _recv_arena_bytes(p, lowering, ndev)
