@@ -13,7 +13,13 @@ the MPI_Init analog), then:
 3. run one m=1 rep over the global 8-device mesh via the jax_ici
    lowering with multi-controller arrays (each process feeds/verifies
    only its addressable shards) — ``run_rep_across_processes``;
-4. each process byte-verifies the recv rows it owns.
+4. each process byte-verifies the recv rows it owns;
+5. run one m=15 TAM rep through the hierarchical two-level engine on
+   the (2 node x 4 local) mesh with the NODE axis crossing the two
+   processes (``run_tam_across_processes``) — the reference engine's
+   whole reason to exist is exactly this boundary: P3 proxy<->proxy
+   traffic between hosts (lustre_driver_test.c:944-1309). Hop 1 rides
+   the cross-process axis (DCN analog), hop 2 stays in-process (ICI).
 
 Run: ``python scripts/two_process_bringup.py`` (parent spawns both
 children and checks their reports). Exit 0 = the multi-host path a real
@@ -60,6 +66,16 @@ def child(coordinator: str, pid: int) -> int:
     print(f"[child {pid}] m={METHOD} rep verified ranks "
           f"{stats['ranks_verified']} across {stats['n_segments']} fenced "
           f"segments OK", flush=True)
+
+    from tpu_aggcomm.parallel.bringup import run_tam_across_processes
+    p_tam = AggregatorPattern(nprocs=NPROCS, cb_nodes=3, data_size=256,
+                              proc_node=LOCAL_DEVICES)
+    stats_t = run_tam_across_processes(p_tam, 15)
+    assert stats_t["mesh_shape"] == (2, LOCAL_DEVICES)
+    print(f"[child {pid}] m=15 TAM hierarchical rep: TAM verified ranks "
+          f"{stats_t['ranks_verified']} on (node x local) mesh "
+          f"{stats_t['mesh_shape']}, node axis across processes OK",
+          flush=True)
     return 0
 
 
@@ -97,15 +113,23 @@ def main() -> int:
         print(f"--- child {pid} (rc={pr.returncode}) ---")
         print(out)
         ok &= pr.returncode == 0 and "rep verified ranks" in out
-    # both children together must cover every aggregator rank
+        ok &= "TAM verified ranks" in out
+    # both children together must cover every aggregator rank, on the
+    # flat m=1 rep AND the hierarchical TAM rep
     import re
-    seen = set()
+    seen_flat: set = set()
+    seen_tam: set = set()
     for out in outs:
-        m = re.search(r"verified ranks \[([0-9, ]+)\]", out)
+        m = re.search(r"rep verified ranks \[([0-9, ]+)\]", out)
         if m:
-            seen |= {int(x) for x in m.group(1).split(",")}
-    print(f"union of verified ranks: {sorted(seen)}")
-    ok &= len(seen) == 3   # cb_nodes aggregators receive in all-to-many
+            seen_flat |= {int(x) for x in m.group(1).split(",")}
+        m = re.search(r"TAM verified ranks \[([0-9, ]+)\]", out)
+        if m:
+            seen_tam |= {int(x) for x in m.group(1).split(",")}
+    print(f"union of verified ranks: m=1 {sorted(seen_flat)}, "
+          f"m=15 TAM {sorted(seen_tam)}")
+    ok &= len(seen_flat) == 3   # cb_nodes aggregators receive in a2m
+    ok &= len(seen_tam) == 3
     print("TWO-PROCESS BRING-UP:", "OK" if ok else "FAILED")
     return 0 if ok else 1
 

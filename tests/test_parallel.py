@@ -142,10 +142,13 @@ def test_bringup_single_process_degenerate():
 
 
 def test_two_process_bringup_end_to_end():
-    """VERDICT r3 item 5: the multi-host path end-to-end — two REAL
-    processes joined via jax.distributed (coordinator on localhost), a
-    global 8-device mesh, the hierarchical (node x local) mesh from live
-    topology, one m=1 rep over cross-process collectives, per-process
+    """VERDICT r3 item 5 + r4 item 6: the multi-host path end-to-end —
+    two REAL processes joined via jax.distributed (coordinator on
+    localhost), a global 8-device mesh, the hierarchical (node x local)
+    mesh from live topology, one m=1 rep over cross-process collectives
+    AND one m=15 TAM rep through the two-level engine with the node axis
+    crossing the process boundary (the reference engine's P3
+    proxy<->proxy hop, lustre_driver_test.c:944-1309), per-process
     local-shard verification (scripts/two_process_bringup.py)."""
     import os
     import subprocess
@@ -157,3 +160,20 @@ def test_two_process_bringup_end_to_end():
                          text=True, timeout=600)
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
     assert "TWO-PROCESS BRING-UP: OK" in out.stdout
+    assert "node axis across processes OK" in out.stdout
+
+
+def test_run_tam_across_processes_single_process_mesh():
+    """The degenerate single-process case of run_tam_across_processes on
+    the virtual CPU mesh: every shard addressable, all aggregators
+    verified, mesh = (2 nodes x 4 locals)."""
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+    from tpu_aggcomm.parallel.bringup import run_tam_across_processes
+
+    p = AggregatorPattern(nprocs=8, cb_nodes=3, data_size=256,
+                          proc_node=4)
+    stats = run_tam_across_processes(p, 15, iter_=2)
+    assert stats["mesh_shape"] == (2, 4)
+    assert len(stats["ranks_verified"]) == 3
+    stats16 = run_tam_across_processes(p, 16, iter_=2)
+    assert len(stats16["ranks_verified"]) == 8   # many-to-all: everyone

@@ -169,11 +169,19 @@ def tam_oracle(tam: TamMethod, iter_: int = 0):
 # TPU-native two-level engine (jax): all_to_all on node axis, then local axis
 
 def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
-                      ntimes: int = 1):
+                      ntimes: int = 1, out: str = "host"):
     """Run the two-level exchange on a (node, local) mesh. Returns
     (per-rank recv slabs, per-rep wall times). Rank r lives at mesh
     coordinate (r // L, r % L) with L = ranks per node (contiguous node
     map, the same shape static_node_assignment type 0 fabricates).
+
+    ``out="host"`` materializes every rank's recv slabs on the host —
+    the single-process mode. ``out="global"`` returns the raw global
+    device array ``(N, L, out_rows, w)`` instead: on a multi-controller
+    runtime a process cannot device_get shards it does not own, so the
+    caller (parallel/bringup.py:run_tam_across_processes) verifies its
+    addressable shards — the per-rank check each reference process runs
+    on its own recv buffer (lustre_driver_test.c:214-217 analog).
 
     A ragged last node (nprocs % proc_node != 0 — the reference supports
     this, l_d_t.c:359-429) is handled by padding the mesh to N*L
@@ -254,7 +262,11 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
     send_g = to_lanes(send_g, ds).reshape(N, L, -1, w)
 
     sharding = NamedSharding(mesh, P("node", "local"))
-    send_dev = jax.device_put(send_g, sharding)
+    # put_global: identical to device_put on one process; contributes
+    # addressable shards on a multi-controller runtime (every process
+    # holds the same pure-function fill — the MAP_DATA discipline)
+    from tpu_aggcomm.backends.jax_ici import put_global
+    send_dev = put_global(send_g, sharding)
 
     aggs_of_node_j = jnp.asarray(aggs_of_node)
     local_of_aggslot_j = jnp.asarray(local_of_aggslot)
@@ -332,6 +344,8 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
         out_dev = fn(send_dev)
         out_dev.block_until_ready()
         rep_times.append(_time.perf_counter() - t0)
+    if out == "global":
+        return out_dev, rep_times
     out = lanes_to_bytes(
         np.asarray(jax.device_get(out_dev)).reshape(n_pad, out_rows, w), ds)
 
