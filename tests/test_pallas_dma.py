@@ -134,44 +134,66 @@ class TestConcurrentMode:
                 np.testing.assert_array_equal(a, b)
 
     def test_wave_structure(self):
-        from jax.sharding import Mesh
-        import jax
-        import numpy as np
-
         p = AggregatorPattern(8, 3, data_size=64, comm_size=1)
         sched = compile_method(1, p)   # c=1: many single-color rounds
-        mesh = Mesh(np.array(jax.devices()[:8]), ("ranks",))
-        *_, w_lock = PallasDmaBackend()._lower(sched, mesh, True)
-        *_, w_conc = PallasDmaBackend(concurrent=True)._lower(sched, mesh,
-                                                              True)
+        w_lock = PallasDmaBackend().wave_profile(sched)
+        w_conc = PallasDmaBackend(concurrent=True).wave_profile(sched)
         # lockstep: every wave is exactly one step
-        assert all(s1 - s0 == 1 for s0, s1 in w_lock)
+        assert w_lock["max_in_flight"] == 1
         # same total step count: concurrency changes posting, not steps
-        assert sum(s1 - s0 for s0, s1 in w_lock) == \
-            sum(s1 - s0 for s0, s1 in w_conc)
+        assert w_lock["steps"] == w_conc["steps"]
         # m=1 is rendezvous: each data wave is preceded by a grant wave
         # of the same width; multi-step waves appear only in conc mode
-        assert len(w_conc) <= len(w_lock)
+        assert w_conc["n_waves"] <= w_lock["n_waves"]
 
     def test_throttle_widens_concurrent_waves(self):
-        from jax.sharding import Mesh
-        import jax
-        import numpy as np
-
-        mesh = Mesh(np.array(jax.devices()[:8]), ("ranks",))
         widths = {}
         for c in (1, 8):
             p = AggregatorPattern(8, 4, data_size=64, comm_size=c)
             sched = compile_method(1, p)
-            *_, waves = PallasDmaBackend(concurrent=True)._lower(
-                sched, mesh, True)
-            widths[c] = max(s1 - s0 for s0, s1 in waves)
+            widths[c] = PallasDmaBackend(
+                concurrent=True).wave_profile(sched)["max_in_flight"]
         # a deeper throttle admits more concurrent copies per round: the
         # widest wave grows with c — the property the mode exists for.
         # (Small c is floor-bounded by sender-side serialization: each
         # sender's a slabs of a round need a colors regardless of the
         # receiver-side c bound, so compare the unthrottled end.)
         assert widths[8] > widths[1]
+
+    @pytest.mark.parametrize("method", [1, 18])
+    def test_wave_count_law_across_throttle_sweep(self, method):
+        """The lockstep-vs-concurrent divergence, quantified (VERDICT r4
+        item 2, interpret-mesh branch — the RESULTS_TPU.md table): as c
+        sweeps 1..n, the SAME steps repartition into ever-wider
+        concurrent waves while lockstep stays at in-flight=1. Pins, per
+        c: (a) step counts identical across disciplines; (b) lockstep
+        max in-flight == 1; (c) concurrent max in-flight nondecreasing
+        in c, reaching n unthrottled; (d) the init dissemination barrier
+        stays lockstep (log2 n one-step waves) in both modes; (e) the
+        rendezvous discipline (both m=1 and m=18 Issend): after the init
+        barrier, concurrent waves come in (grant, data) pairs of equal
+        width — CTS fully drains before RTS posts, at round granularity
+        (mpi_test.c:1789-1815)."""
+        import math
+
+        n = 8
+        prev = 0
+        for c in (1, 2, 4, 8):
+            p = AggregatorPattern(n, 3, data_size=256, comm_size=c)
+            sched = compile_method(method, p)
+            wl = PallasDmaBackend().wave_profile(sched)
+            wc = PallasDmaBackend(concurrent=True).wave_profile(sched)
+            assert wl["steps"] == wc["steps"] == sum(wc["widths"])  # (a)
+            assert wl["max_in_flight"] == 1                        # (b)
+            assert wc["max_in_flight"] >= prev                     # (c)
+            prev = wc["max_in_flight"]
+            nbar = int(math.log2(n))
+            assert wc["widths"][:nbar] == [1] * nbar               # (d)
+            body = wc["widths"][nbar:]
+            assert len(body) % 2 == 0                              # (e)
+            for g, d in zip(body[::2], body[1::2]):
+                assert g == d
+        assert prev == n    # unthrottled: the whole round in flight
 
     def test_registry_and_provenance(self):
         from tpu_aggcomm.backends import get_backend
