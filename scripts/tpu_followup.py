@@ -119,11 +119,18 @@ def main() -> int:
               f"model_share={pw / tw:.3f}", flush=True)
 
     # 6. measured per-round times (prefix truncation, zero dispatch sync)
-    # next to stage 3's dispatch-timed rounds
+    # next to stage 3's dispatch-timed rounds; plus the TAM 3-hop split
     rt = b3.measure_round_times(compile_method(1, p3))
     print(f"measured rounds -m 1 -c 3: per-round us = "
           f"{[round(t * 1e6, 1) for t in rt.values()]} "
           f"(sum {sum(rt.values()) * 1e6:.1f}us)", flush=True)
+    p_tam = AggregatorPattern(nprocs=32, cb_nodes=14, data_size=2048,
+                              comm_size=3, proc_node=4)
+    hops = b3.measure_tam_hops(compile_method(15, p_tam))
+    print(f"measured TAM hops -m 15 -p 4: "
+          f"P2={hops['p2'] * 1e6:.1f}us P3={hops['p3'] * 1e6:.1f}us "
+          f"P4={hops['p4'] * 1e6:.1f}us "
+          f"(total {hops['total'] * 1e6:.1f}us)", flush=True)
 
     # 7. roofline: flagship d=2048 cells vs the bytes-touched HBM floors
     from tpu_aggcomm.harness.roofline import HBM_V5E_GBPS, rep_bytes

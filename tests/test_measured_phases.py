@@ -202,13 +202,81 @@ def test_deep_schedule_fails_upfront(tmp_path):
 
 
 def test_unsupported_methods_fail_upfront(tmp_path):
-    for method in (8, 15):             # dense collective / TAM
-        cfg = ExperimentConfig(
-            **README, method=method, backend="jax_sim", verify=True,
-            measured_phases=True, results_csv=None)
-        with pytest.raises(ValueError, match="measured-phases does not"):
-            run_experiment(cfg, out=io.StringIO())
+    # dense collective: genuinely no decomposition, any backend
+    cfg = ExperimentConfig(
+        **README, method=8, backend="jax_sim", verify=True,
+        measured_phases=True, results_csv=None)
+    with pytest.raises(ValueError, match="measured-phases does not"):
+        run_experiment(cfg, out=io.StringIO())
+    # TAM hop measurement is jax_sim-only
+    cfg = ExperimentConfig(
+        **README, method=15, backend="jax_shard", verify=True,
+        measured_phases=True, results_csv=None)
+    with pytest.raises(ValueError, match="jax_sim only"):
+        run_experiment(cfg, out=io.StringIO())
     cfg = ExperimentConfig(**README, method=1, backend="local",
                            measured_phases=True, results_csv=None)
     with pytest.raises(ValueError, match="requires --backend jax_sim"):
         run_experiment(cfg, out=io.StringIO())
+
+
+class TestTamHops:
+    """Measured 3-hop TAM decomposition (VERDICT r4 weak item 6): the
+    relay's P2/P3/P4 boundaries by the same chained prefix-truncation
+    trick, with the reference's own bracket placement for columns."""
+
+    TAM = dict(nprocs=32, cb_nodes=14, data_size=2048, comm_size=3,
+               proc_node=4)   # 8 nodes x 4 ranks: real P2/P4 legs
+
+    def test_hops_additive_and_nonnegative(self, backend):
+        sched = compile_method(15, AggregatorPattern(**self.TAM))
+        hops = backend.measure_tam_hops(sched)
+        assert all(hops[k] >= 0 for k in ("p2", "p3", "p4"))
+        assert hops["p2"] + hops["p3"] + hops["p4"] == pytest.approx(
+            hops["total"])
+        assert hops["total"] == pytest.approx(
+            backend.measure_per_rep(sched), rel=1e-9)
+
+    def test_run_measured_phases_tam_row(self, backend, tmp_path):
+        from tpu_aggcomm.harness.report import provenance_path
+
+        cfg = ExperimentConfig(
+            **self.TAM, method=15, backend="jax_sim", verify=True,
+            measured_phases=True, results_csv=str(tmp_path / "r.csv"))
+        recs = run_experiment(cfg, out=io.StringIO())
+        assert recs[0]["phase_source"] == \
+            "measured-hops(P2,P3,P4)+attributed(ranks)"
+        with open(provenance_path(str(tmp_path / "r.csv"))) as fh:
+            assert "measured-hops" in fh.read()
+
+    def test_column_placement_follows_reference_brackets(self, backend):
+        """Proxies charge the measured P3 window to send_wait and the
+        intra-node windows to recv_wait; non-proxies spend the whole rep
+        in recv waits (l_d_t.c:1015-1017, 1162-1195, 1264-1266)."""
+        from tpu_aggcomm.core.methods import compile_method as cm
+
+        sched = cm(15, AggregatorPattern(**self.TAM))
+        hops = backend.measure_tam_hops(sched)
+        recv, timers = backend.run(sched, measured_phases=True)
+        na = sched.assignment
+        proxy = int(na.proxies[0])
+        assert timers[proxy].send_wait_all_time == pytest.approx(
+            hops["p3"])
+        assert timers[proxy].recv_wait_all_time == pytest.approx(
+            hops["p2"] + hops["p4"])
+        nonproxy = next(r for r in range(sched.nprocs)
+                        if not na.is_proxy(r))
+        assert timers[nonproxy].send_wait_all_time == 0.0
+        assert timers[nonproxy].recv_wait_all_time == pytest.approx(
+            hops["total"])
+
+    def test_guards(self, backend):
+        from tpu_aggcomm.backends.jax_shard import JaxShardBackend
+
+        with pytest.raises(ValueError, match="TAM schedule"):
+            backend.measure_tam_hops(
+                compile_method(1, AggregatorPattern(**README)))
+        with pytest.raises(ValueError, match="round-structured"):
+            JaxShardBackend().run(
+                compile_method(15, AggregatorPattern(**self.TAM)),
+                measured_phases=True)

@@ -229,31 +229,11 @@ class JaxSimBackend:
                                  or schedule.collective):
             raise ValueError(
                 "round-prefix truncation needs a round-structured "
-                "schedule (TAM and the dense collectives have no "
-                "throttle rounds to truncate)")
+                "schedule (TAM prefixes are _tam_rep(upto_hop=...); the "
+                "dense collectives have no throttle rounds to truncate)")
 
         if isinstance(schedule, TamMethod):
-            # hierarchical route on one chip: three fenced gather hops over
-            # the staged slab arrays — the proxy engine's P2/P3/P4 made
-            # index maps; each hop stays a distinct program step
-            stage_idx, exch_idx, recv_dst, recv_slot = _tam_tables(schedule)
-            stage_j = jnp.asarray(stage_idx)
-            exch_j = jnp.asarray(exch_idx)
-            dst_j = jnp.asarray(recv_dst)
-            slot_j = jnp.asarray(recv_slot)
-
-            _, jdt, w = self._words(p)
-
-            def rep(send):
-                flat = send.reshape(n * n_send_slots, w)
-                staged = jnp.take(flat, stage_j, axis=0)       # P2 gather
-                (staged,) = lax.optimization_barrier((staged,))
-                exch = jnp.take(staged, exch_j, axis=0)        # P3 exchange
-                (exch,) = lax.optimization_barrier((exch,))
-                recv = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
-                return recv.at[dst_j, slot_j].set(exch)        # P4/P5
-
-            return rep
+            return self._tam_rep(schedule)
 
         if schedule.collective:
             # m=5/8: the whole pattern as one dense exchange — dst-major
@@ -421,14 +401,27 @@ class JaxSimBackend:
             # multi-round schedules: per-round durations are MEASURED by
             # prefix truncation (measure_round_times); only the split of
             # a round's time among the buckets charged in that round is
-            # structural. Single-round schedules keep the 2-way measured
-            # post/deliver boundary (measure_phase_split) — there the
-            # prefix decomposition is trivial and the gather/scatter
-            # boundary is the strictly more informative measurement.
-            from tpu_aggcomm.harness.attribution import \
-                attribute_measured_split
-            rt = self.measure_round_times(schedule)
-            if len(rt) >= 2:
+            # structural. TAM schedules: the 3-hop relay is the
+            # decomposition — per-hop durations measured by the same
+            # trick (measure_tam_hops). Single-round schedules keep the
+            # 2-way measured post/deliver boundary (measure_phase_split)
+            # — there the prefix decomposition is trivial and the
+            # gather/scatter boundary is the strictly more informative
+            # measurement.
+            from tpu_aggcomm.harness.attribution import (
+                attribute_measured_split, attribute_tam_hops)
+            from tpu_aggcomm.tam.engine import TamMethod
+            if isinstance(schedule, TamMethod):
+                hops = self.measure_tam_hops(schedule)
+                rep_attr = attribute_tam_hops(
+                    schedule, hops["p2"], hops["p3"], hops["p4"],
+                    weights=attr_w)
+                self.last_provenance = (
+                    "jax_sim", "measured-hops(P2,P3,P4)+attributed(ranks)")
+                self.last_round_times = [
+                    [hops["p2"], hops["p3"], hops["p4"]]
+                    for _ in range(ntimes)]
+            elif len(rt := self.measure_round_times(schedule)) >= 2:
                 rep_attr = attribute_rounds(schedule, rt, weights=attr_w)
                 self.last_provenance = (
                     "jax_sim", "measured-rounds+attributed(buckets)")
@@ -582,8 +575,8 @@ class JaxSimBackend:
         if isinstance(schedule, TamMethod) or schedule.collective:
             raise ValueError(
                 "measured phase split needs a round-structured schedule "
-                "(TAM and the dense collectives have no gather/deliver "
-                "round decomposition to truncate)")
+                "(TAM's 3-hop decomposition is measured by "
+                "measure_tam_hops; the dense collectives have none)")
         p = schedule.pattern
         n = p.nprocs
         _, n_recv_slots = self._slots(p)
@@ -657,6 +650,107 @@ class JaxSimBackend:
             return recv
 
         return rep
+
+    def _tam_rep(self, tam, upto_hop: int | None = None):
+        """THE TAM lowering: three fenced gather hops over the staged
+        slab arrays — the proxy engine's P2/P3/P4 made index maps
+        (l_d_t.c:996-1309); each hop stays a distinct program step.
+        Shared by the full rep (``upto_hop=None``, what _one_rep/run
+        execute) and the measured-hop prefixes ``measure_tam_hops``
+        differences (1 = P2 only, 2 = P2+P3) — one definition, so the
+        measured decomposition can never drift from the program it
+        decomposes (the _apply_round / _build_steps precedent).
+
+        Hop prefixes end in a fixed SINK: the hop's output rows
+        segment-summed into recv's first data row. The sink (a) is
+        identical work for both prefixes (staged and exch have the same
+        row count), so T2 - T1 isolates P3 exactly; (b) touches every
+        gathered row, so XLA cannot dead-code the truncated hop; and
+        (c) lands in a DATA row, so the chain scaffold's token (a sum
+        over data rows) stays data-dependent on the hop — constant-zero
+        data rows would let XLA fold the token and elide the chain."""
+        if upto_hop not in (None, 1, 2):
+            raise ValueError("upto_hop must be None (full rep), 1 (P2) "
+                             "or 2 (P2+P3)")
+        p = tam.pattern
+        n = p.nprocs
+        n_send_slots, n_recv_slots = self._slots(p)
+        stage_idx, exch_idx, recv_dst, recv_slot = _tam_tables(tam)
+        stage_j = jnp.asarray(stage_idx)
+        exch_j = jnp.asarray(exch_idx)
+        dst_j = jnp.asarray(recv_dst)
+        slot_j = jnp.asarray(recv_slot)
+        _, jdt, w = self._words(p)
+
+        def sink(x):
+            # (E, w) -> (n, w) segment sum, landed in data row 0
+            recv = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
+            return recv.at[:, 0, :].set(
+                x.reshape(n, -1, w).sum(axis=1).astype(jdt))
+
+        def rep(send):
+            flat = send.reshape(n * n_send_slots, w)
+            staged = jnp.take(flat, stage_j, axis=0)        # P2 gather
+            (staged,) = lax.optimization_barrier((staged,))
+            if upto_hop == 1:
+                return sink(staged)
+            exch = jnp.take(staged, exch_j, axis=0)         # P3 exchange
+            (exch,) = lax.optimization_barrier((exch,))
+            if upto_hop == 2:
+                return sink(exch)
+            recv = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
+            return recv.at[dst_j, slot_j].set(exch)         # P4/P5
+
+        return rep
+
+    def measure_tam_hops(self, tam, *, iters_small: int = 50,
+                         iters_big: int = 1050, trials: int = 3,
+                         windows: int = 3) -> dict:
+        """MEASURED 3-way decomposition of a TAM rep by chained
+        hop-prefix truncation differencing (VERDICT r4 weak item 6: the
+        3-hop relay IS a round decomposition, and its boundaries are
+        measurable by the same trick as measure_round_times):
+
+        - ``p2`` — the intra-node staging gather (proxy pack, the
+          reference's P2 bracket, l_d_t.c:1015-1106);
+        - ``p3`` — the inter-node proxy exchange (l_d_t.c:1162-1195),
+          isolated EXACTLY (both its prefixes carry the identical sink);
+        - ``p4`` — the local delivery scatter (l_d_t.c:1264-1266);
+        - ``total`` — the full-rep differenced time (== p2+p3+p4 by the
+          same clamp-and-rescale contract as measure_round_times; the
+          hop-1/hop-3 boundaries carry the sink asymmetry, bounded by
+          one reduction pass over the staged arena).
+
+        Cached per schedule."""
+        from tpu_aggcomm.tam.engine import TamMethod
+
+        if not isinstance(tam, TamMethod):
+            raise ValueError("measure_tam_hops needs a TAM schedule "
+                             "(m=15/16); round-structured schedules use "
+                             "measure_round_times")
+        key = (self._key(tam), "tam_hops", iters_small, iters_big,
+               trials, windows)
+        if key in self._chain_cache:
+            return self._chain_cache[key]
+        per_full = self.measure_per_rep(tam, iters_small=iters_small,
+                                        iters_big=iters_big, trials=trials,
+                                        windows=windows)
+        p = tam.pattern
+        send0 = jax.device_put(self._global_send(p, 0), self._dev())
+        bounds = []
+        for k in (1, 2):
+            mk = self._chain_factory(self._tam_rep(tam, upto_hop=k), p)
+            bounds.append(differenced_per_rep(
+                mk, send0, iters_small=iters_small, iters_big=iters_big,
+                trials=trials, windows=windows))
+        bounds.append(per_full)
+        inc = np.maximum(np.diff(np.asarray([0.0] + bounds)), 0.0)
+        s = float(inc.sum())
+        inc = inc * (per_full / s) if s > 0 else np.full(3, per_full / 3)
+        out = {"p2": float(inc[0]), "p3": float(inc[1]),
+               "p4": float(inc[2]), "total": per_full}
+        self._chain_cache[key] = out
+        return out
 
     def _chain_factory(self, rep, p):
         """THE serial-chain scaffold shared by measure_per_rep and
@@ -761,8 +855,8 @@ class JaxSimBackend:
         if isinstance(schedule, TamMethod) or schedule.collective:
             raise ValueError(
                 "measured round times need a round-structured schedule "
-                "(TAM and the dense collectives have no gather/deliver "
-                "round decomposition to truncate)")
+                "(TAM's 3-hop decomposition is measured by "
+                "measure_tam_hops; the dense collectives have none)")
         rounds, _ = _round_tables(schedule)
         round_ids = [r for (r, *_rest) in rounds]
         if len(round_ids) > max_rounds:

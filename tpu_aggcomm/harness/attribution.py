@@ -59,7 +59,8 @@ from tpu_aggcomm.harness.timer import Timer
 
 __all__ = ["POST_COST_BYTES", "attribute_total", "attribute_rounds",
            "attribute_measured_split", "rank_round_weights",
-           "tam_rank_weights", "attribute_tam_total", "weights_for"]
+           "tam_rank_weights", "attribute_tam_total", "attribute_tam_hops",
+           "weights_for"]
 
 #: Per-call overhead of posting one nonblocking op / one pure-sync wait /
 #: one barrier, expressed in byte-equivalents of transfer time. See module
@@ -286,5 +287,32 @@ def attribute_tam_total(tam, total_seconds: float,
         if wsum > 0:
             t.recv_wait_all_time = total_seconds * rw[r] / wsum
             t.send_wait_all_time = total_seconds * sw[r] / wsum
+        timers.append(t)
+    return timers
+
+
+def attribute_tam_hops(tam, p2: float, p3: float, p4: float,
+                       weights=None) -> list[Timer]:
+    """Per-rank timers from a MEASURED 3-hop TAM decomposition
+    (jax_sim.measure_tam_hops) — unlike :func:`attribute_tam_total`, the
+    phase BOUNDARIES are measurements; only which column a rank's wall
+    window lands in is structural, and that mapping is the reference's
+    own bracket placement: a proxy charges the inter-node exchange
+    window to send_wait and its intra-node windows to recv_wait
+    (l_d_t.c:1015-1017, 1162-1195, 1264-1266); a non-proxy spends the
+    whole exchange window blocked in its delivery recv, so its P3 share
+    lands in recv_wait (the reference's non-proxy ranks bracket no P3
+    code at all — their time accrues in the P2/P4 Waitalls that
+    surround it)."""
+    rw, sw = weights if weights is not None else tam_rank_weights(tam)
+    total = p2 + p3 + p4
+    timers = []
+    for r in range(tam.pattern.nprocs):
+        t = Timer(total_time=total)
+        if sw[r] > 0:
+            t.send_wait_all_time = p3
+            t.recv_wait_all_time = p2 + p4
+        elif rw[r] > 0:
+            t.recv_wait_all_time = total
         timers.append(t)
     return timers

@@ -128,6 +128,13 @@ def _data_rows(filename: str) -> int:
 #:                       weights (rounds whose charges are a single
 #:                       bucket — e.g. m=2's per-round send Waitalls —
 #:                       are therefore fully measured columns)
+#:   measured-hops(P2,P3,P4)+attributed(ranks)
+#:                       TAM's 3-hop relay durations MEASURED by chained
+#:                       hop-prefix truncation differencing (jax_sim
+#:                       measure_tam_hops); which column a rank's wall
+#:                       window lands in follows the reference's own
+#:                       bracket placement (proxies charge P3 to
+#:                       send_wait, l_d_t.c:1162-1195)
 #:   measured-split(post,deliver)+attributed(waits)
 #:                       truncation-differenced on-device measurement of
 #:                       the post/deliver boundary (jax_sim
@@ -141,6 +148,7 @@ def _data_rows(filename: str) -> int:
 #:   attributed-chained  differenced serial-chain total, then attributed
 PHASE_SOURCES = ("measured",
                  "measured-rounds+attributed(buckets)",
+                 "measured-hops(P2,P3,P4)+attributed(ranks)",
                  "measured-split(post,deliver)+attributed(waits)",
                  "total-only", "attributed",
                  "attributed-rounds", "attributed-chained")

@@ -103,23 +103,27 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
     compiled = {m: compile_method(m, pattern, barrier_type=cfg.barrier_type)
                 for m in methods}
     if cfg.measured_phases:
-        # fail upfront, like the chained TAM guard: the truncation split
-        # exists only for round-structured schedules
+        # fail upfront, like the chained TAM guard: the truncation
+        # measurement exists for round-structured schedules everywhere
+        # and for TAM's 3-hop relay on jax_sim (measure_tam_hops);
+        # dense collectives genuinely have no decomposition
         bad = [m for m in methods
-               if METHODS[m].tam or compiled[m].collective]
+               if compiled[m].collective
+               or (METHODS[m].tam and cfg.backend != "jax_sim")]
         if bad:
             raise ValueError(
-                f"--measured-phases does not support methods {bad} (TAM "
-                f"and the dense collectives have no gather/deliver round "
-                f"decomposition to truncate); pick round-structured "
-                f"methods with -m")
+                f"--measured-phases does not support methods {bad} here "
+                f"(dense collectives have no decomposition to truncate; "
+                f"TAM hop measurement runs on jax_sim only); pick "
+                f"round-structured methods with -m")
         # ... and only for schedules shallow enough to compile one prefix
         # chain per round — fail BEFORE any method runs, not mid-sweep
         # with a partial CSV (the pairwise methods are always nprocs
         # rounds regardless of -c)
         from tpu_aggcomm.harness.chained import MAX_MEASURED_ROUNDS
         deep = [m for m in methods
-                if len({int(e[4]) for e in compiled[m].data_edges()})
+                if not METHODS[m].tam
+                and len({int(e[4]) for e in compiled[m].data_edges()})
                 > MAX_MEASURED_ROUNDS]
         if deep:
             raise ValueError(
