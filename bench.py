@@ -70,9 +70,16 @@ PROBE_TIMEOUT_S = 120
 #: outage) — a transient blip must not cost a round its TPU headline
 #: (VERDICT r4 item 7a). Probes retry with backoff until this much wall
 #: time has been spent before the headline surrenders to CPU fallback;
-#: override with TPU_AGGCOMM_BENCH_PROBE_WINDOW (seconds).
+#: override with TPU_AGGCOMM_BENCH_PROBE_WINDOW (seconds). The default
+#: covers a ~5-minute blip (3 full 120 s probe timeouts + backoffs,
+#: ending ~375 s in). Total wall time is NOT bounded by the window
+#: alone: typical dead-tunnel case ≈ 375 s probing + ~2 min CPU
+#: measurement; hard worst case is window + one MEASURE_TIMEOUT_S per
+#: successful probe + CPU_TIMEOUT_S (~20 min with a flapping tunnel) —
+#: a supervising driver must budget for that, never SIGTERM a TPU
+#: client mid-flight (CLAUDE.md).
 PROBE_WINDOW_S = float(os.environ.get("TPU_AGGCOMM_BENCH_PROBE_WINDOW",
-                                      600))
+                                      360))
 PROBE_BACKOFF_S = (0, 15, 30, 60, 120)   # then 120 s between later probes
 MEASURE_TIMEOUT_S = 720
 CPU_TIMEOUT_S = 600
