@@ -150,6 +150,21 @@ def test_inspect_round_structured():
     assert "round   0:    42 msgs" in out
 
 
+def test_inspect_roofline_and_waves():
+    rc, out = run_cli(["inspect", "-m", "1", "-n", "8", "-a", "3",
+                       "-c", "2", "--roofline", "--waves"])
+    assert rc == 0
+    assert "roofline (floors at 819 GB/s HBM):" in out
+    assert "jax_sim(ndev=1):" in out and "us/rep" in out
+    assert "pallas_dma lockstep" in out
+    assert "max in-flight = 1" in out          # lockstep law
+    assert "pallas_dma concurrent" in out
+    # roofline also covers the dense collective
+    rc, out = run_cli(["inspect", "-m", "8", "-n", "8", "-a", "3",
+                       "--roofline"])
+    assert rc == 0 and "roofline" in out and "1 rounds" in out
+
+
 def test_inspect_dense_and_tam_and_barriers():
     rc, out = run_cli(["inspect", "-m", "8", "-n", "8", "-a", "3"])
     assert "dense vendor collective" in out and "24 messages" in out

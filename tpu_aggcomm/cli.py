@@ -179,6 +179,14 @@ def build_parser() -> argparse.ArgumentParser:
     ins.add_argument("--ndev", type=int, default=0,
                      help="also show the jax_shard block-table view over "
                           "this many devices (block M, padding factor)")
+    ins.add_argument("--roofline", action="store_true",
+                     help="bytes-touched model per rep + HBM floors "
+                          "(harness/roofline.py): the time the measured "
+                          "numbers are judged against (RESULTS_TPU.md)")
+    ins.add_argument("--waves", action="store_true",
+                     help="pallas_dma wave accounting, lockstep vs "
+                          "concurrent: in-flight DMAs per wave — where "
+                          "the -c throttle becomes physical concurrency")
 
     # analyze — summarize accumulated results.csv rows
     an = sub.add_parser(
@@ -473,12 +481,41 @@ def _run_inspect(args) -> int:
               f"({args.proc_node} ranks/node); phase bytes:")
         for k, v in vols.items():
             print(f"  {k:16s} {v} B")
+        if args.roofline:
+            print("roofline: n/a for TAM (the 3-hop engine's byte "
+                  "accounting is the phase table above; measured hop "
+                  "times via --measured-phases)")
+        if args.waves:
+            print("waves: n/a for TAM (the hierarchical engine rides "
+                  "mesh collectives, not the pallas_dma transport)")
         return 0
+
+    def _print_roofline():
+        # bytes-touched model + HBM floors (harness/roofline.py): the
+        # optimistic/fenced window a measured per-rep time is judged
+        # against. jax_sim always; jax_shard at --ndev (default 1, the
+        # single-chip flagship tier with the fused single-dev rounds)
+        from tpu_aggcomm.harness.roofline import HBM_V5E_GBPS, rep_bytes
+        nd = args.ndev or 1
+        print(f"roofline (floors at {HBM_V5E_GBPS:.0f} GB/s HBM):")
+        for lowering, ndv in (("jax_sim", 1), ("jax_shard", nd)):
+            rb = rep_bytes(sched, lowering=lowering, ndev=ndv)
+            lo = rb.floor_seconds()
+            hi = rb.floor_seconds(fenced=True)
+            print(f"  {lowering}(ndev={ndv}): {rb.total() / 1e6:.2f} MB "
+                  f"optimistic / {rb.total(fenced=True) / 1e6:.2f} MB "
+                  f"fenced ({rb.rounds} rounds) -> floors "
+                  f"[{lo * 1e6:.1f}, {hi * 1e6:.1f}] us/rep")
 
     if sched.collective:
         e = len(p.senders) * len(p.receivers)
         print(f"dense vendor collective (alltoallw analog): "
               f"{e} messages x {p.data_size} B in ONE call")
+        if args.roofline:
+            _print_roofline()
+        if args.waves:
+            print("waves: n/a for dense collectives (they lower to the "
+                  "vendor all_to_all, not the pallas_dma transport)")
         return 0
 
     from tpu_aggcomm.backends.jax_ici import lower_schedule
@@ -512,7 +549,8 @@ def _run_inspect(args) -> int:
         if p.nprocs % ndev:
             print(f"(ndev {ndev} does not divide nprocs {p.nprocs}; "
                   f"no shard view)")
-            return 0
+            ndev = 0
+    if getattr(args, "ndev", 0) and ndev:
         bsz = p.nprocs // ndev
         counts = np.asarray(recv_slot_counts(p))
         recv_base, F = recv_layout(counts, ndev, bsz)
@@ -533,6 +571,19 @@ def _run_inspect(args) -> int:
             print(f"  round {r:3d}: block M = {M:5d}, real msgs = "
                   f"{real:6d}, shipped slots = {shipped:6d} "
                   f"(padding x{shipped / max(real, 1):.2f})")
+
+    if args.roofline:
+        _print_roofline()
+    if args.waves:
+        # wave accounting: in-flight DMAs per wave, the quantity the
+        # posting discipline controls (RESULTS_TPU.md wave table)
+        from tpu_aggcomm.backends.pallas_dma import PallasDmaBackend
+        for label, b in (("lockstep", PallasDmaBackend()),
+                         ("concurrent", PallasDmaBackend(concurrent=True))):
+            w = b.wave_profile(sched)
+            print(f"pallas_dma {label:10s}: {w['steps']} DMA steps in "
+                  f"{w['n_waves']} waves, max in-flight = "
+                  f"{w['max_in_flight']}")
     return 0
 
 

@@ -39,6 +39,13 @@ Chained measurement adds ``chain_overhead_bytes`` per rep (the XOR
 perturbation's send-arena read+write and the checksum's recv read) —
 exposed separately so differenced chain numbers can be compared
 honestly against run() numbers.
+
+Scope: these are HBM floors. A pattern whose whole working set is
+VMEM-resident can legitimately beat them — the README config's 1.73
+µs/rep on the fused Pallas kernel sits below its 4.9 µs HBM floor for
+exactly that reason (128 KiB of arenas never leave VMEM inside the
+chained program). The floors bind at flagship sizes, where arenas are
+hundreds of MB to GB.
 """
 
 from __future__ import annotations
