@@ -126,11 +126,15 @@ def main() -> int:
           f"(sum {sum(rt.values()) * 1e6:.1f}us)", flush=True)
     p_tam = AggregatorPattern(nprocs=32, cb_nodes=14, data_size=2048,
                               comm_size=3, proc_node=4)
-    hops = b3.measure_tam_hops(compile_method(15, p_tam))
+    from tpu_aggcomm.harness.roofline import tam_rep_bytes
+    tam_sched = compile_method(15, p_tam)
+    hops = b3.measure_tam_hops(tam_sched)
+    tam_floor = tam_rep_bytes(tam_sched).floor_seconds()
     print(f"measured TAM hops -m 15 -p 4: "
           f"P2={hops['p2'] * 1e6:.1f}us P3={hops['p3'] * 1e6:.1f}us "
           f"P4={hops['p4'] * 1e6:.1f}us "
-          f"(total {hops['total'] * 1e6:.1f}us)", flush=True)
+          f"(total {hops['total'] * 1e6:.1f}us, HBM floor "
+          f"{tam_floor * 1e6:.1f}us)", flush=True)
 
     # 7. roofline: flagship d=2048 cells vs the bytes-touched HBM floors
     from tpu_aggcomm.harness.roofline import HBM_V5E_GBPS, rep_bytes

@@ -74,12 +74,28 @@ class TestModel:
                               comm_size=3)
         rb = rep_bytes(compile_method(8, p), lowering="jax_sim")
         assert rb.rounds == 1 and rb.edges == 32 * 14
-        with pytest.raises(ValueError, match="tam_phase_bytes"):
+        with pytest.raises(ValueError, match="tam_rep_bytes"):
             rep_bytes(compile_method(15, p))
         with pytest.raises(ValueError, match="single-device"):
             rep_bytes(compile_method(1, p), lowering="jax_sim", ndev=2)
         assert chain_overhead_bytes(compile_method(1, p)) > 0
         assert floor_seconds(819e9, 819.0) == pytest.approx(1.0)
+
+    def test_tam_rep_bytes(self):
+        from tpu_aggcomm.harness.roofline import tam_rep_bytes
+
+        p = AggregatorPattern(nprocs=32, cb_nodes=14, data_size=2048,
+                              comm_size=3, proc_node=4)
+        for mid in (15, 16):
+            rb = tam_rep_bytes(compile_method(mid, p))
+            assert rb.edges == 32 * 14
+            assert rb.gather_read == rb.scatter_write == 32 * 14 * 2048
+            # the two fenced hop boundaries each materialize E rows
+            assert rb.intermediate == 4 * 32 * 14 * 2048
+            assert rb.rounds == 3 and rb.refence_walks == 0
+            assert rb.floor_seconds() > 0
+        with pytest.raises(ValueError, match="models TAM"):
+            tam_rep_bytes(compile_method(1, p))
 
 
 class TestSingleDevRounds:

@@ -482,9 +482,15 @@ def _run_inspect(args) -> int:
         for k, v in vols.items():
             print(f"  {k:16s} {v} B")
         if args.roofline:
-            print("roofline: n/a for TAM (the 3-hop engine's byte "
-                  "accounting is the phase table above; measured hop "
-                  "times via --measured-phases)")
+            from tpu_aggcomm.harness.roofline import (HBM_V5E_GBPS,
+                                                      tam_rep_bytes)
+            rb = tam_rep_bytes(sched)
+            print(f"roofline (jax_sim 3-hop route, floors at "
+                  f"{HBM_V5E_GBPS:.0f} GB/s HBM): "
+                  f"{rb.total() / 1e6:.2f} MB/rep "
+                  f"({rb.edges} slabs x 2 hops materialized) -> floor "
+                  f"{rb.floor_seconds() * 1e6:.1f} us/rep; measured hop "
+                  f"times via --measured-phases --backend jax_sim")
         if args.waves:
             print("waves: n/a for TAM (the hierarchical engine rides "
                   "mesh collectives, not the pallas_dma transport)")
@@ -496,7 +502,9 @@ def _run_inspect(args) -> int:
         # against. jax_sim always; jax_shard at --ndev (default 1, the
         # single-chip flagship tier with the fused single-dev rounds)
         from tpu_aggcomm.harness.roofline import HBM_V5E_GBPS, rep_bytes
-        nd = args.ndev or 1
+        # the jax_shard backend refuses non-dividing device counts — a
+        # floor for an unrunnable configuration would judge nothing
+        nd = args.ndev if (args.ndev and p.nprocs % args.ndev == 0) else 1
         print(f"roofline (floors at {HBM_V5E_GBPS:.0f} GB/s HBM):")
         for lowering, ndv in (("jax_sim", 1), ("jax_shard", nd)):
             rb = rep_bytes(sched, lowering=lowering, ndev=ndv)

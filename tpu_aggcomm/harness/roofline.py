@@ -54,8 +54,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["RepBytes", "rep_bytes", "chain_overhead_bytes",
-           "floor_seconds", "HBM_V5E_GBPS"]
+__all__ = ["RepBytes", "rep_bytes", "tam_rep_bytes",
+           "chain_overhead_bytes", "floor_seconds", "HBM_V5E_GBPS"]
 
 #: TPU v5e (the chip behind the tunnel) peak HBM bandwidth, GB/s
 #: (public spec: 819 GB/s per chip).
@@ -111,14 +111,14 @@ def rep_bytes(schedule, *, lowering: str = "jax_sim", ndev: int = 1
     ``lowering``: "jax_sim" (dense rank-axis, one device) or "jax_shard"
     (compacted block lowering over ``ndev`` devices; ndev == 1 is the
     single-chip flagship tier with the fused single-dev rounds). TAM
-    schedules are out of scope (the 3-hop engine has its own byte
-    accounting, tam_phase_bytes)."""
+    schedules are modeled by :func:`tam_rep_bytes` (the 3-hop relay has
+    a different materialization structure)."""
     from tpu_aggcomm.backends.jax_shard import _schedule_edges
     from tpu_aggcomm.tam.engine import TamMethod
 
     if isinstance(schedule, TamMethod):
-        raise ValueError("TAM reps are modeled by tam_phase_bytes, "
-                         "not the rank-axis roofline")
+        raise ValueError("TAM reps are modeled by tam_rep_bytes, "
+                         "not the rank-axis rep_bytes")
     if lowering not in ("jax_sim", "jax_shard"):
         raise ValueError(f"unknown lowering {lowering!r}")
     if lowering == "jax_sim" and ndev != 1:
@@ -150,6 +150,35 @@ def rep_bytes(schedule, *, lowering: str = "jax_sim", ndev: int = 1
     return RepBytes(gather_read=gather_read, scatter_write=scatter_write,
                     zero_init=zero_init, intermediate=intermediate,
                     refence_walks=refence_walks, rounds=R, edges=nedges)
+
+
+def tam_rep_bytes(tam) -> RepBytes:
+    """Model one rep of the single-chip 3-hop TAM route (jax_sim
+    ``_tam_rep``): the staged and exchanged slab arrays are REAL
+    materializations (each hop is a fenced program step), so they count
+    as ``intermediate`` — one write + one read of E slab rows per hop
+    boundary — exactly like the block lowering's all_to_all blocks. The
+    measured hop times (``measure_tam_hops``) are judged against the
+    floors this returns: p3's floor is one intermediate pass, p2/p4's
+    the gather/scatter plus their share of the zero-init."""
+    from tpu_aggcomm.backends.jax_sim import _tam_tables
+    from tpu_aggcomm.tam.engine import TamMethod
+
+    if not isinstance(tam, TamMethod):
+        raise ValueError("tam_rep_bytes models TAM schedules; use "
+                         "rep_bytes for round-structured/collective ones")
+    p = tam.pattern
+    d = p.data_size
+    stage_idx, exch_idx, _dst, _slot = _tam_tables(tam)
+    E = len(stage_idx)
+    assert len(exch_idx) == E
+    zero_init = _recv_arena_bytes(p, "jax_sim", 1)
+    # P2 reads the send arena rows once, P4 writes the recv arena rows
+    # once; the two fenced hop boundaries each materialize E rows
+    # (staged write+read, exch write+read)
+    return RepBytes(gather_read=E * d, scatter_write=E * d,
+                    zero_init=zero_init, intermediate=2 * 2 * E * d,
+                    refence_walks=0, rounds=3, edges=E)
 
 
 def chain_overhead_bytes(schedule, *, lowering: str = "jax_sim",
