@@ -220,6 +220,31 @@ def test_unsupported_methods_fail_upfront(tmp_path):
         run_experiment(cfg, out=io.StringIO())
 
 
+def test_jax_ici_measured_rounds():
+    """The one-rank-per-device tier (the tier a real pod runs): same
+    prefix truncation through the scanned-chain scaffold at round color
+    boundaries, same additivity contract, same provenance label."""
+    import jax
+
+    from tpu_aggcomm.backends.jax_ici import JaxIciBackend
+
+    p = AggregatorPattern(nprocs=8, cb_nodes=3, data_size=256,
+                          comm_size=2)
+    sched = compile_method(1, p)
+    b = JaxIciBackend(devices=jax.devices()[:8])
+    rt = b.measure_round_times(sched)
+    assert sorted(rt) == list(range(4))       # ceil(8/2) rounds
+    assert sum(rt.values()) == pytest.approx(
+        b.measure_per_rep(sched), rel=1e-9)
+    recv, timers = b.run(sched, measured_phases=True, verify=True)
+    assert b.last_provenance == (
+        "jax_ici", "measured-rounds+attributed(buckets)")
+    assert timers[0].total_time > 0
+    for bad in (8, 15):                       # dense collective / TAM
+        with pytest.raises(ValueError, match="round-structured"):
+            b.run(compile_method(bad, p), measured_phases=True)
+
+
 class TestTamHops:
     """Measured 3-hop TAM decomposition (VERDICT r4 weak item 6): the
     relay's P2/P3/P4 boundaries by the same chained prefix-truncation

@@ -183,17 +183,27 @@ class JaxIciBackend:
     # ------------------------------------------------------------------
     def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
             verify: bool = False, profile_rounds: bool = False,
-            chained: bool = False):
+            chained: bool = False, measured_phases: bool = False):
         if ntimes < 1:
             raise ValueError("ntimes must be >= 1")
         if chained and profile_rounds:
             raise ValueError("chained and profile_rounds are exclusive "
                              "(one program vs per-round programs)")
+        if measured_phases and profile_rounds:
+            raise ValueError("measured_phases and profile_rounds are "
+                             "exclusive (truncation-differenced rounds vs "
+                             "per-round dispatch timing)")
         from tpu_aggcomm.tam.engine import TamMethod, tam_two_level_jax
         if isinstance(schedule, TamMethod) and chained:
             raise ValueError("chained measurement for TAM runs on jax_sim "
                              "(single-chip route); the two-level mesh "
                              "engine times whole reps")
+        if measured_phases and (isinstance(schedule, TamMethod)
+                                or schedule.collective):
+            raise ValueError(
+                "measured phases need a round-structured schedule "
+                "(TAM's 3-hop decomposition is measured by jax_sim's "
+                "measure_tam_hops; the dense collectives have none)")
         self.last_provenance = (
             "jax_ici",
             "attributed-chained" if chained
@@ -276,6 +286,35 @@ class JaxIciBackend:
 
         timers = [Timer() for _ in range(n)]
         self.last_rep_timers = []  # [rep][rank] -> Timer (save_all_timing)
+        self.last_round_times = []  # [rep] -> [per-round seconds]
+        if measured_phases:
+            # per-round durations MEASURED by prefix truncation on the
+            # mesh (same contract and label as jax_sim/jax_shard);
+            # single-round schedules have no boundary this tier can
+            # measure — the trivial decomposition downgrades the label
+            rt = self.measure_round_times(schedule)
+            if len(rt) >= 2:
+                rep_attr = attribute_rounds(schedule, rt, weights=attr_w)
+                self.last_provenance = (
+                    "jax_ici", "measured-rounds+attributed(buckets)")
+                self.last_round_times = [list(rt.values())
+                                         for _ in range(ntimes)]
+            else:
+                rep_attr = attribute_total(
+                    schedule, sum(rt.values()), weights=attr_w)
+                self.last_provenance = ("jax_ici", "attributed-chained")
+            for r, t in enumerate(timers):
+                t += Timer.from_array(rep_attr[r].as_array() * ntimes)
+            self.last_rep_timers = [
+                [Timer.from_array(t.as_array()) for t in rep_attr]
+                for _ in range(ntimes)]
+            recv_w = np.asarray(jax.device_get(warm))[:, :n_recv_slots, :]
+            recv_np = lanes_to_bytes(recv_w, p.data_size)
+            recv_bufs = self._split_recv(p, recv_np)
+            if verify:
+                from tpu_aggcomm.harness.verify import verify_recv
+                verify_recv(p, recv_bufs, iter_)
+            return recv_bufs, timers
         if chained:
             # honest per-rep seconds from the serial-chained differenced
             # scaffold (the multi-chip analog of jax_sim --chained);
@@ -393,6 +432,66 @@ class JaxIciBackend:
         self._chain_cache[key] = per_rep
         return per_rep
 
+    def measure_round_times(self, schedule, *, iters_small: int = 50,
+                            iters_big: int = 1050, trials: int = 3,
+                            windows: int = 3,
+                            max_rounds: int | None = None) -> dict:
+        """MEASURED per-round durations on the one-rank-per-device tier —
+        the tier a real pod runs — by chained round-prefix truncation
+        differencing: the chain scaffold truncated at round color
+        boundaries, round k's duration the differenced increment,
+        clamped and rescaled to sum exactly to the full-rep chain time
+        (the shared additivity contract, harness/chained.py). Zero
+        per-round dispatch sync — the accuracy upgrade over
+        ``--profile-rounds`` on the tier where per-dispatch sync is most
+        expensive (each profiled round pays a full mesh sync). Cached
+        per schedule."""
+        from tpu_aggcomm.core.schedule import schedule_shape_key
+        from tpu_aggcomm.harness.chained import (MAX_MEASURED_ROUNDS,
+                                                 differenced_round_times)
+        from tpu_aggcomm.tam.engine import TamMethod
+
+        if isinstance(schedule, TamMethod) or schedule.collective:
+            raise ValueError(
+                "measured round times need a round-structured schedule "
+                "(TAM's 3-hop decomposition is measured by jax_sim's "
+                "measure_tam_hops; the dense collectives have none)")
+        if max_rounds is None:
+            max_rounds = MAX_MEASURED_ROUNDS
+        low = lower_schedule(schedule)
+        # round ids in color order + the color index where each begins
+        round_ids, starts = [], []
+        for c, r in enumerate(low.round_of_color):
+            if not round_ids or r != round_ids[-1]:
+                round_ids.append(r)
+                starts.append(c)
+        if len(round_ids) > max_rounds:
+            raise ValueError(
+                f"{len(round_ids)} rounds exceeds max_rounds={max_rounds} "
+                f"(one chain family is compiled per round); use "
+                f"profile_rounds for very deep schedules")
+        key = (schedule_shape_key(schedule), "round_times", iters_small,
+               iters_big, trials, windows)
+        if key in self._chain_cache:
+            return self._chain_cache[key]
+        per_full = self.measure_per_rep(schedule, iters_small=iters_small,
+                                        iters_big=iters_big, trials=trials,
+                                        windows=windows)
+        p = schedule.pattern
+        mesh = self._mesh(p.nprocs)
+        sharding = NamedSharding(mesh, P(AXIS))
+        _segs, _sr, make_chain, n_send_slots, _nr = self._segments_for(
+            schedule, mesh, sharding, False)
+        send0 = jax.device_put(self._global_send(p, 0, n_send_slots),
+                               sharding)
+        out = differenced_round_times(
+            lambda k: (lambda iters: make_chain(iters,
+                                                upto_colors=starts[k])),
+            send0, round_ids, per_full, iters_small=iters_small,
+            iters_big=iters_big, trials=trials, windows=windows)
+        self._chain_cache[key] = out
+        return out
+
     # ------------------------------------------------------------------
     def _global_send(self, p: AggregatorPattern, iter_: int,
                      n_send_slots: int) -> np.ndarray:
@@ -495,12 +594,18 @@ class JaxIciBackend:
 
             return seg
 
-        def make_chain(iters: int):
+        def make_chain(iters: int, upto_colors: int | None = None):
             from tpu_aggcomm.harness.chained import scanned_chain
+
+            # ``upto_colors`` truncates every rep to its first color
+            # steps (a round-prefix boundary) — the measure_round_times
+            # prefixes, through this SAME scaffold so dispatch and
+            # scaffold cost cancel identically
+            cN = low.n_colors if upto_colors is None else upto_colors
 
             def chain_local(send, sslot, rslot):
                 rep = lambda s, recv0: rep_body(         # noqa: E731
-                    s, recv0, sslot[0], rslot[0], 0, low.n_colors)
+                    s, recv0, sslot[0], rslot[0], 0, cN)
                 inner = scanned_chain(rep, n_recv_slots=low.n_recv_slots,
                                       w=w, jdt=jdt, axis=AXIS, iters=iters)
                 return inner(send[0])[None]

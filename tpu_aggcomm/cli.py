@@ -67,13 +67,14 @@ def build_parser() -> argparse.ArgumentParser:
                             "measurement (cancels dispatch RPC overhead — "
                             "the honest mode on a tunneled TPU)")
     bench.add_argument("--measured-phases", action="store_true",
-                       help="jax_sim/jax_shard, round-structured methods "
-                            "(+ TAM m=15/16 on jax_sim): MEASURED "
+                       help="jax_sim/jax_shard/jax_ici, round-structured "
+                            "methods (+ TAM m=15/16 on jax_sim): MEASURED "
                             "per-round / per-hop durations via chained "
                             "prefix-truncation differencing (no model "
                             "parameter; single-round schedules fall back "
-                            "to the measured post/deliver split); phase "
-                            "columns marked 'measured-rounds/-hops/"
+                            "to the measured post/deliver split on "
+                            "jax_sim, attributed-chained elsewhere); "
+                            "phase columns marked 'measured-rounds/-hops/"
                             "-split...+attributed(...)' in the "
                             "provenance sidecar")
     bench.add_argument("--results-csv", default="results.csv")

@@ -37,10 +37,12 @@ class ExperimentConfig:
     results_csv: str | None = "results.csv"
     profile_rounds: bool = False
     chained: bool = False        # jax_sim/jax_shard/jax_ici: chained timing
-    measured_phases: bool = False  # jax_sim/jax_shard: measured per-round
-    #                                times (round-prefix truncation
-    #                                differencing; single-round schedules
-    #                                fall back to the post/deliver split)
+    measured_phases: bool = False  # jax_sim/jax_shard/jax_ici: measured
+    #                                per-round times (round-prefix
+    #                                truncation differencing); TAM hops on
+    #                                jax_sim; single-round schedules fall
+    #                                back to the post/deliver split on
+    #                                jax_sim, attributed-chained elsewhere
 
 
 def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
@@ -65,10 +67,10 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             "local/native time each op directly, pallas_dma attributes "
             "whole-rep time)")
     if cfg.measured_phases:
-        if cfg.backend not in ("jax_sim", "jax_shard"):
+        if cfg.backend not in ("jax_sim", "jax_shard", "jax_ici"):
             raise ValueError(
-                "--measured-phases requires --backend jax_sim or "
-                "jax_shard (truncation-differenced round/phase "
+                "--measured-phases requires --backend jax_sim, jax_shard "
+                "or jax_ici (truncation-differenced round/phase "
                 "measurement exists only on the chained rank-axis "
                 "programs)")
         if cfg.profile_rounds:
