@@ -68,6 +68,19 @@ def test_analyze_subcommand(tmp_path):
     assert "winner: All to many" in out
 
 
+def test_analyze_shows_provenance_tags(tmp_path):
+    """The winner table annotates each best row with its sidecar
+    provenance — a measured row and an attributed row must not read as
+    equals."""
+    csv = tmp_path / "results.csv"
+    run_cli(["-n", "8", "-m", "1", "-a", "2", "-d", "64", "-c", "2",
+             "--backend", "local", "--verify",
+             "--results-csv", str(csv)])
+    rc, out = run_cli(["analyze", "--results-csv", str(csv)])
+    assert rc == 0
+    assert "[local, total-only]" in out
+
+
 def test_analyze_missing_file(tmp_path):
     with pytest.raises(SystemExit):
         run_cli(["analyze", "--results-csv", str(tmp_path / "nope.csv")])

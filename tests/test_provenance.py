@@ -46,6 +46,24 @@ def test_append_rejects_unknown_vocabulary(tmp_path):
                           "guessed")
 
 
+def test_comma_bearing_labels_round_trip(tmp_path):
+    """The hop/split vocabulary contains commas — the sidecar must quote
+    them so a DictReader recovers the label whole, not split across
+    columns."""
+    import csv as _csv
+
+    res = tmp_path / "r.csv"
+    res.write_text("header\nrow1\n")
+    path = append_provenance(
+        str(res), "All to many TAM", "jax_sim", "jax_sim",
+        "measured-hops(P2,P3,P4)+attributed(ranks)")
+    with open(path, newline="") as fh:
+        rows = list(_csv.DictReader(fh))
+    assert rows[0]["phase columns"] == \
+        "measured-hops(P2,P3,P4)+attributed(ranks)"
+    assert rows[0]["results row"] == "1"
+
+
 def test_local_rows_are_total_only(tmp_path):
     recs, rows = _run(tmp_path, "local", 1)
     assert rows[-1]["backend requested"] == "local"

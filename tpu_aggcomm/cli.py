@@ -611,9 +611,35 @@ def _run_analyze(args) -> int:
     if not rows:
         raise SystemExit(f"{args.results_csv} has no data rows")
 
+    # provenance sidecar (results row index -> executed backend, phase
+    # source): the winner table says not just WHICH schedule won but how
+    # trustworthy each row's phase columns are — a measured-rounds row
+    # and an attributed row must not read as equals
+    from tpu_aggcomm.harness.report import PHASE_SOURCES, provenance_path
+    prov: dict[int, tuple[str, str]] = {}
+    try:
+        with open(provenance_path(args.results_csv), newline="") as f:
+            for pr in csv.DictReader(f):
+                try:
+                    idx = int(pr["results row"])
+                    executed, phases = (pr["backend executed"],
+                                        pr["phase columns"])
+                except (KeyError, ValueError, TypeError):
+                    continue
+                # reject truncated rows (restval None) and labels outside
+                # the vocabulary (e.g. comma-split fragments from sidecars
+                # written before the quoting fix) — a garbled tag defeats
+                # the trust annotation this join exists to provide
+                if executed is None or phases not in PHASE_SOURCES:
+                    continue
+                prov[idx] = (executed, phases)
+    except FileNotFoundError:
+        pass
+
     # config = (procs, aggregators, data size); best row per (config, method)
     best: dict[tuple, dict] = {}
-    for r in rows:
+    best_idx: dict[tuple, int] = {}
+    for i, r in enumerate(rows):
         try:
             # numeric keys: sort naturally AND reject truncated rows (a
             # sweep killed mid-append leaves None trailing fields)
@@ -624,6 +650,7 @@ def _run_analyze(args) -> int:
             continue
         if key not in best or t < float(best[key]["max total time"]):
             best[key] = r
+            best_idx[key] = i + 1           # sidecar rows are 1-based
     if not best:
         raise SystemExit(
             f"{args.results_csv}: no parseable result rows (expected the "
@@ -636,9 +663,11 @@ def _run_analyze(args) -> int:
                         key=lambda k: float(best[k]["max total time"]))
         for k in ranked:
             r = best[k]
+            pv = prov.get(best_idx[k])
+            tag = f"  [{pv[0]}, {pv[1]}]" if pv else ""
             print(f"  {k[3]:34s} best max total = "
                   f"{float(r['max total time']):.6f} s  "
-                  f"(comm_size = {r['max comm']})")
+                  f"(comm_size = {r['max comm']}){tag}")
         print(f"  winner: {ranked[0][3]}")
     return 0
 

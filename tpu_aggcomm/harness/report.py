@@ -191,10 +191,16 @@ def append_provenance(filename: str, method_name: str, requested: str,
                     bak = f"{path}.old-schema.{k}"
                 os.replace(path, bak)
                 write_header = True
-    with open(path, "a") as fh:
+    import csv
+    with open(path, "a", newline="") as fh:
         if write_header:
             fh.write(_PROV_HEADER)
-        fh.write(f"{nrows},{method_name},{requested},{executed},{phases}\n")
+        # csv.writer, not f-string joins: the phase-source vocabulary
+        # contains commas (measured-hops(P2,P3,P4)+attributed(ranks)),
+        # which must be quoted or every downstream DictReader splits the
+        # label across columns
+        csv.writer(fh, lineterminator="\n").writerow(
+            [nrows, method_name, requested, executed, phases])
     return path
 
 
