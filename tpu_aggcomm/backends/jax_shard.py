@@ -235,26 +235,17 @@ class JaxShardBackend:
         return Mesh(np.array(devs[:d]), (AXIS,)), d
 
     # ------------------------------------------------------------------
-    def _run_tam_sharded(self, schedule, iter_: int, ntimes: int,
-                         verify: bool, profile_rounds: bool):
-        """m=15/16 through the explicit blocked two-level engine
-        (tam_two_level_sharded): B logical ranks per device on a
-        (node, local) grid — the collective_write relay as two padded
-        block all_to_alls, NOT the sharded-jax_sim one-rep route. Ragged
-        node maps run this route too (the engine pads blocks to
-        ceil(N/Dn) x ceil(Lmax/Dl), lustre_driver_test.c:374-386 analog);
-        the only remaining fallback (return None) is an explicit
-        ranks_per_device split whose device count has no factorization
-        fitting inside the (N, Lmax) topology."""
-        from tpu_aggcomm.parallel import host_major_devices
-        from tpu_aggcomm.tam.engine import (sharded_grid,
-                                            tam_two_level_sharded)
+    def _tam_grid(self, schedule, devs):
+        """Resolve the (ndev, (Dn, Dl)) device grid for the blocked TAM
+        engine, or None when an explicit ranks_per_device split has no
+        fitting factorization — shared by the plain and chained TAM
+        routes so they can never resolve different grids."""
+        from tpu_aggcomm.tam.engine import sharded_grid
 
         p = schedule.pattern
         na = schedule.assignment
         N = na.nnodes
         L = int(na.node_sizes.max())        # Lmax: ragged maps allowed
-        devs = host_major_devices(self._devices)
         if self._ranks_per_device and p.nprocs % self._ranks_per_device:
             # same contract as _mesh on every other route: an invalid
             # explicit split raises, it is never silently floor-divided
@@ -273,6 +264,68 @@ class JaxShardBackend:
                 ndev -= 1
         if ndev <= 0 or ndev > len(devs):
             return None
+        return ndev, grid
+
+    def _run_tam_chained(self, schedule, iter_: int, ntimes: int,
+                         verify: bool):
+        """TAM with chained (differenced) timing through the blocked
+        engine: delivery + verification from one plain rep; per-rep
+        seconds from the engine's serial-chain scaffold; per-rank
+        columns by the byte-weighted TAM split of the measured total."""
+        from tpu_aggcomm.parallel import host_major_devices
+        from tpu_aggcomm.tam.engine import tam_two_level_sharded
+
+        devs = host_major_devices(self._devices)
+        resolved = self._tam_grid(schedule, devs)
+        if resolved is None:
+            return None
+        ndev, grid = resolved
+        p = schedule.pattern
+        # ONE plain rep: delivery/verification AND the chain-seed state
+        # (a separate chained call would re-run and discard a twin rep —
+        # through the tunnel that doubles the non-chain cost)
+        recv_bufs, _times, st = tam_two_level_sharded(
+            schedule, devs[:ndev], iter_, 1, mesh_shape=grid,
+            cache=self._cache, return_state=True)
+        from tpu_aggcomm.harness.chained import differenced_per_rep
+        per_rep = differenced_per_rep(
+            st["make_chain"], st["last_send_dev"],
+            iters_small=20, iters_big=220, trials=3, windows=2)
+        self.last_provenance = ("jax_shard", "attributed-chained")
+        attr_w = weights_for(schedule)
+        rep_attr = attribute_total(schedule, per_rep, weights=attr_w)
+        timers = [Timer() for _ in range(p.nprocs)]
+        for r, t in enumerate(timers):
+            t += Timer.from_array(rep_attr[r].as_array() * ntimes)
+        self.last_rep_timers = [
+            [Timer.from_array(t.as_array()) for t in rep_attr]
+            for _ in range(ntimes)]
+        self.last_round_times = []
+        if verify:
+            from tpu_aggcomm.harness.verify import verify_recv
+            verify_recv(p, recv_bufs, iter_)
+        return recv_bufs, timers
+
+    def _run_tam_sharded(self, schedule, iter_: int, ntimes: int,
+                         verify: bool, profile_rounds: bool):
+        """m=15/16 through the explicit blocked two-level engine
+        (tam_two_level_sharded): B logical ranks per device on a
+        (node, local) grid — the collective_write relay as two padded
+        block all_to_alls, NOT the sharded-jax_sim one-rep route. Ragged
+        node maps run this route too (the engine pads blocks to
+        ceil(N/Dn) x ceil(Lmax/Dl), lustre_driver_test.c:374-386 analog);
+        the only remaining fallback (return None) is an explicit
+        ranks_per_device split whose device count has no factorization
+        fitting inside the (N, Lmax) topology."""
+        from tpu_aggcomm.parallel import host_major_devices
+        from tpu_aggcomm.tam.engine import tam_two_level_sharded
+
+        devs = host_major_devices(self._devices)
+        resolved = self._tam_grid(schedule, devs)
+        if resolved is None:
+            return None
+        ndev, grid = resolved
+        p = schedule.pattern
         recv_bufs, rep_times = tam_two_level_sharded(
             schedule, devs[:ndev], iter_, ntimes, mesh_shape=grid,
             cache=self._cache)
@@ -614,8 +667,10 @@ class JaxShardBackend:
         from tpu_aggcomm.tam.engine import TamMethod
 
         if isinstance(schedule, TamMethod):
-            raise ValueError("chained measurement for TAM runs on "
-                             "jax_sim/jax_ici, not jax_shard")
+            raise ValueError(
+                "TAM has no round-program chain here; chained TAM on "
+                "jax_shard rides the blocked engine — call "
+                "run(schedule, chained=True) (or use jax_sim)")
         key = (self._key(schedule), iters_small, iters_big, trials, windows)
         if key in self._chain_cache:
             return self._chain_cache[key]
@@ -713,14 +768,22 @@ class JaxShardBackend:
         p = schedule.pattern
         n = p.nprocs
         is_tam = isinstance(schedule, TamMethod)
-        if is_tam and chained:
-            raise ValueError("chained measurement for TAM runs on "
-                             "jax_sim/jax_ici, not jax_shard")
         if measured_phases and (is_tam or schedule.collective):
             raise ValueError(
                 "measured phases need a round-structured schedule "
-                "(TAM and the dense collectives have no gather/deliver "
-                "round decomposition to truncate)")
+                "(TAM's 3-hop decomposition is measured by jax_sim's "
+                "measure_tam_hops; the dense collectives have none)")
+        if is_tam and chained:
+            # honest flagship-TAM timing: the blocked engine's chain
+            # scaffold — delivery and verification from the same rep
+            # whose state seeds the chain
+            out = self._run_tam_chained(schedule, iter_, ntimes, verify)
+            if out is not None:
+                return out
+            raise ValueError(
+                "chained TAM on jax_shard needs a (Dn, Dl) grid for the "
+                "blocked engine (explicit ranks_per_device split does "
+                "not fit); use --backend jax_sim")
         if is_tam:
             out = self._run_tam_sharded(schedule, iter_, ntimes, verify,
                                         profile_rounds)

@@ -90,16 +90,20 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
         if m not in METHODS:
             raise ValueError(f"unknown method id {m}; valid ids: "
                              f"{sorted(METHODS)}")
-    if cfg.chained and cfg.backend in ("jax_ici", "jax_shard"):
+    if cfg.chained and cfg.backend == "jax_ici":
         # fail BEFORE any method runs: a run-all sweep must not crash
-        # mid-run (and leave a partial CSV) when it reaches m=15/16
+        # mid-run (and leave a partial CSV) when it reaches m=15/16.
+        # (jax_shard chains TAM through the blocked engine's scaffold
+        # since round 5; jax_ici's two-level mesh engine still times
+        # whole reps)
         tam_selected = [m for m in methods if METHODS[m].tam]
         if tam_selected:
             raise ValueError(
                 f"--chained on --backend {cfg.backend} does not support "
                 f"the TAM methods {tam_selected} (the two-level mesh "
-                f"engine times whole reps); use --backend jax_sim for a "
-                f"chained run-all, or pick a non-TAM method with -m")
+                f"engine times whole reps); use --backend jax_sim or "
+                f"jax_shard for a chained run-all, or pick a non-TAM "
+                f"method with -m")
     # schedules do not depend on the iteration (only the fill seed does):
     # compile once per method, reuse across iters
     compiled = {m: compile_method(m, pattern, barrier_type=cfg.barrier_type)

@@ -46,7 +46,8 @@ from tpu_aggcomm.core.topology import NodeAssignment, static_node_assignment
 
 __all__ = ["TamMethod", "gen_tam_schedule", "padded_mesh_size",
            "tam_oracle", "tam_two_level_jax", "tam_two_level_sharded",
-           "sharded_grid", "tam_phase_bytes"]
+           "tam_two_level_sharded_chained", "sharded_grid",
+           "tam_phase_bytes"]
 
 
 def padded_mesh_size(na: NodeAssignment) -> int:
@@ -409,7 +410,8 @@ def sharded_grid(N: int, L: int, ndev: int) -> tuple[int, int]:
 
 
 def tam_two_level_sharded(tam: TamMethod, devices, iter_: int = 0,
-                          ntimes: int = 1, mesh_shape=None, cache=None):
+                          ntimes: int = 1, mesh_shape=None, cache=None,
+                          return_state: bool = False):
     """The two-level exchange with **B logical ranks per device** — the
     reference's flagship regime (16,384 ranks on 256 nodes,
     script_theta_all_to_many_256.sh:3,11) on a small device grid.
@@ -542,26 +544,60 @@ def tam_two_level_sharded(tam: TamMethod, devices, iter_: int = 0,
         from tpu_aggcomm.backends.jax_ici import put_global
         tab_devs = [put_global(t, shard) for t in (pack1, pack2, scat)]
 
-        def local_fn(send, pk1, pk2, sc):
-            x = send[0, 0]                                # (S_rows+1, w)
-            b1 = jnp.take(x, pk1[0, 0], axis=0)           # (Dn, K1, w)
+        def _rep_local(x, pk1, pk2, sc):
+            # one device's rep: x (S_rows+1, w) -> recv (R_rows, w).
+            # Shared by the timed program and the chained-measurement
+            # scan so the chained program cannot drift from the program
+            # it measures (the rep_body precedent, backends/jax_shard.py)
+            b1 = jnp.take(x, pk1, axis=0)                 # (Dn, K1, w)
             g1 = lax.all_to_all(b1, "node", 0, 0)
             f1 = jnp.concatenate(
                 [g1.reshape(Dn * K1, w), jnp.zeros((1, w), x.dtype)])
-            b2 = jnp.take(f1, pk2[0, 0], axis=0)          # (Dl, K2, w)
+            b2 = jnp.take(f1, pk2, axis=0)                # (Dl, K2, w)
             g2 = lax.all_to_all(b2, "local", 0, 0)
             recv = jnp.zeros((R_rows + 1, w), x.dtype)
-            recv = recv.at[sc[0, 0]].set(g2.reshape(Dl * K2, w))
-            return recv[:R_rows][None, None]
+            recv = recv.at[sc].set(g2.reshape(Dl * K2, w))
+            return recv[:R_rows]
+
+        def local_fn(send, pk1, pk2, sc):
+            return _rep_local(send[0, 0], pk1[0, 0], pk2[0, 0],
+                              sc[0, 0])[None, None]
 
         fn = jax.jit(jax.shard_map(
             local_fn, mesh=mesh, in_specs=(P("node", "local"),) * 4,
             out_specs=P("node", "local")))
 
+        def make_chain(iters: int):
+            """The serial-chain scaffold on the (node, local) grid: rep
+            r+1's send XOR-perturbed by a psum over BOTH mesh axes of
+            rep r's delivered rows — same token formula as every other
+            chained backend (harness/chained.py), so chained numbers
+            stay comparable across tiers."""
+            from tpu_aggcomm.harness.chained import xor_word
+
+            def chain_local(send, pk1, pk2, sc):
+                def body(s, r):
+                    recv = _rep_local(s, pk1[0, 0], pk2[0, 0], sc[0, 0])
+                    tok = (lax.psum(
+                        jnp.sum(recv[:, 0].astype(jnp.uint32)),
+                        ("node", "local")).astype(jnp.int32) + r) % 251
+                    return s ^ xor_word(tok, jdt), ()
+
+                out, _ = lax.scan(body, send[0, 0],
+                                  jnp.arange(iters, dtype=jnp.int32),
+                                  unroll=1)
+                return out[None, None]
+
+            csm = jax.shard_map(
+                chain_local, mesh=mesh, in_specs=(P("node", "local"),) * 4,
+                out_specs=P("node", "local"))
+            cjf = jax.jit(csm)
+            return lambda send: cjf(send, *tab_devs)
+
         st = dict(fn=fn, tab_devs=tab_devs, shard=shard, si=si, sj=sj,
                   send_flat=send_flat, S_rows=S_rows, R_rows=R_rows,
                   agg_i=agg_i, agg_j=agg_j, agg_slot=agg_slot, w=w,
-                  warm=False)
+                  make_chain=make_chain, warm=False)
         if cache is not None:
             cache[key] = st
     fn, tab_devs, shard = st["fn"], st["tab_devs"], st["shard"]
@@ -582,6 +618,7 @@ def tam_two_level_sharded(tam: TamMethod, devices, iter_: int = 0,
 
     from tpu_aggcomm.backends.jax_ici import put_global
     send_dev = put_global(arena, shard)
+    st["last_send_dev"] = send_dev     # chain seed (iter-0 convention)
 
     if not st["warm"]:
         fn(send_dev, *tab_devs).block_until_ready()   # warm-up compile
@@ -605,4 +642,30 @@ def tam_two_level_sharded(tam: TamMethod, devices, iter_: int = 0,
             rows = out[dev_i(r), dev_j(r),
                        dev_u(r) * a:(dev_u(r) + 1) * a]
             recv_bufs[r] = lanes_to_bytes(rows, ds)
+    if return_state:
+        return recv_bufs, rep_times, st
     return recv_bufs, rep_times
+
+
+def tam_two_level_sharded_chained(tam: TamMethod, devices, *,
+                                  mesh_shape=None, cache=None,
+                                  iters_small: int = 20,
+                                  iters_big: int = 220, trials: int = 3,
+                                  windows: int = 2) -> float:
+    """Serial-chained differenced per-rep seconds of the BLOCKED
+    two-level engine — honest flagship-TAM timing through a tunneled or
+    contended dispatch path (the last tier that only had per-dispatch
+    wall times). One verified rep runs first (build + warm-up + delivery
+    check path), then the chain scaffold stashed in the engine state
+    measures reps back-to-back with dispatch overhead differenced away
+    (harness/chained.py)."""
+    from tpu_aggcomm.harness.chained import differenced_per_rep
+
+    cache = {} if cache is None else cache
+    _recv, _times, st = tam_two_level_sharded(
+        tam, devices, iter_=0, ntimes=1, mesh_shape=mesh_shape,
+        cache=cache, return_state=True)
+    return differenced_per_rep(st["make_chain"], st["last_send_dev"],
+                               iters_small=iters_small,
+                               iters_big=iters_big, trials=trials,
+                               windows=windows)
