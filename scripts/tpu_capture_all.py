@@ -19,6 +19,8 @@ each logged with a PASS/FAIL marker so a partial run is still evidence:
 5. scripts/tpu_followup.py      — seven stages: bench sanity, n=1024
    cross-lowering, per-round profile, winner refresh, measured splits,
    measured rounds + TAM hops, flagship roofline on the fused lowering
+6. scripts/tpu_flagship.py      — the 16,384x256 Theta shape on one
+   chip: m=1 cells + the blocked-engine TAM cell, all chained-timed
 
 Concurrent-discipline note: stage 3 executes BOTH disciplines (the
 probe script runs pallas_dma and pallas_dma_conc); the wave-accounting
@@ -100,12 +102,16 @@ def main() -> int:
         record("followup",
                stage("followup",
                      [sys.executable, "scripts/tpu_followup.py"]))
+        record("flagship",
+               stage("flagship",
+                     [sys.executable, "scripts/tpu_flagship.py"]))
     else:
         # gated tests and the followup batch ALSO launch kernels — the
         # compile-before-any-kernel invariant gates everything
         print("Mosaic rejected a kernel: fix the legality issue first — "
               "NOT launching any kernel through the tunnel", flush=True)
-        for k in ("bench", "mosaic-execute", "gated-tests", "followup"):
+        for k in ("bench", "mosaic-execute", "gated-tests", "followup",
+                  "flagship"):
             results[k] = "SKIP"
     print("===== capture summary =====")
     for k, v in results.items():

@@ -15,7 +15,9 @@ pod"). At d=256 the arenas are ~1 GB each and the full pattern executes,
 byte-verifies, and is chained-timed honestly.
 
 Cells: m=1 unthrottled, m=1 -c 2048 (the Theta grid's deep-throttle
-point: 8 distinct rounds), m=8 dense. Each --verify'd (4.19M slabs
+point: 8 distinct rounds), m=8 dense, and (round 5) m=15 TAM through
+the blocked two-level engine's chain scaffold — the flagship TAM tier's
+first honest (differenced) timing. Each --verify'd (4.19M slabs
 byte-checked); timing via the serial-chain differenced scaffold with
 reduced chain lengths (a flagship rep is ~ms, so short chains already
 swamp the dispatch RPC).
@@ -32,10 +34,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # for the full-payload n=4096 scaling point
 N, A, D = 16384, 256, 256
 CELLS = [(1, 999_999_999), (1, 2048), (8, 999_999_999)]
+TAM_CELL = True        # argv overrides run EXACTLY the requested cells
 if len(sys.argv) > 3:
     N, A, D = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     cs = [int(c) for c in sys.argv[4:]] or [999_999_999]
     CELLS = [(1, c) for c in cs]
+    TAM_CELL = False
 elif len(sys.argv) > 1:
     sys.exit(f"usage: {sys.argv[0]} [N A D [c ...]] — need all of N A D")
 
@@ -67,6 +71,22 @@ def main() -> int:
         print(f"  chained: {per_rep * 1e3:.3f} ms/rep, {gbs:.1f} GB/s "
               f"aggregate (measure wall {time.perf_counter() - t0:.0f}s)",
               flush=True)
+
+    # flagship TAM (m=15) through the blocked engine's chain scaffold —
+    # proc_node=64 is the Theta ranks-per-node (script_theta:3). ONE
+    # run(chained=True): the backend's TAM-chained route verifies the
+    # rep whose state seeds the chain (no discarded twin rep).
+    if TAM_CELL:
+        p_tam = AggregatorPattern(nprocs=N, cb_nodes=A, data_size=D,
+                                  proc_node=64)
+        t0 = time.perf_counter()
+        _recv, timers = backend.run(compile_method(15, p_tam), ntimes=1,
+                                    verify=True, chained=True)
+        per_tam = timers[0].total_time
+        print(f"m=15 TAM: verified {N}x{A} d={D} proc_node=64; chained "
+              f"{per_tam * 1e3:.3f} ms/rep, "
+              f"{N * A * D / per_tam / 1e9:.1f} GB/s aggregate "
+              f"(wall {time.perf_counter() - t0:.0f}s)", flush=True)
     return 0
 
 
