@@ -95,14 +95,14 @@ def test_jax_chained_rejects_tam_and_profile():
 
 
 def test_runner_rejects_chained_run_all_with_tam_upfront():
-    """-m 0 --chained on the mesh tiers must fail BEFORE any method runs
-    (not crash at m=15 mid-sweep leaving a partial CSV): the TAM engine
-    times whole reps, so chained run-all belongs to jax_sim."""
+    """-m 0 --chained on jax_ici must fail BEFORE any method runs (not
+    crash at m=15 mid-sweep leaving a partial CSV): its two-level mesh
+    engine times whole reps. jax_shard chains TAM through the blocked
+    engine since round 5, so its chained run-all covers m=15/16."""
     import io
     from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
-    for backend in ("jax_ici", "jax_shard"):
-        cfg = ExperimentConfig(nprocs=8, cb_nodes=3, data_size=16,
-                               comm_size=2, method=0, backend=backend,
-                               chained=True, results_csv=None)
-        with pytest.raises(ValueError, match="TAM methods"):
-            run_experiment(cfg, out=io.StringIO())
+    cfg = ExperimentConfig(nprocs=8, cb_nodes=3, data_size=16,
+                           comm_size=2, method=0, backend="jax_ici",
+                           chained=True, results_csv=None)
+    with pytest.raises(ValueError, match="TAM methods"):
+        run_experiment(cfg, out=io.StringIO())
