@@ -68,6 +68,26 @@ def test_analyze_subcommand(tmp_path):
     assert "winner: All to many" in out
 
 
+def test_sweep_measured_phases_rows_and_resume(tmp_path):
+    """sweep --measured-phases: cells emit measured-rounds rows, the
+    resume sidecar distinguishes a measured sweep from a chained one
+    (same grid must NOT be skipped), and re-resume of the measured sweep
+    itself skips."""
+    csv = tmp_path / "results.csv"
+    base = ["sweep", "-n", "8", "-m", "1", "-a", "2", "-d", "64", "-i", "1",
+            "--backend", "jax_sim", "--results-csv", str(csv),
+            "--comm-sizes", "4"]
+    run_cli(base + ["--measured-phases"])
+    from tpu_aggcomm.harness.report import provenance_path
+    with open(provenance_path(str(csv))) as fh:
+        assert "measured-rounds+attributed(buckets)" in fh.read()
+    rc, out = run_cli(base + ["--measured-phases", "--resume"])
+    assert "resume: skipping already-recorded comm sizes [4]" in out
+    # a CHAINED sweep over the same grid is a different experiment
+    rc, out = run_cli(base + ["--chained", "--resume"])
+    assert "skipping" not in out
+
+
 def test_analyze_shows_provenance_tags(tmp_path):
     """The winner table annotates each best row with its sidecar
     provenance — a measured row and an attributed row must not read as

@@ -152,6 +152,12 @@ def build_parser() -> argparse.ArgumentParser:
     sw.add_argument("-t", dest="agg_type", type=int, default=1)
     sw.add_argument("--backend", choices=BACKENDS, default="local")
     sw.add_argument("--verify", action="store_true")
+    sw.add_argument("--measured-phases", action="store_true",
+                    help="jax_sim/jax_shard/jax_ici: measured per-round/"
+                         "per-hop rows per sweep cell (one prefix-chain "
+                         "compile per round per cell — meaningful compile "
+                         "cost on deep-throttle cells; cells beyond "
+                         "MAX_MEASURED_ROUNDS fail upfront)")
     sw.add_argument("--chained", action="store_true",
                     help="jax_sim/jax_shard/jax_ici: serial-chained per-rep "
                          "measurement")
@@ -319,30 +325,37 @@ def _sweep_sidecar(csv_path: str) -> str:
 
 
 def _sweep_key(nprocs, cb_nodes, data_size, method, iters, ntimes, agg_type,
-               proc_node, backend, chained) -> dict:
-    return {"nprocs": nprocs, "cb_nodes": cb_nodes, "data_size": data_size,
-            "method": method, "iters": iters, "ntimes": ntimes,
-            "agg_type": agg_type, "proc_node": proc_node,
-            "backend": backend, "chained": bool(chained)}
+               proc_node, backend, chained, measured_phases=False) -> dict:
+    key = {"nprocs": nprocs, "cb_nodes": cb_nodes, "data_size": data_size,
+           "method": method, "iters": iters, "ntimes": ntimes,
+           "agg_type": agg_type, "proc_node": proc_node,
+           "backend": backend, "chained": bool(chained)}
+    if measured_phases:
+        # only stamped when set: older sidecar records (no key) keep
+        # matching their non-measured sweeps exactly
+        key["measured_phases"] = True
+    return key
 
 
 def _completed_throttles(csv_path: str, nprocs: int, cb_nodes: int,
                          data_size: int, method: int, iters: int,
                          ntimes: int, agg_type: int, proc_node: int = 1,
                          backend: str = "local",
-                         chained: bool = False) -> set:
+                         chained: bool = False,
+                         measured_phases: bool = False) -> set:
     """Throttle values already fully recorded for this sweep config.
 
     Primary source: the sweep sidecar (``<results_csv>.sweep.jsonl``, one
     JSON line per completed throttle carrying the FULL run config —
-    including proc_node, backend and chained, which the reference CSV
-    format cannot record; ADVICE r1). When the sidecar exists, only its
-    exact-config matches count. Fallback for pre-sidecar CSVs: every
-    required method name has >= iters rows at that comm size matching the
-    parameters the reference CSV does carry (nprocs, cb_nodes, data_size,
-    ntimes, agg_type) — rows from a sweep differing only in proc_node,
-    backend, or chained are indistinguishable there, which is exactly why
-    the sidecar is written."""
+    including proc_node, backend, chained and measured_phases, which the
+    reference CSV format cannot record; ADVICE r1). When the sidecar
+    exists, only its exact-config matches count. Fallback for pre-sidecar
+    CSVs: every required method name has >= iters rows at that comm size
+    matching the parameters the reference CSV does carry (nprocs,
+    cb_nodes, data_size, ntimes, agg_type) — rows from a sweep differing
+    only in proc_node, backend, chained, or measured_phases are
+    indistinguishable there, which is exactly why the sidecar is
+    written."""
     import csv
     import json
     import os
@@ -359,7 +372,8 @@ def _completed_throttles(csv_path: str, nprocs: int, cb_nodes: int,
     sidecar = _sweep_sidecar(csv_path)
     if os.path.exists(sidecar):
         key = _sweep_key(nprocs, cb_nodes, data_size, method, iters, ntimes,
-                         agg_type, proc_node, backend, chained)
+                         agg_type, proc_node, backend, chained,
+                         measured_phases)
         family = (nprocs, cb_nodes, data_size, ntimes, agg_type)
         family_seen = False
         done = set()
@@ -429,11 +443,38 @@ def _run_sweep(args) -> int:
                                     args.data_size, args.method, args.iters,
                                     args.ntimes, args.agg_type,
                                     args.proc_node, args.backend,
-                                    args.chained)
+                                    args.chained, args.measured_phases)
         skipped = [c for c in grid if c in done]
         grid = [c for c in grid if c not in done]
         if skipped:
             print(f"resume: skipping already-recorded comm sizes {skipped}")
+    if args.measured_phases:
+        # validate the WHOLE grid's round depth before any cell runs — a
+        # mid-grid ValueError after earlier cells recorded rows is the
+        # partial-CSV failure the upfront guards exist to prevent
+        from tpu_aggcomm.core.methods import METHODS, compile_method
+        from tpu_aggcomm.core.pattern import AggregatorPattern
+        from tpu_aggcomm.harness.chained import MAX_MEASURED_ROUNDS
+        ids = ([args.method] if args.method else
+               [m for m in METHODS if METHODS[m].dispatched])
+        for c in grid:
+            for m in ids:
+                if METHODS[m].tam:
+                    continue
+                sched = compile_method(m, AggregatorPattern(
+                    nprocs=nprocs, cb_nodes=args.cb_nodes,
+                    data_size=max(args.data_size, 1),
+                    proc_node=args.proc_node, comm_size=c,
+                    placement=args.agg_type))
+                if sched.collective:
+                    continue
+                depth = len({int(e[4]) for e in sched.data_edges()})
+                if depth > MAX_MEASURED_ROUNDS:
+                    raise SystemExit(
+                        f"--measured-phases: grid cell c={c} method {m} "
+                        f"has {depth} throttle rounds (> "
+                        f"{MAX_MEASURED_ROUNDS}); trim --comm-sizes or "
+                        f"use --chained for the deep cells")
     import json
     for c in grid:
         print(f"RUN_OPTS: -a {args.cb_nodes} -d {args.data_size} -c {c} "
@@ -443,14 +484,15 @@ def _run_sweep(args) -> int:
             data_size=args.data_size, comm_size=c, iters=args.iters,
             ntimes=args.ntimes, proc_node=args.proc_node,
             agg_type=args.agg_type, backend=args.backend, verify=args.verify,
-            results_csv=args.results_csv, chained=args.chained)
+            results_csv=args.results_csv, chained=args.chained,
+            measured_phases=args.measured_phases)
         run_experiment(cfg)
         if args.results_csv:
             # checkpoint: record the completed throttle with its FULL config
             rec = _sweep_key(nprocs, args.cb_nodes, args.data_size,
                              args.method, args.iters, args.ntimes,
                              args.agg_type, args.proc_node, args.backend,
-                             args.chained)
+                             args.chained, args.measured_phases)
             rec["comm"] = c
             with open(_sweep_sidecar(args.results_csv), "a") as f:
                 f.write(json.dumps(rec) + "\n")
