@@ -48,6 +48,12 @@ def stage(name: str, argv: list, env: dict | None = None) -> bool:
 
 
 def main() -> int:
+    # manual capture session: every bench.py invocation in this session
+    # (the dedicated stage AND followup's bench-sanity step) probes
+    # patiently; the driver-facing default stays small so an
+    # end-of-round bench cannot overrun the driver's patience
+    os.environ.setdefault("TPU_AGGCOMM_BENCH_PROBE_WINDOW", "600")
+
     # bounded aliveness probes first (device-list only — safe to kill on
     # timeout, unlike anything that launches kernels): a dead tunnel
     # must produce a clear log line, not a forever-hung capture run; a
