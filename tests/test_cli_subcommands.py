@@ -80,7 +80,8 @@ def test_sweep_measured_phases_rows_and_resume(tmp_path):
     run_cli(base + ["--measured-phases"])
     from tpu_aggcomm.harness.report import provenance_path
     with open(provenance_path(str(csv))) as fh:
-        assert "measured-rounds+attributed(buckets)" in fh.read()
+        # the 2-round cell is unrolled: the full 2-D measurement applies
+        assert "measured-rounds(post,deliver)+attributed(waits)" in fh.read()
     rc, out = run_cli(base + ["--measured-phases", "--resume"])
     assert "resume: skipping already-recorded comm sizes [4]" in out
     # a CHAINED sweep over the same grid is a different experiment

@@ -109,6 +109,17 @@ def _round_tables(schedule: Schedule):
     return rounds, barrier_rounds
 
 
+def _scan_lowered(tabs, barrier_rounds) -> bool:
+    """THE lowering predicate: many-round barrier-light schedules ride
+    one lax.scan, everything else unrolls. ONE definition shared by
+    _one_rep, every truncation builder, and run()'s measured-phases
+    dispatch — the prefix families difference against the full rep, so
+    a drifted copy of this predicate would measure the lowering
+    asymmetry instead of the truncated rounds."""
+    return (len(tabs) >= 32
+            and all(v <= 1 for v in barrier_rounds.values()))
+
+
 def _tam_tables(tam):
     """Static index maps for the single-chip TAM route (the analog of
     collective_write2's hindexed views, l_d_t.c:848-904: datatype tricks
@@ -277,8 +288,7 @@ class JaxSimBackend:
         # row. Barrier rounds fold in as a selected token write; a round
         # with >1 barriers (no current method emits one) keeps the
         # unrolled path.
-        scan_ok = (len(tabs) >= 32
-                   and all(v <= 1 for v in barrier_rounds.values()))
+        scan_ok = _scan_lowered(tabs, barrier_rounds)
         if scan_ok:
             R = len(tabs)
             E = max(len(srcs) for (srcs, _ss, _ds, _dl) in tabs)
@@ -409,8 +419,17 @@ class JaxSimBackend:
             # gather/scatter boundary is the strictly more informative
             # measurement.
             from tpu_aggcomm.harness.attribution import (
-                attribute_measured_split, attribute_tam_hops)
+                attribute_measured_split, attribute_round_splits,
+                attribute_tam_hops)
             from tpu_aggcomm.tam.engine import TamMethod
+            if not (isinstance(schedule, TamMethod)
+                    or schedule.collective):
+                rounds_tab, bars = _round_tables(schedule)
+            if schedule.collective:
+                raise ValueError(
+                    "measured phases need a round-structured schedule "
+                    "(TAM's 3-hop decomposition is measured by "
+                    "measure_tam_hops; the dense collectives have none)")
             if isinstance(schedule, TamMethod):
                 hops = self.measure_tam_hops(schedule)
                 rep_attr = attribute_tam_hops(
@@ -421,7 +440,21 @@ class JaxSimBackend:
                 self.last_round_times = [
                     [hops["p2"], hops["p3"], hops["p4"]]
                     for _ in range(ntimes)]
+            elif (len(rounds_tab) >= 2
+                  and not _scan_lowered(rounds_tab, bars)):
+                # unrolled multi-round: the FULL 2-D measurement — per
+                # round, post AND deliver windows measured
+                splits = self.measure_round_splits(schedule)
+                rep_attr = attribute_round_splits(schedule, splits,
+                                                  weights=attr_w)
+                self.last_provenance = (
+                    "jax_sim",
+                    "measured-rounds(post,deliver)+attributed(waits)")
+                self.last_round_times = [
+                    [p_ + d_ for (p_, d_) in splits.values()]
+                    for _ in range(ntimes)]
             elif len(rt := self.measure_round_times(schedule)) >= 2:
+                # deep scan-lowered schedules: per-round totals measured
                 rep_attr = attribute_rounds(schedule, rt, weights=attr_w)
                 self.last_provenance = (
                     "jax_sim", "measured-rounds+attributed(buckets)")
@@ -590,8 +623,7 @@ class JaxSimBackend:
         # schedules, unrolled otherwise): differencing a scan-lowered
         # full rep against an unrolled truncation would measure the
         # lowering asymmetry, not the removed gathers
-        scan_ok = (len(tabs) >= 32
-                   and all(v <= 1 for v in barrier_rounds.values()))
+        scan_ok = _scan_lowered(tabs, barrier_rounds)
         if scan_ok:
             R = len(tabs)
             E = max(len(srcs) for (srcs, _ss, _ds, _dl) in tabs)
@@ -650,6 +682,151 @@ class JaxSimBackend:
             return recv
 
         return rep
+
+    def _one_rep_hybrid(self, schedule, upto: int):
+        """Rounds 0..upto-2 at FULL fidelity, then round upto-1 with its
+        per-edge gather replaced by the broadcast-row scatter (the
+        _one_rep_scatters truncation applied to ONE round): the prefix
+        family ``measure_round_splits`` differences against the full
+        prefixes to separate round k's preparation (gather) side from
+        its delivery side. Unrolled lowering only — prefixes must share
+        the full rep's lowering, and a scan body cannot swap gather for
+        broadcast per iteration without computing both (jnp.where) or
+        adding branch structure the full rep lacks (lax.cond)."""
+        from tpu_aggcomm.tam.engine import TamMethod
+
+        if isinstance(schedule, TamMethod) or schedule.collective:
+            raise ValueError(
+                "round splits need a round-structured schedule "
+                "(TAM's 3-hop decomposition is measured by "
+                "measure_tam_hops; the dense collectives have none)")
+        p = schedule.pattern
+        n = p.nprocs
+        _, n_recv_slots = self._slots(p)
+        _, jdt, w = self._words(p)
+        rounds, barrier_rounds = _round_tables(schedule)
+        tabs = [(srcs, ss, dsts, ds_)
+                for (_r, srcs, ss, dsts, ds_) in rounds]
+        round_ids = [r for (r, *_rest) in rounds]
+        scan_ok = _scan_lowered(tabs, barrier_rounds)
+        if scan_ok:
+            raise ValueError(
+                "round splits need the unrolled lowering (< 32 rounds); "
+                "deep scan-lowered schedules have measure_round_times")
+        if not 1 <= upto <= len(tabs):
+            raise ValueError(f"upto must be in [1, {len(tabs)}]")
+
+        def rep(send):
+            recv = jnp.zeros((n, n_recv_slots + 1, w), dtype=jdt)
+            for k in range(upto):
+                srcs, ss, dsts, ds_ = tabs[k]
+                nbar = barrier_rounds.get(round_ids[k], 0)
+                if k == upto - 1:
+                    # the split round: delivery only (broadcast one
+                    # gathered row; barriers stay — they are deliver-side)
+                    one = send[int(srcs[0]), int(ss[0])]
+                    vals = jnp.broadcast_to(one, (len(srcs), w))
+                    recv = recv.at[jnp.asarray(dsts),
+                                   jnp.asarray(ds_)].set(vals)
+                    for _ in range(nbar):
+                        tok = jnp.sum(recv[:, :n_recv_slots, 0]
+                                      .astype(jnp.int32))
+                        recv = recv.at[:, n_recv_slots, 0].set(
+                            tok.astype(jdt))
+                else:
+                    recv = _apply_round(send, recv, srcs, ss, dsts, ds_,
+                                        nbar, n_recv_slots, jdt)
+                if k + 1 < upto:
+                    send, recv = lax.optimization_barrier((send, recv))
+            return recv
+
+        return rep
+
+    def measure_round_splits(self, schedule, *, iters_small: int = 50,
+                             iters_big: int = 1050, trials: int = 3,
+                             windows: int = 3,
+                             max_rounds: int = MAX_MEASURED_ROUNDS
+                             ) -> dict:
+        """MEASURED 2-D decomposition: per round k, BOTH the preparation
+        (gather) side and the delivery side, by differencing three prefix
+        families through the shared chain scaffold:
+
+        - P_k  — rounds 0..k-1 at full fidelity (``_one_rep(upto=k)``);
+        - S_k  — rounds 0..k-2 full + round k-1 delivery-only
+          (``_one_rep_hybrid``);
+        - round k's deliver ≈ S_{k+1} - P_k, post ≈ P_{k+1} - S_{k+1}.
+
+        Increments are clamped and rescaled so all posts + delivers sum
+        EXACTLY to the full-rep chain time; within each round the
+        post/deliver ratio comes from the raw differenced pair. Returns
+        ``{round id: (post_seconds, deliver_seconds)}``. This makes the
+        reference's per-round bracket structure (mpi_test.c:1768-1815)
+        fully measured up to wait-bucket mixing WITHIN a round's deliver
+        window — the residual attribution the provenance label names.
+        Unrolled lowering only (< 32 rounds); cost is 2R-1 chain
+        families. Cached per schedule."""
+        from tpu_aggcomm.tam.engine import TamMethod
+        if isinstance(schedule, TamMethod) or schedule.collective:
+            raise ValueError(
+                "round splits need a round-structured schedule "
+                "(TAM's 3-hop decomposition is measured by "
+                "measure_tam_hops; the dense collectives have none)")
+        rounds, bars = _round_tables(schedule)
+        round_ids = [r for (r, *_rest) in rounds]
+        R = len(round_ids)
+        if _scan_lowered(rounds, bars):
+            raise ValueError(
+                "round splits need the unrolled lowering (< 32 rounds); "
+                "deep scan-lowered schedules have measure_round_times")
+        if R > max_rounds:
+            raise ValueError(
+                f"{R} rounds exceeds max_rounds={max_rounds} (two chain "
+                f"families are compiled per round); use profile_rounds "
+                f"for very deep schedules")
+        key = (self._key(schedule), "round_splits", iters_small, iters_big,
+               trials, windows)
+        if key in self._chain_cache:
+            return self._chain_cache[key]
+        per_full = self.measure_per_rep(schedule, iters_small=iters_small,
+                                        iters_big=iters_big, trials=trials,
+                                        windows=windows)
+        p = schedule.pattern
+        send0 = jax.device_put(self._global_send(p, 0), self._dev())
+
+        def timed(rep_fn):
+            return differenced_per_rep(
+                self._chain_factory(rep_fn, p), send0,
+                iters_small=iters_small, iters_big=iters_big,
+                trials=trials, windows=windows)
+
+        memo = self._prefix_memo(schedule, iters_small, iters_big,
+                                 trials, windows)
+        P = [0.0]
+        for k in range(1, R):
+            if k not in memo:
+                memo[k] = timed(self._one_rep(schedule, upto=k))
+            P.append(memo[k])
+        P.append(per_full)
+        S = [timed(self._one_rep_hybrid(schedule, k))
+             for k in range(1, R + 1)]
+
+        inc = np.maximum(np.diff(np.asarray(P)), 0.0)
+        s = float(inc.sum())
+        inc = inc * (per_full / s) if s > 0 else np.full(R, per_full / R)
+        out = {}
+        for k in range(R):
+            post_raw = max(P[k + 1] - S[k], 0.0)
+            del_raw = max(S[k] - P[k], 0.0)
+            tot_raw = post_raw + del_raw
+            # the raw pair sets the WITHIN-round ratio; the rescaled
+            # increment sets the round's total (additivity contract).
+            # tot_raw == 0 (pure noise) -> all deliver: a round's scatter
+            # exists by construction, its gather may be arbitrarily cheap
+            frac_post = post_raw / tot_raw if tot_raw > 0 else 0.0
+            out[round_ids[k]] = (float(inc[k] * frac_post),
+                                 float(inc[k] * (1.0 - frac_post)))
+        self._chain_cache[key] = out
+        return out
 
     def _tam_rep(self, tam, upto_hop: int | None = None):
         """THE TAM lowering: three fenced gather hops over the staged
@@ -878,9 +1055,18 @@ class JaxSimBackend:
             lambda k: self._chain_factory(self._one_rep(schedule, upto=k),
                                           p),
             send0, round_ids, per_full, iters_small=iters_small,
-            iters_big=iters_big, trials=trials, windows=windows)
+            iters_big=iters_big, trials=trials, windows=windows,
+            memo=self._prefix_memo(schedule, iters_small, iters_big,
+                                   trials, windows))
         self._chain_cache[key] = out
         return out
+
+    def _prefix_memo(self, schedule, *timing_key) -> dict:
+        """Per-(schedule, timing-params) memo of measured P-prefix chain
+        times, shared by measure_round_times and measure_round_splits so
+        the identical prefix families are compiled and timed once."""
+        return self._chain_cache.setdefault(
+            (self._key(schedule), "prefix_memo", *timing_key), {})
 
     def measure_per_rep(self, schedule, *, iters_small: int = 50,
                         iters_big: int = 1050, trials: int = 3,

@@ -58,9 +58,9 @@ from tpu_aggcomm.core.schedule import OpKind, Schedule, TimerBucket
 from tpu_aggcomm.harness.timer import Timer
 
 __all__ = ["POST_COST_BYTES", "attribute_total", "attribute_rounds",
-           "attribute_measured_split", "rank_round_weights",
-           "tam_rank_weights", "attribute_tam_total", "attribute_tam_hops",
-           "weights_for"]
+           "attribute_round_splits", "attribute_measured_split",
+           "rank_round_weights", "tam_rank_weights", "attribute_tam_total",
+           "attribute_tam_hops", "weights_for"]
 
 #: Per-call overhead of posting one nonblocking op / one pure-sync wait /
 #: one barrier, expressed in byte-equivalents of transfer time. See module
@@ -199,6 +199,44 @@ def attribute_measured_split(schedule, post_seconds: float,
                 t.add(bucket, rest * w / wsum)
         elif post_w > 0:
             t.add(TimerBucket.POST, rest)   # post-only rank
+        timers.append(t)
+    return timers
+
+
+def attribute_round_splits(schedule, splits: dict[int, tuple],
+                           weights=None) -> list[Timer]:
+    """Per-rank timers from a MEASURED 2-D decomposition
+    (jax_sim.measure_round_splits): per round, both the preparation
+    (post) and delivery windows are measurements; only the distribution
+    of a round's delivery window among a rank's wait/barrier buckets is
+    structural. Per rank per round: the post window lands on POST if the
+    rank posts in that round (everyone shares wall windows on a fused
+    program — non-posting ranks spend it waiting, so it joins their
+    deliver share); the deliver share splits over the round's wait
+    buckets by weight, preserving the RECV_AND_SEND_WAIT both-columns
+    convention."""
+    total = float(sum(p + d for p, d in splits.values()))
+    timers = []
+    for acc in (weights if weights is not None
+                else rank_round_weights(schedule)):
+        t = Timer(total_time=total)
+        for rnd, (post, deliver) in splits.items():
+            sel = {bucket: w for (r, bucket), w in acc.items() if r == rnd}
+            if not sel:
+                continue                    # idle round for this rank
+            post_w = sel.get(TimerBucket.POST, 0.0)
+            waits = {b: w for b, w in sel.items()
+                     if b is not TimerBucket.POST}
+            p_r = post if post_w > 0 else 0.0
+            if p_r:
+                t.add(TimerBucket.POST, p_r)
+            rest = (post - p_r) + deliver
+            wsum = sum(waits.values())
+            if wsum > 0:
+                for bucket, w in waits.items():
+                    t.add(bucket, rest * w / wsum)
+            elif post_w > 0:
+                t.add(TimerBucket.POST, rest)   # post-only round
         timers.append(t)
     return timers
 

@@ -107,7 +107,8 @@ def differenced_per_rep(chain_factory, send0, *, iters_small: int,
 def differenced_round_times(make_prefix_chain, send0, round_ids,
                             per_full: float, *, iters_small: int,
                             iters_big: int, trials: int = 3,
-                            windows: int = 3) -> dict:
+                            windows: int = 3, memo: dict | None = None
+                            ) -> dict:
     """Shared tail of ``measure_round_times`` (jax_sim AND jax_shard —
     one definition, so the additivity contract the tests pin cannot
     drift between tiers): difference the round-prefix chains.
@@ -118,7 +119,13 @@ def differenced_round_times(make_prefix_chain, send0, round_ids,
     duration is the increment between consecutive prefix times; noise
     handling clamps increments at 0 and rescales so they sum EXACTLY to
     ``per_full`` (the uniform fallback covers the degenerate all-zero
-    case). Returns ``{round id: seconds}`` in program order."""
+    case). Returns ``{round id: seconds}`` in program order.
+
+    ``memo`` (a caller-held dict, prefix index -> differenced seconds)
+    shares the expensive per-prefix measurements with other consumers of
+    the same prefix family (jax_sim's measure_round_splits times the
+    identical P prefixes) — each prefix chain is compiled and timed at
+    most once per schedule."""
     import numpy as np
 
     R = len(round_ids)
@@ -126,9 +133,15 @@ def differenced_round_times(make_prefix_chain, send0, round_ids,
         return {round_ids[0]: per_full}
     bounds = []
     for k in range(1, R):
-        bounds.append(differenced_per_rep(
+        if memo is not None and k in memo:
+            bounds.append(memo[k])
+            continue
+        t = differenced_per_rep(
             make_prefix_chain(k), send0, iters_small=iters_small,
-            iters_big=iters_big, trials=trials, windows=windows))
+            iters_big=iters_big, trials=trials, windows=windows)
+        if memo is not None:
+            memo[k] = t
+        bounds.append(t)
     bounds.append(per_full)
     inc = np.maximum(np.diff(np.asarray([0.0] + bounds)), 0.0)
     s = float(inc.sum())

@@ -119,15 +119,24 @@ def _data_rows(filename: str) -> int:
 #: measured — a sidecar reader can never over-read a row as fully
 #: measured when only a boundary was.
 #:   measured            direct per-op host timing (native)
+#:   measured-rounds(post,deliver)+attributed(waits)
+#:                       the FULL 2-D measurement (jax_sim
+#:                       measure_round_splits, unrolled schedules): per
+#:                       round, BOTH the preparation window and the
+#:                       delivery window are chained-truncation
+#:                       measurements; only the mixing of a round's
+#:                       delivery window among a rank's wait buckets is
+#:                       structural
 #:   measured-rounds+attributed(buckets)
 #:                       per-round durations MEASURED by chained round-
-#:                       prefix truncation differencing (jax_sim/jax_shard
-#:                       measure_round_times, zero dispatch-sync); within
-#:                       each round, the measured time is distributed
-#:                       among the buckets charged in that round by op
-#:                       weights (rounds whose charges are a single
-#:                       bucket — e.g. m=2's per-round send Waitalls —
-#:                       are therefore fully measured columns)
+#:                       prefix truncation differencing
+#:                       (measure_round_times on jax_sim/jax_shard/
+#:                       jax_ici, zero dispatch-sync); within each
+#:                       round, the measured time is distributed among
+#:                       the buckets charged in that round by op weights
+#:                       (rounds whose charges are a single bucket —
+#:                       e.g. m=2's per-round send Waitalls — are
+#:                       therefore fully measured columns)
 #:   measured-hops(P2,P3,P4)+attributed(ranks)
 #:                       TAM's 3-hop relay durations MEASURED by chained
 #:                       hop-prefix truncation differencing (jax_sim
@@ -147,6 +156,7 @@ def _data_rows(filename: str) -> int:
 #:                       round (--profile-rounds; host sync per round)
 #:   attributed-chained  differenced serial-chain total, then attributed
 PHASE_SOURCES = ("measured",
+                 "measured-rounds(post,deliver)+attributed(waits)",
                  "measured-rounds+attributed(buckets)",
                  "measured-hops(P2,P3,P4)+attributed(ranks)",
                  "measured-split(post,deliver)+attributed(waits)",
