@@ -118,12 +118,17 @@ def main() -> int:
               f"measured_post_share={s['post'] / s['total']:.3f} "
               f"model_share={pw / tw:.3f}", flush=True)
 
-    # 6. measured per-round times (prefix truncation, zero dispatch sync)
-    # next to stage 3's dispatch-timed rounds; plus the TAM 3-hop split
+    # 6. measured per-round times + the FULL 2-D (round x post/deliver)
+    # decomposition (prefix truncation, zero dispatch sync) next to
+    # stage 3's dispatch-timed rounds; plus the TAM 3-hop split
     rt = b3.measure_round_times(compile_method(1, p3))
     print(f"measured rounds -m 1 -c 3: per-round us = "
           f"{[round(t * 1e6, 1) for t in rt.values()]} "
           f"(sum {sum(rt.values()) * 1e6:.1f}us)", flush=True)
+    sp = b3.measure_round_splits(compile_method(1, p3))
+    print(f"measured 2-D    -m 1 -c 3: (post, deliver) us per round = "
+          f"{[(round(a * 1e6, 1), round(b * 1e6, 1)) for a, b in sp.values()]}",
+          flush=True)
     p_tam = AggregatorPattern(nprocs=32, cb_nodes=14, data_size=2048,
                               comm_size=3, proc_node=4)
     from tpu_aggcomm.harness.roofline import tam_rep_bytes
