@@ -134,6 +134,36 @@ def test_round_splits_2d_decomposition(backend):
     assert sum(d for (_p, d) in splits.values()) > 0
 
 
+def test_prefix_measurements_shared_between_apis(monkeypatch):
+    """measure_round_times and measure_round_splits time the identical
+    P-prefix families — the memo must make each prefix chain measured
+    exactly once per schedule (the efficiency contract that matters at
+    60-90 ms per tunneled dispatch)."""
+    import tpu_aggcomm.backends.jax_sim as sim_mod
+    import tpu_aggcomm.harness.chained as chained_mod
+
+    calls = {"n": 0}
+    real = chained_mod.differenced_per_rep
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    # both binding sites: the chained module's own name (used by
+    # differenced_round_times) and jax_sim's module-level import
+    monkeypatch.setattr(chained_mod, "differenced_per_rep", counting)
+    monkeypatch.setattr(sim_mod, "differenced_per_rep", counting)
+    b = JaxSimBackend()                    # fresh caches
+    sched = compile_method(1, AggregatorPattern(
+        nprocs=8, cb_nodes=3, data_size=64, comm_size=4))   # 2 rounds
+    b.measure_round_times(sched)
+    after_rt = calls["n"]                  # per_rep + (R-1) prefixes = 2
+    assert after_rt == 2
+    b.measure_round_splits(sched)
+    # splits adds ONLY the R hybrid prefixes (P family + per_rep reused)
+    assert calls["n"] - after_rt == 2
+
+
 def test_round_splits_guards(backend):
     # scan-lowered deep schedules: measure_round_times only
     deep = compile_method(1, AggregatorPattern(
