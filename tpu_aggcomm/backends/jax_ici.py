@@ -44,7 +44,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_aggcomm.backends.lanes import lane_layout, lanes_to_bytes, to_lanes
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
-from tpu_aggcomm.core.schedule import OpKind, Schedule
+from tpu_aggcomm.core.schedule import Schedule
 from tpu_aggcomm.harness.attribution import (attribute_rounds,
                                              attribute_tam_total,
                                              attribute_total, weights_for)

@@ -20,7 +20,6 @@ import tempfile
 
 import numpy as np
 
-from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import OpKind, Schedule, TimerBucket
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs

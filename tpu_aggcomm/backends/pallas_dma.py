@@ -50,7 +50,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import Schedule
 from tpu_aggcomm.harness.attribution import attribute_total, weights_for
 from tpu_aggcomm.harness.timer import Timer

@@ -30,7 +30,7 @@ separate channel — the dup'ed signal_comm of mpi_test.c:1252).
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 

@@ -4,14 +4,14 @@ console/CSV reporting, optional verification."""
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tpu_aggcomm.backends import get_backend
 from tpu_aggcomm.core.methods import METHODS, compile_method, method_ids
 from tpu_aggcomm.core.pattern import AggregatorPattern
 from tpu_aggcomm.harness.report import (append_provenance, config_banner,
                                         save_all_timing, summarize_results)
-from tpu_aggcomm.harness.timer import Timer, max_reduce
+from tpu_aggcomm.harness.timer import max_reduce
 
 __all__ = ["ExperimentConfig", "run_experiment"]
 

@@ -13,7 +13,7 @@ whole-program — see backends/jax_ici.py for how phases are attributed).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 

@@ -37,7 +37,7 @@ is its ``co=1`` special case.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, replace as _replace
+from dataclasses import dataclass
 
 import numpy as np
 
