@@ -312,7 +312,36 @@ def supervise() -> int:
     return 1
 
 
+def check_regression() -> int:
+    """``--check-regression`` mode: validate the BENCH_r*/MULTICHIP_r*
+    history and compare the newest round's headline against the best prior
+    same-(metric, platform) round (tpu_aggcomm/obs/regress.py). Prints
+    exactly ONE JSON verdict line on stdout (detail on stderr), jax-free,
+    exit 0 iff no regression and no schema errors."""
+    from tpu_aggcomm.obs.regress import check_regression as _check
+
+    verdict = _check(os.path.dirname(os.path.abspath(__file__)) or ".")
+    for err in verdict["schema_errors"]:
+        print(f"# schema: {err}", file=sys.stderr)
+    for row in verdict["history"]:
+        print(f"# r{row['round']:02d}: {row['value']:.6g} {row['unit']} "
+              f"[{row['platform']}]", file=sys.stderr)
+    if verdict["delta_pct"] is not None:
+        print(f"# delta vs best prior comparable round: "
+              f"{verdict['delta_pct']:+.1f}% "
+              f"(tolerance {verdict['tolerance_pct']:.0f}%)",
+              file=sys.stderr)
+    # the one-JSON-line stdout contract holds in this mode too; the full
+    # per-round history stays on stderr
+    slim = {k: v for k, v in verdict.items() if k != "history"}
+    slim["schema_errors"] = len(verdict["schema_errors"])
+    print(json.dumps(slim))
+    return 0 if verdict["ok"] else 1
+
+
 def main() -> int:
+    if "--check-regression" in sys.argv:
+        return check_regression()
     if "--measure" in sys.argv:
         return measure()
     if "--probe" in sys.argv:

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Validate every BENCH_r*.json / MULTICHIP_r*.json bench-history artifact
+against the shared schema (tpu_aggcomm/obs/regress.py — the same
+definitions ``bench.py --check-regression`` consumes).
+
+Usage: ``python scripts/check_bench_schema.py [root]`` (default: repo
+root). Prints one line per artifact, exits nonzero if any artifact is
+invalid or the history is empty. jax-free; wired into the test suite via
+tests/test_obs.py.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_aggcomm.obs.regress import (load_history, validate_bench,
+                                     validate_multichip)
+
+
+def check(root: str) -> int:
+    n_files = 0
+    n_errors = 0
+    for kind, validate in (("BENCH", validate_bench),
+                           ("MULTICHIP", validate_multichip)):
+        for rnd, path, blob in load_history(root, kind):
+            n_files += 1
+            errors = validate(blob, os.path.basename(path))
+            if errors:
+                n_errors += len(errors)
+                for e in errors:
+                    print(f"FAIL {e}")
+            else:
+                print(f"ok   {os.path.basename(path)}")
+    if n_files == 0:
+        print(f"FAIL no BENCH_r*/MULTICHIP_r*.json found under {root}")
+        return 1
+    print(f"{n_files} artifact(s), {n_errors} schema error(s)")
+    return 1 if n_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(check(sys.argv[1] if len(sys.argv) > 1 else
+                   os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))

@@ -111,6 +111,22 @@ def main() -> int:
         record("flagship",
                stage("flagship",
                      [sys.executable, "scripts/tpu_flagship.py"]))
+        if os.environ.get("TPU_AGGCOMM_TRACE"):
+            # opt-in flight-recorder stage (TPU_AGGCOMM_TRACE=1): one
+            # traced chained jax_sim run + a traced sweep pass, leaving
+            # traces/*.trace.{jsonl,json} artifacts. Default capture
+            # behavior is unchanged — this stage simply does not run.
+            os.makedirs(os.path.join(REPO, "traces"), exist_ok=True)
+            record("traced-run",
+                   stage("traced-run",
+                         [sys.executable, "-m", "tpu_aggcomm.cli",
+                          "-n", "32", "-a", "14", "-d", "2048", "-c", "8",
+                          "-m", "1", "-k", "4", "--backend", "jax_sim",
+                          "--chained",
+                          "--trace", "traces/capture_n32_m1_c8"]))
+            record("traced-sweeps",
+                   stage("traced-sweeps",
+                         [sys.executable, "scripts/tpu_sweeps.py"]))
     else:
         # gated tests and the followup batch ALSO launch kernels — the
         # compile-before-any-kernel invariant gates everything

@@ -13,11 +13,37 @@ numbers 2-7x (CLAUDE.md). Cells print as they finish, so a killed run
 still yields its completed cells from the log.
 """
 
+import contextlib
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@contextlib.contextmanager
+def _cell_trace(tag: str):
+    """Per-cell flight-recorder artifact, opt-in via TPU_AGGCOMM_TRACE=1.
+
+    Default behavior is byte-identical (tracing stays disabled — zero-cost
+    no-op spans). When armed, each grid cell flushes
+    ``traces/<tag>.trace.{jsonl,json}``; the trace carries the backend's
+    host dispatch spans plus the differencing evidence instants
+    (``chained.trial``), not reconstructed rounds — the direct
+    ``backend.run`` path here bypasses the runner's cell capture."""
+    if not os.environ.get("TPU_AGGCOMM_TRACE"):
+        yield
+        return
+    from tpu_aggcomm.obs import trace
+    os.makedirs("traces", exist_ok=True)
+    trace.enable()
+    try:
+        yield
+    finally:
+        paths = trace.flush(os.path.join("traces", tag))
+        trace.disable()
+        if paths:
+            print(f"    trace: {paths[0]}", flush=True)
 
 
 GRIDS = [
@@ -50,8 +76,9 @@ def main() -> int:
                                       comm_size=c)
                 sched = compile_method(m, p)
                 t0 = time.perf_counter()
-                recv, timers = backend.run(sched, ntimes=1, verify=True,
-                                           chained=True)
+                with _cell_trace(f"sweep_n{n}_m{m}_c{c}"):
+                    recv, timers = backend.run(sched, ntimes=1, verify=True,
+                                               chained=True)
                 per_rep = timers[0].total_time
                 row.append((c, per_rep))
                 key = (n, m)

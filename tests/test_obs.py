@@ -1,0 +1,285 @@
+"""Flight-recorder (tpu_aggcomm/obs) guarantees:
+
+- zero-cost when disabled: no-op spans, no recorder, no jax import from
+  the obs package (bench.py's jax-free supervisor imports obs.regress);
+- overhead guard: a traced local run produces structurally byte-identical
+  results.csv rows (every non-timing column) and timer values within
+  tolerance of the untraced run;
+- round trip: the JSONL event log of a multi-round ``-c``-throttled run
+  re-aggregates to the Timer's phase columns FLOAT-EXACTLY (the trace
+  records the attribution's exact Timer.add arithmetic in order —
+  harness/attribution.py cell sinks), with a column-accurate
+  PHASE_SOURCES label on every reconstructed slice;
+- the Perfetto export is valid JSON with monotonically non-decreasing
+  ``ts`` per (pid, tid) track;
+- the bench-history schema (obs/regress.py + scripts/check_bench_schema.py)
+  accepts every committed BENCH_r*/MULTICHIP_r*.json and rejects
+  malformed artifacts; regression verdicts compare only same-(metric,
+  platform) rounds.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_aggcomm.harness.report import PHASE_SOURCES
+from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+from tpu_aggcomm.obs import trace
+from tpu_aggcomm.obs.perfetto import RANKS_PID, to_chrome_trace
+from tpu_aggcomm.obs.regress import (check_regression, validate_bench,
+                                     validate_multichip)
+from tpu_aggcomm.obs.trace import aggregate_run, load_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _timer_cols(t):
+    return {"post": t.post_request_time, "send_wait": t.send_wait_all_time,
+            "recv_wait": t.recv_wait_all_time, "barrier": t.barrier_time,
+            "total": t.total_time}
+
+
+def _run(backend, *, tmp_path=None, csv_name="results.csv", traced=False,
+         prefix=None, **kw):
+    cfg = ExperimentConfig(
+        nprocs=8, cb_nodes=2, data_size=64, comm_size=2, method=1,
+        ntimes=3, backend=backend, verify=True,
+        results_csv=str(tmp_path / csv_name) if tmp_path else None, **kw)
+    if traced:
+        trace.enable()
+        try:
+            recs = run_experiment(cfg, out=io.StringIO())
+        finally:
+            paths = trace.flush(prefix)
+            trace.disable()
+        return recs, paths
+    return run_experiment(cfg, out=io.StringIO()), None
+
+
+# ---------------------------------------------------------------- disabled
+
+def test_disabled_tracing_is_noop():
+    assert trace.current() is None
+    s1 = trace.span("anything", rank=3)
+    s2 = trace.span("else")
+    assert s1 is s2          # shared no-op singleton — zero allocation
+    with s1:
+        pass
+    trace.instant("nothing")  # must not raise
+    assert trace.flush("/nonexistent/prefix") is None
+
+
+def test_obs_package_imports_no_jax():
+    """bench.py's supervisor process is deliberately jax-free (a dead
+    tunnel hangs ``import jax``); obs must stay importable there."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import tpu_aggcomm.obs, tpu_aggcomm.obs.regress, sys; "
+         "assert 'jax' not in sys.modules, 'obs imported jax'"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+
+
+# ----------------------------------------------------------- overhead guard
+
+def test_overhead_guard_local(tmp_path):
+    """Satellite 2: tracing must not change WHAT the local oracle computes
+    (verify=True pins recv bytes both times) nor the CSV row structure —
+    every non-timing column byte-identical — and the traced timers must
+    stay within a generous same-order-of-magnitude tolerance (the 1-core
+    build host jitters; this guards against pathological overhead, not
+    percent-level noise)."""
+    recs_u, _ = _run("local", tmp_path=tmp_path, csv_name="untraced.csv")
+    recs_t, paths = _run("local", tmp_path=tmp_path, csv_name="traced.csv",
+                         traced=True, prefix=str(tmp_path / "tr"))
+    assert paths is not None and os.path.exists(paths[0])
+
+    rows_u = (tmp_path / "untraced.csv").read_text().splitlines()
+    rows_t = (tmp_path / "traced.csv").read_text().splitlines()
+    assert len(rows_u) == len(rows_t)
+    for ru, rt in zip(rows_u, rows_t):
+        # first 7 CSV columns are method/config (report.py): byte-identical
+        assert ru.split(",")[:7] == rt.split(",")[:7]
+    tu = recs_u[0]["timer0"].total_time
+    tt = recs_t[0]["timer0"].total_time
+    assert tt <= tu * 10 + 1e-2, (
+        f"traced local run pathologically slower: {tt:.6f}s vs {tu:.6f}s")
+    # provenance must be untouched by tracing
+    assert recs_u[0]["phase_source"] == recs_t[0]["phase_source"]
+
+
+# ---------------------------------------------------------------- round trip
+
+@pytest.mark.parametrize("backend", ["local", "jax_sim"])
+def test_roundtrip_exact(tmp_path, backend):
+    """Satellite 3: the JSONL events of a multi-round throttled run
+    re-aggregate to the Timer's phase columns float-exactly, for every
+    rank — total-only rep timers (local) and attributed cells (jax_sim)
+    both replay the exact accumulation arithmetic."""
+    recs, paths = _run(backend, traced=True,
+                       prefix=str(tmp_path / backend))
+    events = load_events(paths[0])
+    agg = aggregate_run(events, 0)
+    assert set(agg) == set(range(8))
+    exp = _timer_cols(recs[0]["timer0"])
+    assert agg[0] == exp, f"rank 0 re-aggregation differs: {agg[0]} != {exp}"
+    # the max-over-ranks reduction must also be reproducible from events
+    max_total = max(a["total"] for a in agg.values())
+    assert max_total == recs[0]["max_timer"].total_time
+
+
+def test_roundtrip_exact_measured_phases(tmp_path):
+    """The measured-rounds path (combine mode "scale": rep-0 columns ×
+    ntimes, mirroring Timer.from_array(as_array() * ntimes)) round-trips
+    exactly too."""
+    recs, paths = _run("jax_sim", traced=True, measured_phases=True,
+                       prefix=str(tmp_path / "mp"))
+    agg = aggregate_run(load_events(paths[0]), 0)
+    assert agg[0] == _timer_cols(recs[0]["timer0"])
+    assert recs[0]["phase_source"] in PHASE_SOURCES
+
+
+def test_perfetto_valid_and_monotone(tmp_path):
+    """Satellite 3b: the Perfetto file is valid JSON; within every
+    (pid, tid) track, ts never decreases; every reconstructed slice
+    carries a column-accurate PHASE_SOURCES label."""
+    _recs, paths = _run("jax_sim", traced=True,
+                        prefix=str(tmp_path / "pf"))
+    with open(paths[1]) as fh:
+        pf = json.load(fh)
+    evs = pf["traceEvents"]
+    assert evs, "empty Perfetto export"
+    last = {}
+    for e in evs:
+        if e.get("ph") not in ("X", "i", "C"):
+            continue
+        key = (e["pid"], e["tid"])
+        assert e["ts"] >= last.get(key, float("-inf")), (
+            f"ts regressed on track {key}")
+        last[key] = e["ts"]
+    slices = [e for e in evs
+              if e.get("ph") == "X" and e["pid"] == RANKS_PID]
+    assert slices, "no reconstructed rank slices"
+    for e in slices:
+        assert e["args"]["phase_source"] in PHASE_SOURCES
+    # one counter track with bytes-in-flight samples
+    counters = [e for e in evs if e.get("ph") == "C"]
+    assert counters and all("bytes" in e["args"] for e in counters)
+
+
+def test_perfetto_rank_tracks(tmp_path):
+    """One thread-name metadata entry per logical rank."""
+    _recs, paths = _run("jax_sim", traced=True,
+                        prefix=str(tmp_path / "tk"))
+    pf = to_chrome_trace(load_events(paths[0]))
+    names = {e["args"]["name"] for e in pf["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"
+             and e["pid"] == RANKS_PID}
+    assert {f"rank {r}" for r in range(8)} <= names
+
+
+def test_cli_inspect_trace(tmp_path, capsys):
+    from tpu_aggcomm.cli import main
+
+    _recs, paths = _run("jax_sim", traced=True,
+                        prefix=str(tmp_path / "ci"))
+    rc = main(["inspect", "trace", paths[0]])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "run 0:" in out and "rounds" in out
+
+
+def test_cli_trace_flag_writes_artifacts(tmp_path):
+    from tpu_aggcomm.cli import main
+
+    prefix = str(tmp_path / "cli_tr")
+    rc = main(["-n", "8", "-a", "2", "-d", "64", "-c", "2", "-m", "1",
+               "--backend", "local", "--verify",
+               "--results-csv", str(tmp_path / "r.csv"),
+               "--trace", prefix])
+    assert rc == 0
+    assert os.path.exists(prefix + ".trace.jsonl")
+    assert os.path.exists(prefix + ".trace.json")
+    assert trace.current() is None   # CLI must disable tracing on exit
+
+
+# ------------------------------------------------------- bench history tools
+
+def test_committed_bench_history_validates():
+    """Satellite 5 wiring: every committed artifact passes the shared
+    schema; the checker script agrees."""
+    r = subprocess.run(
+        [sys.executable, os.path.join("scripts", "check_bench_schema.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 schema error(s)" in r.stdout
+
+
+def test_check_bench_schema_rejects_malformed(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"n": "not-an-int", "cmd": "x", "rc": 0}))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_bench_schema.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "FAIL" in r.stdout
+
+
+def test_validate_bench_schema_units():
+    good = {"n": 32, "cmd": "python bench.py", "rc": 0, "tail": "",
+            "parsed": {"metric": "m", "value": 1e-6, "unit": "s"}}
+    assert validate_bench(good) == []
+    assert validate_bench({"n": 32}) != []
+    bad = dict(good, parsed=dict(good["parsed"], value="fast"))
+    assert any("value" in e for e in validate_bench(bad))
+    assert validate_multichip({"n_devices": 8, "rc": 0, "ok": True,
+                               "skipped": False, "tail": ""}) == []
+    assert validate_multichip({"rc": 0}) != []
+
+
+def _bench_blob(rnd, value, platform):
+    return {"n": 32, "cmd": "bench", "rc": 0, "tail": "",
+            "parsed": {"metric": "m", "value": value, "unit": "s",
+                       "platform": platform}}
+
+
+def test_check_regression_same_platform_only(tmp_path):
+    """A slower CPU-fallback round after a fast TPU round is NOT a
+    regression (no comparable prior); a same-platform 2x slowdown is."""
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps(_bench_blob(1, 2e-6, "tpu")))
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps(_bench_blob(2, 6e-5, "cpu")))
+    v = check_regression(str(tmp_path))
+    assert v["ok"] and v["delta_pct"] is None
+
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps(_bench_blob(3, 1.2e-4, "cpu")))
+    v = check_regression(str(tmp_path))
+    assert not v["ok"]
+    assert v["baseline"]["round"] == 2
+    assert v["delta_pct"] == pytest.approx(100.0)
+
+    # within tolerance: ok
+    (tmp_path / "BENCH_r03.json").write_text(
+        json.dumps(_bench_blob(3, 6.5e-5, "cpu")))
+    assert check_regression(str(tmp_path))["ok"]
+
+
+def test_bench_check_regression_one_json_line():
+    """The one-JSON-line stdout contract holds for --check-regression
+    too (history detail goes to stderr); jax-free and fast."""
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--check-regression"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    verdict = json.loads(lines[0])
+    assert verdict["check"] == "regression"
+    assert verdict["ok"] is (r.returncode == 0)

@@ -43,6 +43,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_aggcomm.backends.lanes import lane_layout, lanes_to_bytes, to_lanes
+from tpu_aggcomm.compat import shard_map as _compat_shard_map
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import Schedule
 from tpu_aggcomm.harness.attribution import (attribute_rounds,
@@ -50,6 +51,7 @@ from tpu_aggcomm.harness.attribution import (attribute_rounds,
                                              attribute_total, weights_for)
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs
+from tpu_aggcomm.obs import trace
 
 __all__ = ["JaxIciBackend", "color_rounds", "lower_schedule", "put_global"]
 
@@ -332,18 +334,21 @@ class JaxIciBackend:
                 verify_recv(p, recv_bufs, iter_)
             return recv_bufs, timers
         recv_dev = None
-        for _ in range(ntimes):
+        for rep in range(ntimes):
             recv_dev = fresh_recv()
             seg_times = []
-            t0 = time.perf_counter()
-            for seg in segments:
-                ts = time.perf_counter()
-                recv_dev = seg(send_dev, recv_dev)
-                if profile_rounds:
-                    recv_dev.block_until_ready()
-                    seg_times.append(time.perf_counter() - ts)
-            recv_dev.block_until_ready()
-            dt = time.perf_counter() - t0
+            with trace.span("jax_ici.dispatch", rep=rep,
+                            method=schedule.name,
+                            segments=len(segments)):
+                t0 = time.perf_counter()
+                for seg in segments:
+                    ts = time.perf_counter()
+                    recv_dev = seg(send_dev, recv_dev)
+                    if profile_rounds:
+                        recv_dev.block_until_ready()
+                        seg_times.append(time.perf_counter() - ts)
+                recv_dev.block_until_ready()
+                dt = time.perf_counter() - t0
             # measured time -> TimerBucket structure (the fenced-segment
             # approximation, harness/attribution.py): per-round when the
             # program was split at round boundaries, whole-rep otherwise
@@ -579,7 +584,7 @@ class JaxIciBackend:
                 return rep_body(send[0], recv[0], sslot[0], rslot[0],
                                 c0, c1)[None]
 
-            sm = jax.shard_map(
+            sm = _compat_shard_map(
                 local_fn, mesh=mesh,
                 in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS)),
                 out_specs=P(AXIS))
@@ -610,7 +615,7 @@ class JaxIciBackend:
                                       w=w, jdt=jdt, axis=AXIS, iters=iters)
                 return inner(send[0])[None]
 
-            csm = jax.shard_map(chain_local, mesh=mesh,
+            csm = _compat_shard_map(chain_local, mesh=mesh,
                                 in_specs=(P(AXIS),) * 3, out_specs=P(AXIS))
             cjf = jax.jit(csm)
 
@@ -661,7 +666,7 @@ class JaxIciBackend:
         def local_fn(send, recv):
             return rep_body(send[0], recv[0])[None]
 
-        sm = jax.shard_map(local_fn, mesh=mesh,
+        sm = _compat_shard_map(local_fn, mesh=mesh,
                            in_specs=(P(AXIS), P(AXIS)), out_specs=P(AXIS))
 
         def make_chain(iters: int):
@@ -672,7 +677,7 @@ class JaxIciBackend:
                                       w=w, jdt=jdt, axis=AXIS, iters=iters)
                 return inner(send[0])[None]
 
-            csm = jax.shard_map(chain_local, mesh=mesh,
+            csm = _compat_shard_map(chain_local, mesh=mesh,
                                 in_specs=(P(AXIS),), out_specs=P(AXIS))
             return jax.jit(csm)
 

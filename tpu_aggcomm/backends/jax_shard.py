@@ -51,6 +51,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_aggcomm.backends.lanes import lane_layout, lanes_to_bytes, to_lanes
+from tpu_aggcomm.compat import pcast as _compat_pcast
+from tpu_aggcomm.compat import shard_map as _compat_shard_map
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import (Schedule, barrier_rounds_of,
                                        schedule_shape_key)
@@ -58,6 +60,7 @@ from tpu_aggcomm.harness.attribution import (attribute_rounds,
                                              attribute_total, weights_for)
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs, recv_slot_counts
+from tpu_aggcomm.obs import trace
 
 __all__ = ["JaxShardBackend", "block_round_tables"]
 
@@ -427,7 +430,7 @@ class JaxShardBackend:
                 recv0 = jnp.zeros((F, w), dtype=jdt)
                 # the all_to_all output is varying over the mesh axis; the
                 # constant initial carry must be cast to match
-                recv0 = lax.pcast(recv0, (AXIS,), to="varying")
+                recv0 = _compat_pcast(recv0, (AXIS,), to="varying")
                 recv, _ = lax.scan(body, recv0, (pks, scs), unroll=1)
                 return recv
         else:
@@ -454,7 +457,7 @@ class JaxShardBackend:
         def local_fn(send, packs, scats):
             return rep_body(send[0], packs, scats)[None]
 
-        sm = jax.shard_map(
+        sm = _compat_shard_map(
             local_fn, mesh=mesh,
             in_specs=(P(AXIS), [P(AXIS)] * len(pack_dev), [P(AXIS)] * len(pack_dev)),
             out_specs=P(AXIS))
@@ -487,7 +490,7 @@ class JaxShardBackend:
                                   unroll=1)
                 return out[None]
 
-            csm = jax.shard_map(
+            csm = _compat_shard_map(
                 chain_local, mesh=mesh,
                 in_specs=(P(AXIS), [P(AXIS)] * len(pack_dev),
                           [P(AXIS)] * len(pack_dev)),
@@ -568,7 +571,7 @@ class JaxShardBackend:
                                               scl[0], nbar, F, w, jdt,
                                               single_dev=ndev == 1)[None]
 
-                sm = jax.shard_map(local, mesh=mesh,
+                sm = _compat_shard_map(local, mesh=mesh,
                                    in_specs=(P(AXIS),) * 4,
                                    out_specs=P(AXIS))
 
@@ -614,14 +617,16 @@ class JaxShardBackend:
         self.last_round_times = []
         attr_w = weights_for(schedule)
         out = None
-        for _ in range(ntimes):
+        for rep in range(ntimes):
             recv = recv0
             round_times = []
-            for seg in segs:
-                ts = time.perf_counter()
-                recv = seg(send_dev, recv)
-                recv.block_until_ready()
-                round_times.append(time.perf_counter() - ts)
+            for rnd, seg in zip(round_ids, segs):
+                with trace.span("jax_shard.round", rep=rep, round=rnd,
+                                method=schedule.name):
+                    ts = time.perf_counter()
+                    recv = seg(send_dev, recv)
+                    recv.block_until_ready()
+                    round_times.append(time.perf_counter() - ts)
             out = recv
             self.last_round_times.append(round_times)
             rep_attr = attribute_rounds(
@@ -844,11 +849,13 @@ class JaxShardBackend:
                 [Timer.from_array(t.as_array()) for t in rep_attr]
                 for _ in range(ntimes)]
         else:
-            for _ in range(ntimes):
-                t0 = time.perf_counter()
-                out = fn(send_dev)
-                out.block_until_ready()
-                dt = time.perf_counter() - t0
+            for rep in range(ntimes):
+                with trace.span("jax_shard.dispatch", rep=rep,
+                                method=schedule.name):
+                    t0 = time.perf_counter()
+                    out = fn(send_dev)
+                    out.block_until_ready()
+                    dt = time.perf_counter() - t0
                 rep_attr = attribute_total(schedule, dt, weights=attr_w)
                 for r, t in enumerate(timers):
                     t += rep_attr[r]

@@ -50,6 +50,7 @@ from tpu_aggcomm.harness.chained import (MAX_MEASURED_ROUNDS,
                                          differenced_per_rep)
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs, recv_slot_counts
+from tpu_aggcomm.obs import trace
 
 __all__ = ["JaxSimBackend", "dense_send_lanes"]
 
@@ -486,11 +487,13 @@ class JaxSimBackend:
             out = self._run_profiled(schedule, send_dev, ntimes, timers,
                                      profiled_segs)
         else:
-            for _ in range(ntimes):
-                t0 = time.perf_counter()
-                out = fn(send_dev)
-                out.block_until_ready()
-                dt = time.perf_counter() - t0
+            for rep in range(ntimes):
+                with trace.span("jax_sim.dispatch", rep=rep,
+                                method=schedule.name):
+                    t0 = time.perf_counter()
+                    out = fn(send_dev)
+                    out.block_until_ready()
+                    dt = time.perf_counter() - t0
                 rep_attr = attribute_total(schedule, dt, weights=attr_w)
                 for r, t in enumerate(timers):
                     t += rep_attr[r]
@@ -576,11 +579,13 @@ class JaxSimBackend:
                     jnp.zeros((p.nprocs, n_recv_slots + 1, w), dtype=jdt),
                     dev)
                 round_times = []
-                for seg in segs_run:
-                    ts = time.perf_counter()
-                    recv = seg(send_dev, recv)
-                    recv.block_until_ready()
-                    round_times.append(time.perf_counter() - ts)
+                for rnd, seg in zip(round_ids, segs_run):
+                    with trace.span("jax_sim.round", round=rnd,
+                                    method=schedule.name):
+                        ts = time.perf_counter()
+                        recv = seg(send_dev, recv)
+                        recv.block_until_ready()
+                        round_times.append(time.perf_counter() - ts)
                 out = recv
                 self.last_round_times.append(round_times)
                 rep_attr = attribute_rounds(

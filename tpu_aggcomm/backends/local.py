@@ -31,6 +31,7 @@ from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.schedule import Op, OpKind, Schedule
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs
+from tpu_aggcomm.obs import trace
 
 __all__ = ["LocalBackend", "DeadlockError", "run_schedule_local"]
 
@@ -74,10 +75,11 @@ class LocalBackend:
                 return bufs
 
         self.last_rep_timers = []  # [rep][rank] -> Timer (save_all_timing)
-        for _ in range(ntimes):
-            t0 = time.perf_counter()
-            recv_bufs = run_rep(recv_bufs)
-            dt = time.perf_counter() - t0
+        for rep in range(ntimes):
+            with trace.span("local.rep", rep=rep, method=schedule.name):
+                t0 = time.perf_counter()
+                recv_bufs = run_rep(recv_bufs)
+                dt = time.perf_counter() - t0
             self.last_rep_timers.append(
                 [Timer(total_time=dt) for _ in range(p.nprocs)])
         if verify:
@@ -105,6 +107,10 @@ def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:
         return
 
     states = [_RankState(prog) for prog in schedule.programs]
+    # flight recorder: every delivery emits a host-measured instant with
+    # its throttle round — the oracle's real per-round boundary events
+    # (the compiled backends reconstruct theirs from attribution instead)
+    rec = trace.current()
     # message plumbing, keyed by (src, dst):
     #  sends_posted[(s,d)] = (slot, token|None, rendezvous)
     #  recvs_posted[(s,d)] = (slot, token|None)
@@ -124,11 +130,14 @@ def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:
             return
         if key in sends_posted and key in recvs_posted:
             src, dst = key
-            sslot, stok, rendezvous, nbytes = sends_posted[key]
+            sslot, stok, rendezvous, nbytes, rnd = sends_posted[key]
             rslot, rtok = recvs_posted[key]
             if nbytes > 0:
                 recv_bufs[dst][rslot] = send_slabs[src][sslot]
             delivered.add(key)
+            if rec is not None:
+                rec.instant("local.deliver", src=src, dst=dst,
+                            round=rnd, nbytes=nbytes)
             # completion: send token completes (rendezvous satisfied), recv
             # token completes.
             if stok is not None:
@@ -151,7 +160,8 @@ def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:
         k = op.kind
         if k is OpKind.ISSEND or k is OpKind.ISEND:
             key = (rank, op.peer)
-            sends_posted[key] = (op.slot, op.token, k is OpKind.ISSEND, op.nbytes)
+            sends_posted[key] = (op.slot, op.token, k is OpKind.ISSEND,
+                                 op.nbytes, op.round)
             if k is OpKind.ISEND:
                 # eager: complete at post time; delivery happens at match
                 states[rank].done.add(op.token)
@@ -172,7 +182,8 @@ def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:
             # as eager; only Issend keeps rendezvous semantics.
             key = (rank, op.peer)
             if key not in sends_posted:
-                sends_posted[key] = (op.slot, None, False, op.nbytes)
+                sends_posted[key] = (op.slot, None, False, op.nbytes,
+                                     op.round)
                 try_deliver(key)
             st.pc += 1
             return True
@@ -191,7 +202,8 @@ def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:
             skey = (rank, op.peer)
             rkey = (op.peer2, rank)
             if skey not in sends_posted:
-                sends_posted[skey] = (op.slot, None, False, op.nbytes)
+                sends_posted[skey] = (op.slot, None, False, op.nbytes,
+                                      op.round)
                 try_deliver(skey)
             if rkey not in recvs_posted:
                 recvs_posted[rkey] = (op.slot2, None)

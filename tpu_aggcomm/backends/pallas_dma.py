@@ -50,10 +50,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_aggcomm.compat import shard_map as _compat_shard_map
+from tpu_aggcomm.compat import tpu_compiler_params as _compat_compiler_params
 from tpu_aggcomm.core.schedule import Schedule
 from tpu_aggcomm.harness.attribution import attribute_total, weights_for
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs, recv_slot_counts
+from tpu_aggcomm.obs import trace
 
 __all__ = ["PallasDmaBackend", "barrier_shifts", "complete_permutation"]
 
@@ -203,11 +206,13 @@ class PallasDmaBackend:
         self.last_rep_timers = []
         attr_w = weights_for(schedule)
         out = None
-        for _ in range(ntimes):
-            t0 = time.perf_counter()
-            out = fn(send_dev, *tab_devs)
-            out.block_until_ready()
-            dt = time.perf_counter() - t0
+        for rep in range(ntimes):
+            with trace.span(f"{self.name}.dispatch", rep=rep,
+                            method=schedule.name):
+                t0 = time.perf_counter()
+                out = fn(send_dev, *tab_devs)
+                out.block_until_ready()
+                dt = time.perf_counter() - t0
             # whole-rep wall time split onto the TimerBucket structure
             # (fenced-segment approximation, harness/attribution.py) —
             # in-kernel step timestamps remain future work
@@ -424,14 +429,14 @@ class PallasDmaBackend:
                 # collective_id coordinates the cross-chip barrier at kernel
                 # entry; Mosaic rejects it on a single-device mesh (no
                 # custom barrier there — surfaced by the compiled v5e run)
-                compiler_params=pltpu.CompilerParams(
+                compiler_params=_compat_compiler_params(
                     has_side_effects=True,
                     collective_id=0 if n > 1 else None),
                 input_output_aliases={5: 0},
                 interpret=interpret,
             )(dst_a, src_a, sslot_a, rslot_a, send, recv0)
 
-        sm = jax.shard_map(outer, mesh=mesh,
+        sm = _compat_shard_map(outer, mesh=mesh,
                            in_specs=(P(AXIS),) * 5, out_specs=P(AXIS),
                            check_vma=False)
         fn = jax.jit(sm)

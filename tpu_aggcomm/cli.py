@@ -78,6 +78,12 @@ def build_parser() -> argparse.ArgumentParser:
                             "-split...+attributed(...)' in the "
                             "provenance sidecar")
     bench.add_argument("--results-csv", default="results.csv")
+    bench.add_argument("--trace", metavar="PREFIX", default=None,
+                       help="flight recorder: write PREFIX.trace.jsonl "
+                            "(structured events; inspect with 'inspect "
+                            "trace') and PREFIX.trace.json (Chrome/"
+                            "Perfetto). Results CSVs and console output "
+                            "are unchanged; off = zero overhead")
 
     pt = sub.add_parser("pt2pt", help="2-rank latency microbenchmark "
                                       "(mpi_sendrecv_test.c)")
@@ -166,6 +172,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "results CSV for this config (an interrupted sweep "
                          "picks up where it stopped)")
     sw.add_argument("--results-csv", default="results.csv")
+    sw.add_argument("--trace", metavar="PREFIX", default=None,
+                    help="flight recorder over the whole sweep: one "
+                         "PREFIX.trace.{jsonl,json} pair covering every "
+                         "cell")
     sw.add_argument("--comm-sizes", type=str, default=None,
                     help="comma-separated throttle values (default: the "
                          "Theta grid 1,2,4,...,8192,999999999)")
@@ -174,9 +184,16 @@ def build_parser() -> argparse.ArgumentParser:
     ins = sub.add_parser(
         "inspect", help="show how a method compiles for a pattern: rounds, "
                         "edges and ppermute colors per round, bytes moved, "
-                        "barriers, rendezvous mode")
+                        "barriers, rendezvous mode — or, with 'inspect "
+                        "trace FILE', the round/rank critical-path summary "
+                        "of a flight-recorder trace")
+    ins.add_argument("what", nargs="?", choices=["trace"], default=None,
+                     help="'trace' to summarize a *.trace.jsonl file "
+                          "instead of a compiled schedule")
+    ins.add_argument("trace_file", nargs="?", default=None,
+                     help="the *.trace.jsonl to summarize (with 'trace')")
     ins.add_argument("-n", "--nprocs", type=int, default=32)
-    ins.add_argument("-m", dest="method", type=int, required=True)
+    ins.add_argument("-m", dest="method", type=int, default=None)
     ins.add_argument("-a", dest="cb_nodes", type=int, default=1)
     ins.add_argument("-d", dest="data_size", type=int, default=2048)
     ins.add_argument("-c", dest="comm_size", type=int, default=200_000_000)
@@ -205,6 +222,31 @@ def build_parser() -> argparse.ArgumentParser:
 
 THETA_COMM_SIZES = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
                     4096, 8192, 999_999_999)  # script_theta_*.sh:33-106
+
+
+def _tracing(prefix):
+    """Context manager enabling the flight recorder for one CLI run and
+    flushing ``<prefix>.trace.{jsonl,json}`` on exit. ``prefix=None``
+    (tracing off) is a no-op."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        if not prefix:
+            yield
+            return
+        from tpu_aggcomm.obs import trace
+        trace.enable()
+        try:
+            yield
+        finally:
+            paths = trace.flush(prefix)
+            trace.disable()
+            if paths:
+                print(f"trace written: {paths[0]} (events), "
+                      f"{paths[1]} (Perfetto)")
+
+    return cm()
 
 
 def _run_tam(args) -> int:
@@ -476,26 +518,29 @@ def _run_sweep(args) -> int:
                         f"{MAX_MEASURED_ROUNDS}); trim --comm-sizes or "
                         f"use --chained for the deep cells")
     import json
-    for c in grid:
-        print(f"RUN_OPTS: -a {args.cb_nodes} -d {args.data_size} -c {c} "
-              f"-m {args.method} -i {args.iters}")
-        cfg = ExperimentConfig(
-            nprocs=nprocs, cb_nodes=args.cb_nodes, method=args.method,
-            data_size=args.data_size, comm_size=c, iters=args.iters,
-            ntimes=args.ntimes, proc_node=args.proc_node,
-            agg_type=args.agg_type, backend=args.backend, verify=args.verify,
-            results_csv=args.results_csv, chained=args.chained,
-            measured_phases=args.measured_phases)
-        run_experiment(cfg)
-        if args.results_csv:
-            # checkpoint: record the completed throttle with its FULL config
-            rec = _sweep_key(nprocs, args.cb_nodes, args.data_size,
-                             args.method, args.iters, args.ntimes,
-                             args.agg_type, args.proc_node, args.backend,
-                             args.chained, args.measured_phases)
-            rec["comm"] = c
-            with open(_sweep_sidecar(args.results_csv), "a") as f:
-                f.write(json.dumps(rec) + "\n")
+    with _tracing(getattr(args, "trace", None)):
+        for c in grid:
+            print(f"RUN_OPTS: -a {args.cb_nodes} -d {args.data_size} -c {c} "
+                  f"-m {args.method} -i {args.iters}")
+            cfg = ExperimentConfig(
+                nprocs=nprocs, cb_nodes=args.cb_nodes, method=args.method,
+                data_size=args.data_size, comm_size=c, iters=args.iters,
+                ntimes=args.ntimes, proc_node=args.proc_node,
+                agg_type=args.agg_type, backend=args.backend,
+                verify=args.verify, results_csv=args.results_csv,
+                chained=args.chained,
+                measured_phases=args.measured_phases)
+            run_experiment(cfg)
+            if args.results_csv:
+                # checkpoint: record the completed throttle with its FULL
+                # config
+                rec = _sweep_key(nprocs, args.cb_nodes, args.data_size,
+                                 args.method, args.iters, args.ntimes,
+                                 args.agg_type, args.proc_node, args.backend,
+                                 args.chained, args.measured_phases)
+                rec["comm"] = c
+                with open(_sweep_sidecar(args.results_csv), "a") as f:
+                    f.write(json.dumps(rec) + "\n")
     return 0
 
 
@@ -503,6 +548,17 @@ def _run_inspect(args) -> int:
     """Schedule-shape report: what the -c/-m/-t choices actually compile
     to. This is the question the per-phase timers approximate at runtime,
     answered statically."""
+    if args.what == "trace":
+        if not args.trace_file:
+            raise SystemExit("inspect trace: missing trace file "
+                             "(a *.trace.jsonl written by --trace)")
+        from tpu_aggcomm.obs.trace import summarize_trace
+        print(summarize_trace(args.trace_file), end="")
+        return 0
+    if args.method is None:
+        raise SystemExit("inspect: -m is required "
+                         "(or use 'inspect trace <file>')")
+
     from tpu_aggcomm.core.methods import METHODS, compile_method
     from tpu_aggcomm.core.pattern import AggregatorPattern
 
@@ -742,7 +798,8 @@ def main(argv=None) -> int:
         backend=args.backend, verify=args.verify,
         results_csv=args.results_csv, profile_rounds=args.profile_rounds,
         chained=args.chained, measured_phases=args.measured_phases)
-    run_experiment(cfg)
+    with _tracing(args.trace):
+        run_experiment(cfg)
     return 0
 
 

@@ -1,0 +1,59 @@
+"""JAX version compatibility shims.
+
+The framework targets the current jax API (``jax.shard_map``,
+``lax.pcast``); the pinned container jax (0.4.x) predates both. Every
+call site imports the two names from here so the whole package runs on
+either API without version branches at use sites.
+
+- :func:`shard_map` — ``jax.shard_map`` when present, else the
+  ``jax.experimental.shard_map`` original with ``check_rep=False`` (the
+  old replication checker predates the varying-type system the mesh
+  programs are written for, and rejects valid programs the new
+  ``check_vma`` accepts).
+- :func:`pcast` — ``lax.pcast`` when present, else identity: the
+  replicated→varying cast only exists to satisfy the new varying-type
+  checker; under 0.4.x semantics the value is already usable as-is.
+
+jax is imported lazily inside each shim: bench.py's supervisor process
+must stay importable without touching jax (a wedged TPU tunnel can hang
+``import jax`` — CLAUDE.md).
+"""
+
+from __future__ import annotations
+
+__all__ = ["shard_map", "pcast", "tpu_compiler_params"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kw):
+    import jax
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    # the varying-type checker toggle doesn't exist pre-jax.shard_map;
+    # check_rep=False is its closest 0.4.x analog (disable rep checking)
+    kw.pop("check_vma", None)
+    kw.setdefault("check_rep", False)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+def pcast(x, axes, to="varying"):
+    from jax import lax
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axes, to=to)
+    return x
+
+
+def tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams`` (current name) or ``TPUCompilerParams``
+    (its 0.4.x name). Fields the installed class doesn't know are
+    dropped (e.g. ``has_side_effects`` predates 0.4.x — there the
+    kernel's liveness is carried by its consumed output instead)."""
+    import dataclasses
+
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    names = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in kw.items() if k in names})

@@ -52,6 +52,8 @@ and do not use this module.
 
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 from tpu_aggcomm.core.schedule import OpKind, Schedule, TimerBucket
@@ -60,7 +62,7 @@ from tpu_aggcomm.harness.timer import Timer
 __all__ = ["POST_COST_BYTES", "attribute_total", "attribute_rounds",
            "attribute_round_splits", "attribute_measured_split",
            "rank_round_weights", "tam_rank_weights", "attribute_tam_total",
-           "attribute_tam_hops", "weights_for"]
+           "attribute_tam_hops", "weights_for", "cell_recording"]
 
 #: Per-call overhead of posting one nonblocking op / one pure-sync wait /
 #: one barrier, expressed in byte-equivalents of transfer time. See module
@@ -69,6 +71,58 @@ POST_COST_BYTES = 512
 
 _NB_POSTS = (OpKind.ISEND, OpKind.ISSEND, OpKind.IRECV, OpKind.SIGNAL_SEND)
 _BLOCKING = (OpKind.SEND, OpKind.RECV, OpKind.SENDRECV, OpKind.SIGNAL_RECV)
+
+
+# ---------------------------------------------------------------------------
+# Attribution cell stream — the flight recorder's view of this module.
+#
+# When a sink is active (obs tracing), every attribute_* call appends one
+# "call" dict {"kind", "total", "cells"} whose cells mirror the call's
+# Timer writes: ``(rank, round, bucket label, seconds)`` with the EXACT
+# float handed to ``Timer.add`` (same expression, same order), so a trace
+# re-aggregates float-exactly to the Timer columns (obs.trace.aggregate_run
+# replays the additions in cell order). ``round`` is an int throttle
+# round, -1 for a whole-rep charge, or a TAM hop label ("P2"/"P3"/"P4").
+# Off by default: one ``is None`` test per attribution call.
+
+_CELL_SINK: list | None = None
+
+_CELL_LABELS = {
+    TimerBucket.POST: "post",
+    TimerBucket.SEND_WAIT: "send_wait",
+    TimerBucket.RECV_WAIT: "recv_wait",
+    TimerBucket.RECV_AND_SEND_WAIT: "recv+send_wait",
+    TimerBucket.BARRIER: "barrier",
+}
+
+#: cell round label for charges with no per-round decomposition
+WHOLE_REP = -1
+
+
+@contextlib.contextmanager
+def cell_recording():
+    """Capture the attribution cell stream of the enclosed block; yields
+    the list the attribute_* calls append to. A delegating backend's
+    inner attribution calls (pallas_dma -> jax_sim/jax_ici) land in the
+    same capture — the runner wraps the whole ``backend.run``. Nested
+    captures restore the previous sink on exit (innermost wins while
+    active)."""
+    global _CELL_SINK
+    prev = _CELL_SINK
+    _CELL_SINK = sink = []
+    try:
+        yield sink
+    finally:
+        _CELL_SINK = prev
+
+
+def _open_call(kind: str, total: float):
+    """One attribution call's record, or None when no sink is active."""
+    if _CELL_SINK is None:
+        return None
+    call = {"kind": kind, "total": float(total), "cells": []}
+    _CELL_SINK.append(call)
+    return call
 
 
 def _rank_charges(prog) -> list[tuple[int, TimerBucket, float]]:
@@ -144,16 +198,22 @@ def attribute_total(schedule, total_seconds: float,
     if getattr(schedule, "assignment", None) is not None:
         return attribute_tam_total(schedule, total_seconds, weights=weights)
     if schedule.collective:
+        _open_call("collective-total", total_seconds)
         return [Timer(total_time=total_seconds)
                 for _ in range(schedule.nprocs)]
+    call = _open_call("total", total_seconds)
     timers = []
-    for acc in (weights if weights is not None
-                else rank_round_weights(schedule)):
+    for rank, acc in enumerate(weights if weights is not None
+                               else rank_round_weights(schedule)):
         t = Timer(total_time=total_seconds)
         wsum = sum(acc.values())
         if wsum > 0:
-            for (_rnd, bucket), w in acc.items():
-                t.add(bucket, total_seconds * w / wsum)
+            for (rnd, bucket), w in acc.items():
+                s = total_seconds * w / wsum
+                t.add(bucket, s)
+                if call is not None:
+                    call["cells"].append(
+                        (rank, rnd, _CELL_LABELS[bucket], s))
         timers.append(t)
     return timers
 
@@ -181,9 +241,10 @@ def attribute_measured_split(schedule, post_seconds: float,
     RECV_AND_SEND_WAIT both-columns convention preserved.
     """
     total = post_seconds + deliver_seconds
+    call = _open_call("measured-split", total)
     timers = []
-    for acc in (weights if weights is not None
-                else rank_round_weights(schedule)):
+    for rank, acc in enumerate(weights if weights is not None
+                               else rank_round_weights(schedule)):
         t = Timer(total_time=total)
         post_w = sum(w for (_r, b), w in acc.items()
                      if b is TimerBucket.POST)
@@ -192,13 +253,21 @@ def attribute_measured_split(schedule, post_seconds: float,
         p_r = post_seconds if post_w > 0 else 0.0
         if p_r:
             t.add(TimerBucket.POST, p_r)
+            if call is not None:
+                call["cells"].append((rank, WHOLE_REP, "post", p_r))
         rest = total - p_r
         wsum = sum(waits.values())
         if wsum > 0:
-            for (_rnd, bucket), w in waits.items():
-                t.add(bucket, rest * w / wsum)
+            for (rnd, bucket), w in waits.items():
+                s = rest * w / wsum
+                t.add(bucket, s)
+                if call is not None:
+                    call["cells"].append(
+                        (rank, rnd, _CELL_LABELS[bucket], s))
         elif post_w > 0:
             t.add(TimerBucket.POST, rest)   # post-only rank
+            if call is not None:
+                call["cells"].append((rank, WHOLE_REP, "post", rest))
         timers.append(t)
     return timers
 
@@ -216,9 +285,10 @@ def attribute_round_splits(schedule, splits: dict[int, tuple],
     buckets by weight, preserving the RECV_AND_SEND_WAIT both-columns
     convention."""
     total = float(sum(p + d for p, d in splits.values()))
+    call = _open_call("round-splits", total)
     timers = []
-    for acc in (weights if weights is not None
-                else rank_round_weights(schedule)):
+    for rank, acc in enumerate(weights if weights is not None
+                               else rank_round_weights(schedule)):
         t = Timer(total_time=total)
         for rnd, (post, deliver) in splits.items():
             sel = {bucket: w for (r, bucket), w in acc.items() if r == rnd}
@@ -230,13 +300,21 @@ def attribute_round_splits(schedule, splits: dict[int, tuple],
             p_r = post if post_w > 0 else 0.0
             if p_r:
                 t.add(TimerBucket.POST, p_r)
+                if call is not None:
+                    call["cells"].append((rank, rnd, "post", p_r))
             rest = (post - p_r) + deliver
             wsum = sum(waits.values())
             if wsum > 0:
                 for bucket, w in waits.items():
-                    t.add(bucket, rest * w / wsum)
+                    s = rest * w / wsum
+                    t.add(bucket, s)
+                    if call is not None:
+                        call["cells"].append(
+                            (rank, rnd, _CELL_LABELS[bucket], s))
             elif post_w > 0:
                 t.add(TimerBucket.POST, rest)   # post-only round
+                if call is not None:
+                    call["cells"].append((rank, rnd, "post", rest))
         timers.append(t)
     return timers
 
@@ -249,16 +327,21 @@ def attribute_rounds(schedule, round_times: dict[int, float],
     whole program's elapsed time (sum of segments), as in the reference
     where total_time brackets the full rep loop."""
     total = float(sum(round_times.values()))
+    call = _open_call("rounds", total)
     timers = []
-    for acc in (weights if weights is not None
-                else rank_round_weights(schedule)):
+    for rank, acc in enumerate(weights if weights is not None
+                               else rank_round_weights(schedule)):
         t = Timer(total_time=total)
         for rnd, dt in round_times.items():
             sel = {bucket: w for (r, bucket), w in acc.items() if r == rnd}
             wsum = sum(sel.values())
             if wsum > 0:
                 for bucket, w in sel.items():
-                    t.add(bucket, dt * w / wsum)
+                    s = dt * w / wsum
+                    t.add(bucket, s)
+                    if call is not None:
+                        call["cells"].append(
+                            (rank, rnd, _CELL_LABELS[bucket], s))
         timers.append(t)
     return timers
 
@@ -318,6 +401,7 @@ def attribute_tam_total(tam, total_seconds: float,
     """Per-rank byte-weighted split of a measured TAM rep time between
     recv_wait (intra-node P2/P4) and send_wait (inter-node P3)."""
     rw, sw = weights if weights is not None else tam_rank_weights(tam)
+    call = _open_call("tam-total", total_seconds)
     timers = []
     for r in range(tam.pattern.nprocs):
         t = Timer(total_time=total_seconds)
@@ -325,6 +409,11 @@ def attribute_tam_total(tam, total_seconds: float,
         if wsum > 0:
             t.recv_wait_all_time = total_seconds * rw[r] / wsum
             t.send_wait_all_time = total_seconds * sw[r] / wsum
+            if call is not None:
+                call["cells"].append(
+                    (r, WHOLE_REP, "recv_wait", t.recv_wait_all_time))
+                call["cells"].append(
+                    (r, WHOLE_REP, "send_wait", t.send_wait_all_time))
         timers.append(t)
     return timers
 
@@ -344,13 +433,26 @@ def attribute_tam_hops(tam, p2: float, p3: float, p4: float,
     surround it)."""
     rw, sw = weights if weights is not None else tam_rank_weights(tam)
     total = p2 + p3 + p4
+    call = _open_call("tam-hops", total)
     timers = []
     for r in range(tam.pattern.nprocs):
         t = Timer(total_time=total)
         if sw[r] > 0:
             t.send_wait_all_time = p3
             t.recv_wait_all_time = p2 + p4
+            if call is not None:
+                # per-hop cells; sequential re-aggregation reproduces
+                # p2 + p4 and p3 exactly
+                call["cells"].append((r, "P2", "recv_wait", p2))
+                call["cells"].append((r, "P3", "send_wait", p3))
+                call["cells"].append((r, "P4", "recv_wait", p4))
         elif rw[r] > 0:
             t.recv_wait_all_time = total
+            if call is not None:
+                # non-proxy: blocked in recv across all three hop
+                # windows; (p2 + p3) + p4 == total, left-to-right
+                call["cells"].append((r, "P2", "recv_wait", p2))
+                call["cells"].append((r, "P3", "recv_wait", p3))
+                call["cells"].append((r, "P4", "recv_wait", p4))
         timers.append(t)
     return timers

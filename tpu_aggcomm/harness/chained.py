@@ -20,6 +20,8 @@ from __future__ import annotations
 import statistics
 import time
 
+from tpu_aggcomm.obs import trace
+
 __all__ = ["differenced_per_rep", "differenced_trials",
            "differenced_round_times", "scanned_chain", "xor_word",
            "MAX_MEASURED_ROUNDS"]
@@ -72,8 +74,10 @@ def differenced_trials(chain_factory, send0, *, iters_small: int,
 
     f_small = chain_factory(iters_small)
     f_big = chain_factory(iters_big)
-    int(jax.device_get(checksum(f_small(send0))))    # compile + warm
-    int(jax.device_get(checksum(f_big(send0))))
+    with trace.span("chained.warmup", iters_small=iters_small,
+                    iters_big=iters_big):
+        int(jax.device_get(checksum(f_small(send0))))    # compile + warm
+        int(jax.device_get(checksum(f_big(send0))))
     per = []
     # noise budget: a jittery link can invert a diff; keep a floor so
     # small-trials windows=1 callers (chained pt2pt with -k 1) are not
@@ -83,6 +87,11 @@ def differenced_trials(chain_factory, send0, *, iters_small: int,
         t_s = timed(f_small)
         t_b = timed(f_big)
         v = (t_b - t_s) / (iters_big - iters_small)
+        # measured differencing evidence for the flight recorder: the
+        # two chain wall times behind each accepted/rejected trial
+        trace.instant("chained.trial", iters_small=iters_small,
+                      iters_big=iters_big, t_small=t_s, t_big=t_b,
+                      per_rep=v, accepted=v > 0)
         if v > 0:
             per.append(v)
         elif retries > 0:
@@ -166,9 +175,11 @@ def scanned_chain(rep, *, n_recv_slots: int, w: int, jdt, axis: str,
     import jax.numpy as jnp
     from jax import lax
 
+    from tpu_aggcomm.compat import pcast
+
     def chain_local(send_local):
         def body(s, r):
-            recv0 = lax.pcast(
+            recv0 = pcast(
                 jnp.zeros((n_recv_slots + 1, w), dtype=jdt),
                 (axis,), to="varying")
             recv = rep(s, recv0)

@@ -33,6 +33,8 @@ import time
 
 import numpy as np
 
+from tpu_aggcomm.compat import shard_map as _compat_shard_map
+
 __all__ = ["pt2pt_statistics"]
 
 
@@ -66,7 +68,7 @@ def _make_chain_factory(mesh, data_size: int):
             v, _ = lax.scan(body, v, xs, unroll=1)
             return v[None]
 
-        return jax.jit(jax.shard_map(local_fn, mesh=mesh, in_specs=P("p"),
+        return jax.jit(_compat_shard_map(local_fn, mesh=mesh, in_specs=P("p"),
                                      out_specs=P("p")))
 
     return make_chain

@@ -9,9 +9,11 @@ from dataclasses import dataclass
 from tpu_aggcomm.backends import get_backend
 from tpu_aggcomm.core.methods import METHODS, compile_method, method_ids
 from tpu_aggcomm.core.pattern import AggregatorPattern
+from tpu_aggcomm.harness.attribution import cell_recording
 from tpu_aggcomm.harness.report import (append_provenance, config_banner,
                                         save_all_timing, summarize_results)
 from tpu_aggcomm.harness.timer import max_reduce
+from tpu_aggcomm.obs import trace
 
 __all__ = ["ExperimentConfig", "run_experiment"]
 
@@ -150,8 +152,22 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
                 kwargs["chained"] = True
             if cfg.measured_phases:
                 kwargs["measured_phases"] = True
-            recv, timers = backend.run(sched, ntimes=cfg.ntimes, iter_=i,
-                                       verify=cfg.verify, **kwargs)
+            rec = trace.current()
+            if rec is not None:
+                # flight recorder: capture the attribution cell stream of
+                # this backend.run (delegations included) plus a measured
+                # host span around the whole dispatch
+                with cell_recording() as calls, \
+                        rec.span("backend.run", method=m,
+                                 method_name=spec.name, iter=i,
+                                 backend=cfg.backend):
+                    recv, timers = backend.run(sched, ntimes=cfg.ntimes,
+                                               iter_=i, verify=cfg.verify,
+                                               **kwargs)
+            else:
+                recv, timers = backend.run(sched, ntimes=cfg.ntimes,
+                                           iter_=i, verify=cfg.verify,
+                                           **kwargs)
             max_timer = max_reduce(timers)
             summarize_results(cfg.nprocs, cfg.cb_nodes, cfg.data_size,
                               cfg.comm_size, cfg.ntimes, cfg.agg_type,
@@ -163,6 +179,13 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             # attributed — the main CSV stays reference-byte-compatible
             executed, phases = getattr(backend, "last_provenance",
                                        (backend.name, "total-only"))
+            if rec is not None:
+                rec.record_method_run(
+                    sched, method=m, name=spec.name, iter_=i,
+                    ntimes=cfg.ntimes, requested=cfg.backend,
+                    executed=executed, phase_source=phases,
+                    timers=timers, calls=calls,
+                    rep_timers=getattr(backend, "last_rep_timers", None))
             if cfg.results_csv:
                 append_provenance(cfg.results_csv, spec.name, cfg.backend,
                                   executed, phases)

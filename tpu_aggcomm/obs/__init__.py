@@ -1,0 +1,25 @@
+"""Flight recorder: per-round structured tracing for every backend.
+
+The observability subsystem of the framework (ISSUE 1):
+
+- :mod:`tpu_aggcomm.obs.trace` — the span/counter recorder plus the
+  reconstruction of per-rank per-round slices from the attribution
+  machinery (harness/attribution.py cell sink); JSONL event log;
+  round/rank critical-path summary (``cli inspect trace``).
+- :mod:`tpu_aggcomm.obs.perfetto` — Chrome/Perfetto ``trace.json``
+  export (one track per logical rank, one slice per throttle round,
+  counter track for bytes in flight).
+- :mod:`tpu_aggcomm.obs.regress` — BENCH_r*.json / MULTICHIP_r*.json
+  schema validation and round-over-round regression checking
+  (``bench.py --check-regression``).
+
+Tracing is OFF by default and zero-cost when off: ``trace.span(...)``
+returns a shared no-op context manager, and nothing here imports jax, so
+importing the package never changes bench.py's output.
+"""
+
+from tpu_aggcomm.obs.trace import (TraceRecorder, current, disable, enable,
+                                   enabled, flush, instant, span)
+
+__all__ = ["TraceRecorder", "current", "disable", "enable", "enabled",
+           "flush", "instant", "span"]

@@ -1,0 +1,91 @@
+"""Chrome/Perfetto export of a flight-recorder event log.
+
+Produces the Chrome Trace Event JSON format (the ``traceEvents`` array
+form), loadable in ``ui.perfetto.dev`` or ``chrome://tracing``:
+
+- pid 1, "host (measured)": real perf_counter windows (``host_span``)
+  and instants — dispatch loops, chained differencing windows, oracle
+  delivery events.
+- pid 2, "ranks (reconstructed)": one thread (track) per logical rank.
+  Rep envelopes and per-round bucket slices from the attribution cell
+  stream. Every slice's args carry the exact attributed seconds
+  (``dur_s``) and the run's column-accurate provenance label
+  (``phase_source``) — the UI can never present a reconstructed slice
+  as a measurement.
+- pid 2, tid 0: the ``bytes_in_flight`` counter track (payload bytes
+  entering flight per throttle round).
+
+Slices within each track are sorted by timestamp, so ``ts`` is
+monotonically non-decreasing per track (pinned by the round-trip
+tests). Timestamps are microseconds (the format's unit).
+"""
+
+from __future__ import annotations
+
+__all__ = ["to_chrome_trace", "HOST_PID", "RANKS_PID"]
+
+HOST_PID = 1
+RANKS_PID = 2
+
+
+def _meta(pid: int, tid: int, what: str, name: str) -> dict:
+    return {"ph": "M", "pid": pid, "tid": tid, "name": what,
+            "args": {"name": name}}
+
+
+def to_chrome_trace(events: list[dict]) -> dict:
+    """Convert flight-recorder events to a Chrome trace dict."""
+    runs = {e["id"]: e for e in events if e["ev"] == "run"}
+    out: list[dict] = [
+        _meta(HOST_PID, 0, "process_name", "host (measured)"),
+        _meta(RANKS_PID, 0, "process_name", "ranks (reconstructed)"),
+        _meta(RANKS_PID, 0, "thread_name", "bytes_in_flight"),
+    ]
+    ranks_seen: set[int] = set()
+    slices: list[dict] = []
+    for e in events:
+        ev = e["ev"]
+        if ev == "host_span":
+            slices.append({
+                "ph": "X", "pid": HOST_PID, "tid": 1, "name": e["name"],
+                "cat": "host", "ts": e["ts"], "dur": e["dur"],
+                "args": e.get("args", {})})
+        elif ev == "instant":
+            slices.append({
+                "ph": "i", "pid": HOST_PID, "tid": 1, "name": e["name"],
+                "cat": "host", "ts": e["ts"], "s": "t",
+                "args": e.get("args", {})})
+        elif ev == "span":
+            run = runs.get(e["run"], {})
+            rank = e["rank"]
+            ranks_seen.add(rank)
+            rnd = e["round"]
+            if e["bucket"] == "total":
+                name = f"rep {e['rep']}"
+            elif rnd is None or rnd == -1:
+                name = e["bucket"]
+            else:
+                name = f"round {rnd}: {e['bucket']}" \
+                    if isinstance(rnd, int) else f"{rnd}: {e['bucket']}"
+            slices.append({
+                "ph": "X", "pid": RANKS_PID, "tid": rank + 1,
+                "name": name, "cat": run.get("name", "run"),
+                "ts": e["ts"], "dur": e["dur"],
+                "args": {"run": e["run"], "rep": e["rep"],
+                         "round": rnd, "bucket": e["bucket"],
+                         "dur_s": e["dur_s"],
+                         "phase_source": e["src"],
+                         "method": run.get("name")}})
+        elif ev == "counter":
+            slices.append({
+                "ph": "C", "pid": RANKS_PID, "tid": 0,
+                "name": e["name"], "ts": e["ts"],
+                "args": {"bytes": e["value"]}})
+        # "run"/"timer"/"meta" events carry no timeline geometry
+
+    for rank in sorted(ranks_seen):
+        out.append(_meta(RANKS_PID, rank + 1, "thread_name",
+                         f"rank {rank}"))
+    slices.sort(key=lambda s: (s["pid"], s["tid"], s["ts"]))
+    out.extend(slices)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}

@@ -1,0 +1,178 @@
+"""Bench-history schema validation and regression tracking.
+
+The driver snapshots one ``BENCH_rNN.json`` and one ``MULTICHIP_rNN.json``
+per growth round (round = NN). This module is the single definition of
+their schemas — used by ``scripts/check_bench_schema.py`` (and its test)
+to validate every artifact in the repo, and by ``bench.py
+--check-regression`` to compare the newest round's headline metric
+against the best prior round.
+
+Regression comparisons are grouped per (metric, platform): the history
+legitimately mixes TPU rounds (~µs/rep) with CPU-fallback rounds
+(~tens of µs/rep), and a cross-platform delta would flag a 40x
+"regression" that is just the fallback path. Lower is better (the
+headline metric is seconds per rep).
+
+No jax anywhere here — bench.py's supervisor process imports this.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+__all__ = ["validate_bench", "validate_multichip", "load_history",
+           "check_regression", "DEFAULT_TOLERANCE"]
+
+#: Relative slowdown vs the best prior same-platform round that counts as
+#: a regression. Differenced-chain numbers jitter a few percent
+#: (harness/chained.py); 25% headroom keeps noise out of the signal.
+DEFAULT_TOLERANCE = 0.25
+
+
+def _require(obj: dict, key: str, types, errors: list[str],
+             where: str, *, nullable: bool = False) -> None:
+    if key not in obj:
+        errors.append(f"{where}: missing required key {key!r}")
+        return
+    v = obj[key]
+    if v is None and nullable:
+        return
+    if not isinstance(v, types):
+        tn = types.__name__ if isinstance(types, type) else \
+            "/".join(t.__name__ for t in types)
+        errors.append(f"{where}: key {key!r} must be {tn}, "
+                      f"got {type(v).__name__}")
+
+
+def validate_bench(obj, where: str = "BENCH") -> list[str]:
+    """Schema errors (empty list = valid) for one BENCH_rNN.json blob:
+    ``{n:int, cmd:str, rc:int, tail:str, parsed: null | {metric:str,
+    value:number|null, unit:str, ...}}``. ``parsed`` is the bench.py
+    one-JSON-line output when rc==0 and the line parsed; extra keys
+    (vs_baseline, platform, tpu_error, tpu_attempts, error) are typed
+    but optional."""
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    _require(obj, "n", int, errors, where)
+    _require(obj, "cmd", str, errors, where)
+    _require(obj, "rc", int, errors, where)
+    _require(obj, "tail", str, errors, where)
+    if "parsed" not in obj:
+        errors.append(f"{where}: missing required key 'parsed'")
+        return errors
+    parsed = obj["parsed"]
+    if parsed is None:
+        return errors
+    if not isinstance(parsed, dict):
+        errors.append(f"{where}: 'parsed' must be null or an object")
+        return errors
+    w = f"{where}.parsed"
+    _require(parsed, "metric", str, errors, w)
+    _require(parsed, "value", (int, float), errors, w, nullable=True)
+    _require(parsed, "unit", str, errors, w)
+    for opt, types in (("vs_baseline", (int, float)), ("platform", str),
+                       ("tpu_error", str), ("tpu_attempts", int),
+                       ("error", str)):
+        if opt in parsed and parsed[opt] is not None \
+                and not isinstance(parsed[opt], types):
+            errors.append(f"{w}: optional key {opt!r} has wrong type "
+                          f"{type(parsed[opt]).__name__}")
+    return errors
+
+
+def validate_multichip(obj, where: str = "MULTICHIP") -> list[str]:
+    """Schema errors for one MULTICHIP_rNN.json blob:
+    ``{n_devices:int, rc:int, ok:bool, skipped:bool, tail:str}``."""
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    _require(obj, "n_devices", int, errors, where)
+    _require(obj, "rc", int, errors, where)
+    _require(obj, "ok", bool, errors, where)
+    _require(obj, "skipped", bool, errors, where)
+    _require(obj, "tail", str, errors, where)
+    return errors
+
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def load_history(root: str = ".", kind: str = "BENCH"
+                 ) -> list[tuple[int, str, dict]]:
+    """All ``<kind>_rNN.json`` under ``root`` as (round, path, blob),
+    sorted by round. Unparsable JSON raises — a corrupt artifact should
+    fail loudly, not vanish from the history."""
+    out = []
+    for path in glob.glob(os.path.join(root, f"{kind}_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        with open(path) as fh:
+            out.append((int(m.group(1)), path, json.load(fh)))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def check_regression(root: str = ".",
+                     tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Compare the newest round's parsed metric against the best prior
+    same-(metric, platform) round.
+
+    Returns a JSON-able verdict::
+
+        {"check": "regression", "ok": bool, "rounds": N,
+         "schema_errors": [...], "current": {...} | null,
+         "baseline": {...} | null, "delta_pct": float | null,
+         "tolerance_pct": float, "history": [...]}
+
+    ``ok`` is False only when the newest measurable round is more than
+    ``tolerance`` slower than the best prior comparable round, or when
+    any artifact fails schema validation. No prior comparable round (or
+    no measurable current round) is ok=True with delta_pct null — a
+    missing baseline is not a regression.
+    """
+    schema_errors: list[str] = []
+    history = load_history(root, "BENCH")
+    for rnd, path, blob in history:
+        schema_errors += validate_bench(blob, os.path.basename(path))
+    for rnd, path, blob in load_history(root, "MULTICHIP"):
+        schema_errors += validate_multichip(blob, os.path.basename(path))
+
+    measurable = [
+        (rnd, path, blob["parsed"]) for rnd, path, blob in history
+        if isinstance(blob.get("parsed"), dict)
+        and isinstance(blob["parsed"].get("value"), (int, float))]
+    rows = [{"round": rnd, "metric": p["metric"],
+             "platform": p.get("platform", "unknown"),
+             "value": p["value"], "unit": p.get("unit", "")}
+            for rnd, _path, p in measurable]
+
+    verdict: dict = {"check": "regression", "ok": True,
+                     "rounds": len(history),
+                     "schema_errors": schema_errors,
+                     "current": None, "baseline": None,
+                     "delta_pct": None,
+                     "tolerance_pct": tolerance * 100.0,
+                     "history": rows}
+    if schema_errors:
+        verdict["ok"] = False
+    if not rows:
+        return verdict
+    cur = rows[-1]
+    verdict["current"] = cur
+    prior = [r for r in rows[:-1]
+             if r["metric"] == cur["metric"]
+             and r["platform"] == cur["platform"]]
+    if not prior:
+        return verdict
+    best = min(prior, key=lambda r: r["value"])
+    verdict["baseline"] = best
+    delta = (cur["value"] - best["value"]) / best["value"]
+    verdict["delta_pct"] = delta * 100.0
+    if delta > tolerance:
+        verdict["ok"] = False
+    return verdict

@@ -41,6 +41,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from tpu_aggcomm.compat import shard_map as _compat_shard_map
 from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
 from tpu_aggcomm.core.topology import NodeAssignment, static_node_assignment
 
@@ -332,7 +333,7 @@ def tam_two_level_jax(tam: TamMethod, devices, iter_: int = 0,
 
         out_rows = p.cb_nodes
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(_compat_shard_map(
         local_fn, mesh=mesh, in_specs=P("node", "local"),
         out_specs=P("node", "local")))
 
@@ -563,7 +564,7 @@ def tam_two_level_sharded(tam: TamMethod, devices, iter_: int = 0,
             return _rep_local(send[0, 0], pk1[0, 0], pk2[0, 0],
                               sc[0, 0])[None, None]
 
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(_compat_shard_map(
             local_fn, mesh=mesh, in_specs=(P("node", "local"),) * 4,
             out_specs=P("node", "local")))
 
@@ -588,7 +589,7 @@ def tam_two_level_sharded(tam: TamMethod, devices, iter_: int = 0,
                                   unroll=1)
                 return out[None, None]
 
-            csm = jax.shard_map(
+            csm = _compat_shard_map(
                 chain_local, mesh=mesh, in_specs=(P("node", "local"),) * 4,
                 out_specs=P("node", "local"))
             cjf = jax.jit(csm)

@@ -36,6 +36,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from tpu_aggcomm.compat import shard_map as _compat_shard_map
 from tpu_aggcomm.core.meta import AggregatorMeta
 from tpu_aggcomm.core.topology import NodeAssignment
 from tpu_aggcomm.core.workload import Workload
@@ -414,7 +415,7 @@ def _two_level_mesh_exchange(wl: Workload, na: NodeAssignment,
                          ).sum(axis=0, dtype=jdt)        # (N, L, W)
         return recv.reshape(n, W)[None, None]
 
-    fn = jax.jit(jax.shard_map(local_fn, mesh=mesh,
+    fn = jax.jit(_compat_shard_map(local_fn, mesh=mesh,
                                in_specs=P("node", "local"),
                                out_specs=P("node", "local")))
 
