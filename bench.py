@@ -182,6 +182,10 @@ def _measure_inner() -> int:
         "unit": "s",
         "vs_baseline": BASELINE_S / per_rep,
         "platform": dev.platform,
+        # parsed-schema v2: the per-trial differenced seconds behind
+        # ``value`` — obs/regress.py's bootstrap gate needs both sides'
+        # trials, not just the medians
+        "samples": per_reps,
     }))
     print(f"# effective bandwidth: {gbps:.2f} GB/s pattern-bytes "
           f"on {dev.device_kind}; path={'pallas' if on_tpu else 'xla'}; "
@@ -331,6 +335,13 @@ def check_regression() -> int:
               f"{verdict['delta_pct']:+.1f}% "
               f"(tolerance {verdict['tolerance_pct']:.0f}%)",
               file=sys.stderr)
+    if verdict["ci_delta_pct"] is not None:
+        lo, hi = verdict["ci_delta_pct"]
+        print(f"# bootstrap 95% CI on relative median delta: "
+              f"[{lo:+.1f}%, {hi:+.1f}%] (gate: {verdict['gate']})",
+              file=sys.stderr)
+    if verdict["gate_note"]:
+        print(f"# gate: {verdict['gate_note']}", file=sys.stderr)
     # the one-JSON-line stdout contract holds in this mode too; the full
     # per-round history stays on stderr
     slim = {k: v for k, v in verdict.items() if k != "history"}

@@ -23,7 +23,14 @@ def check(root: str) -> int:
     n_errors = 0
     for kind, validate in (("BENCH", validate_bench),
                            ("MULTICHIP", validate_multichip)):
-        for rnd, path, blob in load_history(root, kind):
+        # unparsable JSON must FAIL the check, not traceback out of it
+        load_errors: list[str] = []
+        history = load_history(root, kind, errors=load_errors)
+        for e in load_errors:
+            n_files += 1
+            n_errors += 1
+            print(f"FAIL {e}")
+        for rnd, path, blob in history:
             n_files += 1
             errors = validate(blob, os.path.basename(path))
             if errors:

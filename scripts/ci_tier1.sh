@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the ROADMAP.md gate, verbatim, as a runnable script so
+# CI and humans execute the exact command the driver grades against
+# (any drift between "what CI ran" and "what the gate runs" makes green
+# builds meaningless).
+#
+# CPU-only, marker-filtered (-m 'not slow'), bounded at 870 s. Prints
+# DOTS_PASSED=<count> (progress-dot count from the pytest tail) and
+# exits with pytest's return code. Run from anywhere; it cd's to the
+# repo root first. NOTE: JAX_PLATFORMS=cpu alone is not enough on the
+# tunnel host — unset PALLAS_AXON_POOL_IPS in your environment if a
+# sitecustomize forces the TPU platform (CLAUDE.md).
+set -u
+cd "$(dirname "$0")/.."
+
+# ROADMAP.md "Tier-1 verify", verbatim:
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

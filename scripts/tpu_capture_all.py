@@ -127,6 +127,26 @@ def main() -> int:
             record("traced-sweeps",
                    stage("traced-sweeps",
                          [sys.executable, "scripts/tpu_sweeps.py"]))
+            # jax-free analytics over what the traced stages just wrote:
+            # the merged straggler summary plus the self-contained HTML
+            # dashboard (obs/metrics.py, obs/report_html.py) — cheap,
+            # no kernels, safe even if a traced stage half-failed
+            trace_files = sorted(
+                os.path.join("traces", f)
+                for f in os.listdir(os.path.join(REPO, "traces"))
+                if f.endswith(".trace.jsonl"))
+            if trace_files:
+                record("trace-summary",
+                       stage("trace-summary",
+                             [sys.executable, "-m", "tpu_aggcomm.cli",
+                              "inspect", "trace"] + trace_files))
+                # trace files must precede --out: argparse cannot match a
+                # nargs="*" positional split across an optional
+                record("trace-report",
+                       stage("trace-report",
+                             [sys.executable, "-m", "tpu_aggcomm.cli",
+                              "inspect", "report"] + trace_files
+                             + ["--out", "traces/report.html"]))
     else:
         # gated tests and the followup batch ALSO launch kernels — the
         # compile-before-any-kernel invariant gates everything

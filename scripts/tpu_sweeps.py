@@ -46,6 +46,20 @@ def _cell_trace(tag: str):
             print(f"    trace: {paths[0]}", flush=True)
 
 
+def _record_cell(**rec) -> None:
+    """Compare-ready per-cell record, opt-in via TPU_AGGCOMM_TRACE=1:
+    appends one ``{n,a,m,c,d,per_rep,samples}`` JSON line to
+    ``traces/sweep_cells.jsonl``. ``samples`` is the backend's per-trial
+    differenced evidence (``last_samples``) — two such grids diff with
+    real CIs instead of bare medians. Off by default: no file I/O."""
+    if not os.environ.get("TPU_AGGCOMM_TRACE"):
+        return
+    import json
+    os.makedirs("traces", exist_ok=True)
+    with open(os.path.join("traces", "sweep_cells.jsonl"), "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+
+
 GRIDS = [
     # (nprocs, cb_nodes, methods, comm_sizes)
     (32, 14, (1, 2), (1, 2, 4, 8, 16, 32, 999_999_999)),
@@ -80,6 +94,8 @@ def main() -> int:
                     recv, timers = backend.run(sched, ntimes=1, verify=True,
                                                chained=True)
                 per_rep = timers[0].total_time
+                _record_cell(n=n, a=a, m=m, c=c, d=D, per_rep=per_rep,
+                             samples=backend.last_samples)
                 row.append((c, per_rep))
                 key = (n, m)
                 if key not in best or per_rep < best[key]:

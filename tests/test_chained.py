@@ -31,6 +31,29 @@ def test_differenced_positive_and_finite():
     assert np.isfinite(v) and v > 0
 
 
+def test_differenced_records_samples_instant(tmp_path):
+    """With tracing on, the accepted trial set lands in the event log as
+    ONE ``chained.samples`` instant — the evidence obs/compare.py
+    bootstraps whole-rep deltas from."""
+    import jax
+
+    from tpu_aggcomm.obs import trace
+    from tpu_aggcomm.obs.trace import load_events
+
+    x0 = jax.device_put(np.zeros((64, 256), np.uint32))
+    trace.enable()
+    try:
+        per = differenced_trials(_factory(), x0, iters_small=5,
+                                 iters_big=505, trials=2, windows=1)
+        paths = trace.flush(str(tmp_path / "ch"))
+    finally:
+        trace.disable()
+    insts = [e for e in load_events(paths[0])
+             if e["ev"] == "instant" and e["name"] == "chained.samples"]
+    assert len(insts) == 1
+    assert insts[0]["args"]["samples"] == per
+
+
 def test_differenced_rejects_bad_lengths():
     import jax
     x0 = jax.device_put(np.zeros((4, 4), np.uint32))

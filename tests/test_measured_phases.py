@@ -143,16 +143,17 @@ def test_prefix_measurements_shared_between_apis(monkeypatch):
     import tpu_aggcomm.harness.chained as chained_mod
 
     calls = {"n": 0}
-    real = chained_mod.differenced_per_rep
+    real = chained_mod.differenced_trials
 
     def counting(*a, **k):
         calls["n"] += 1
         return real(*a, **k)
 
-    # both binding sites: the chained module's own name (used by
-    # differenced_round_times) and jax_sim's module-level import
-    monkeypatch.setattr(chained_mod, "differenced_per_rep", counting)
-    monkeypatch.setattr(sim_mod, "differenced_per_rep", counting)
+    # every chain measurement — full-rep (measure_per_rep keeps the raw
+    # trial samples) or prefix (via differenced_per_rep) — bottoms out in
+    # differenced_trials; count there, at both binding sites
+    monkeypatch.setattr(chained_mod, "differenced_trials", counting)
+    monkeypatch.setattr(sim_mod, "differenced_trials", counting)
     b = JaxSimBackend()                    # fresh caches
     sched = compile_method(1, AggregatorPattern(
         nprocs=8, cb_nodes=3, data_size=64, comm_size=4))   # 2 rounds

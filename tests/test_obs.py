@@ -78,7 +78,9 @@ def test_obs_package_imports_no_jax():
     tunnel hangs ``import jax``); obs must stay importable there."""
     r = subprocess.run(
         [sys.executable, "-c",
-         "import tpu_aggcomm.obs, tpu_aggcomm.obs.regress, sys; "
+         "import tpu_aggcomm.obs, tpu_aggcomm.obs.regress, "
+         "tpu_aggcomm.obs.metrics, tpu_aggcomm.obs.compare, "
+         "tpu_aggcomm.obs.report_html, tpu_aggcomm.obs.perfetto, sys; "
          "assert 'jax' not in sys.modules, 'obs imported jax'"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr

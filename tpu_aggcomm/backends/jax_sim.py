@@ -32,6 +32,7 @@ counts — the same methodology as bench.py, shared here for every method.
 
 from __future__ import annotations
 
+import statistics
 import time
 
 import numpy as np
@@ -47,7 +48,8 @@ from tpu_aggcomm.core.schedule import Schedule
 from tpu_aggcomm.harness.attribution import (attribute_rounds,
                                              attribute_total, weights_for)
 from tpu_aggcomm.harness.chained import (MAX_MEASURED_ROUNDS,
-                                         differenced_per_rep)
+                                         differenced_per_rep,
+                                         differenced_trials)
 from tpu_aggcomm.harness.timer import Timer
 from tpu_aggcomm.harness.verify import make_send_slabs, recv_slot_counts
 from tpu_aggcomm.obs import trace
@@ -201,6 +203,10 @@ class JaxSimBackend:
         self._device = device
         self._cache: dict = {}
         self._chain_cache: dict = {}   # schedule key -> measured per-rep s
+        #: Per-trial differenced seconds behind the last measure_per_rep
+        #: result (cache hits included) — sweep scripts thread these into
+        #: compare-ready artifacts; None before any chained measurement.
+        self.last_samples: list[float] | None = None
 
     def _dev(self):
         return self._device if self._device is not None else jax.devices()[0]
@@ -1088,14 +1094,18 @@ class JaxSimBackend:
         """
         key = (self._key(schedule), iters_small, iters_big, trials, windows)
         if key in self._chain_cache:
-            return self._chain_cache[key]
+            per_rep, samples = self._chain_cache[key]
+            self.last_samples = list(samples)
+            return per_rep
         p = schedule.pattern
         dev = self._dev()
         make_chain = self._chain_factory(self._one_rep(schedule), p)
         send0 = jax.device_put(self._global_send(p, 0), dev)
-        per_rep = differenced_per_rep(make_chain, send0,
-                                      iters_small=iters_small,
-                                      iters_big=iters_big,
-                                      trials=trials, windows=windows)
-        self._chain_cache[key] = per_rep
+        samples = differenced_trials(make_chain, send0,
+                                     iters_small=iters_small,
+                                     iters_big=iters_big,
+                                     trials=trials, windows=windows)
+        per_rep = statistics.median(samples)
+        self._chain_cache[key] = (per_rep, tuple(samples))
+        self.last_samples = list(samples)
         return per_rep

@@ -185,13 +185,33 @@ def build_parser() -> argparse.ArgumentParser:
         "inspect", help="show how a method compiles for a pattern: rounds, "
                         "edges and ppermute colors per round, bytes moved, "
                         "barriers, rendezvous mode — or, with 'inspect "
-                        "trace FILE', the round/rank critical-path summary "
-                        "of a flight-recorder trace")
-    ins.add_argument("what", nargs="?", choices=["trace"], default=None,
-                     help="'trace' to summarize a *.trace.jsonl file "
-                          "instead of a compiled schedule")
-    ins.add_argument("trace_file", nargs="?", default=None,
-                     help="the *.trace.jsonl to summarize (with 'trace')")
+                        "trace FILE...', the merged round/rank straggler "
+                        "summary of flight-recorder traces; 'inspect "
+                        "compare A B [--by ...]' diffs two traces (or two "
+                        "sweep-trace directories) cell-by-cell; 'inspect "
+                        "report' writes a self-contained HTML dashboard "
+                        "over the BENCH_r*/MULTICHIP_r* history plus any "
+                        "trace files")
+    ins.add_argument("what", nargs="?", choices=["trace", "compare",
+                                                 "report"], default=None,
+                     help="'trace' to summarize *.trace.jsonl files, "
+                          "'compare' to diff two of them, 'report' for "
+                          "the HTML dashboard — instead of a compiled "
+                          "schedule")
+    ins.add_argument("trace_file", nargs="*", default=[],
+                     help="trace files: one or more to summarize "
+                          "('trace'), exactly two files or directories to "
+                          "diff ('compare'), zero or more to embed in the "
+                          "dashboard ('report')")
+    ins.add_argument("--by", choices=["rank", "round", "phase"],
+                     default="rank",
+                     help="compare grouping key (default: rank)")
+    ins.add_argument("--out", default="report.html",
+                     help="output path for 'inspect report' "
+                          "(default: report.html)")
+    ins.add_argument("--history-root", default=".",
+                     help="directory holding BENCH_r*/MULTICHIP_r*.json "
+                          "for 'inspect report' (default: .)")
     ins.add_argument("-n", "--nprocs", type=int, default=32)
     ins.add_argument("-m", dest="method", type=int, default=None)
     ins.add_argument("-a", dest="cb_nodes", type=int, default=1)
@@ -550,10 +570,29 @@ def _run_inspect(args) -> int:
     answered statically."""
     if args.what == "trace":
         if not args.trace_file:
-            raise SystemExit("inspect trace: missing trace file "
-                             "(a *.trace.jsonl written by --trace)")
-        from tpu_aggcomm.obs.trace import summarize_trace
-        print(summarize_trace(args.trace_file), end="")
+            raise SystemExit("inspect trace: missing trace file(s) "
+                             "(*.trace.jsonl written by --trace)")
+        from tpu_aggcomm.obs.metrics import summarize_traces
+        print(summarize_traces(args.trace_file), end="")
+        return 0
+    if args.what == "compare":
+        if len(args.trace_file) != 2:
+            raise SystemExit("inspect compare: need exactly two trace "
+                             "files (or two sweep-trace directories)")
+        from tpu_aggcomm.obs.compare import (TraceCompareError,
+                                             compare_paths, render_compare)
+        try:
+            res = compare_paths(args.trace_file[0], args.trace_file[1],
+                                by=args.by)
+        except TraceCompareError as e:
+            raise SystemExit(f"inspect compare: {e}")
+        print(render_compare(res), end="")
+        return 0
+    if args.what == "report":
+        from tpu_aggcomm.obs.report_html import write_report
+        path = write_report(args.out, history_root=args.history_root,
+                            trace_paths=args.trace_file)
+        print(f"report written: {path}")
         return 0
     if args.method is None:
         raise SystemExit("inspect: -m is required "

@@ -62,7 +62,8 @@ from tpu_aggcomm.harness.timer import Timer
 __all__ = ["POST_COST_BYTES", "attribute_total", "attribute_rounds",
            "attribute_round_splits", "attribute_measured_split",
            "rank_round_weights", "tam_rank_weights", "attribute_tam_total",
-           "attribute_tam_hops", "weights_for", "cell_recording"]
+           "attribute_tam_hops", "weights_for", "cell_recording",
+           "CELL_LABELS"]
 
 #: Per-call overhead of posting one nonblocking op / one pure-sync wait /
 #: one barrier, expressed in byte-equivalents of transfer time. See module
@@ -87,13 +88,18 @@ _BLOCKING = (OpKind.SEND, OpKind.RECV, OpKind.SENDRECV, OpKind.SIGNAL_RECV)
 
 _CELL_SINK: list | None = None
 
-_CELL_LABELS = {
+#: TimerBucket -> flight-recorder cell label. The label vocabulary the
+#: obs layer analyzes (obs/trace.py BUCKET_FIELDS mirrors the values) —
+#: public so analytics code names buckets without importing jax-adjacent
+#: schedule enums at runtime.
+CELL_LABELS = {
     TimerBucket.POST: "post",
     TimerBucket.SEND_WAIT: "send_wait",
     TimerBucket.RECV_WAIT: "recv_wait",
     TimerBucket.RECV_AND_SEND_WAIT: "recv+send_wait",
     TimerBucket.BARRIER: "barrier",
 }
+_CELL_LABELS = CELL_LABELS
 
 #: cell round label for charges with no per-round decomposition
 WHOLE_REP = -1

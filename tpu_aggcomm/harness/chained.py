@@ -101,6 +101,10 @@ def differenced_trials(chain_factory, send0, *, iters_small: int,
                 f"differenced timing unstable: T({iters_big})={t_b:.6f}s <= "
                 f"T({iters_small})={t_s:.6f}s repeatedly — increase "
                 f"iters_big or reduce link noise")
+    # the accepted trial set, as one instant: obs/compare.py bootstraps
+    # whole-rep deltas from this when both sides of a diff carry it
+    trace.instant("chained.samples", iters_small=iters_small,
+                  iters_big=iters_big, samples=list(per))
     return per
 
 

@@ -10,8 +10,19 @@ The observability subsystem of the framework (ISSUE 1):
   export (one track per logical rank, one slice per throttle round,
   counter track for bytes in flight).
 - :mod:`tpu_aggcomm.obs.regress` — BENCH_r*.json / MULTICHIP_r*.json
-  schema validation and round-over-round regression checking
+  schema validation and round-over-round regression checking with a
+  bootstrap statistical gate over per-trial samples
   (``bench.py --check-regression``).
+- :mod:`tpu_aggcomm.obs.metrics` — straggler analytics: per-round
+  p50/p95/max/skew/imbalance over ranks, critical-path attribution to
+  (rank, round, phase) with PHASE_SOURCES provenance, and the seeded
+  bootstrap/sign-test statistical kernel (``cli inspect trace``).
+- :mod:`tpu_aggcomm.obs.compare` — trace diffing: per-cell deltas
+  between two recordings or two sweep-trace directories
+  (``cli inspect compare``).
+- :mod:`tpu_aggcomm.obs.report_html` — self-contained static HTML
+  dashboard over the bench history and trace files
+  (``cli inspect report``).
 
 Tracing is OFF by default and zero-cost when off: ``trace.span(...)``
 returns a shared no-op context manager, and nothing here imports jax, so

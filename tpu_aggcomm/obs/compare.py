@@ -1,0 +1,300 @@
+"""Trace diffing: per-cell deltas between two flight-recorder logs, or
+between two sweep grids of per-cell logs — jax-free.
+
+``cli inspect compare A.trace.jsonl B.trace.jsonl [--by rank|round|phase]``
+pairs the two logs' runs in recording order, refuses to compare runs of
+different methods or shapes (a delta between different programs is not a
+delta), and reports:
+
+- the max-over-ranks total of each side and its relative delta — the
+  headline the reference's MAX-reduce studies;
+- the dominant (rank, round) delta cell — WHERE the change happened,
+  with the run's PHASE_SOURCES provenance carried through;
+- a per-key table (key = rank, round, or phase) with per-cell deltas
+  and a sign test over repeated trials: per-dispatch runs record one
+  slice set per rep, so paired per-rep deltas exist and the sign test
+  says whether a cell moved consistently or just jittered. Chained
+  runs combine reps into one recorded set (no pairs — ``p`` is None),
+  but when both traces carry ``chained.samples`` instants (the
+  differenced per-trial evidence harness/chained.py records) the
+  whole-rep delta additionally gets a bootstrap CI.
+
+Directory mode: when both arguments are directories, ``*.trace.jsonl``
+files are matched by basename (a sweep grid's per-cell artifacts —
+scripts/tpu_sweeps.py writes ``traces/sweep_n*_m*_c*.trace.jsonl``) and
+each common cell is diffed; unmatched cells are listed, not ignored.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+from tpu_aggcomm.obs.metrics import (bootstrap_delta_ci, bucket_cells,
+                                     sign_test)
+from tpu_aggcomm.obs.trace import aggregate_run, load_events, round_key
+
+__all__ = ["TraceCompareError", "compare_traces", "compare_paths",
+           "render_compare", "BY_CHOICES"]
+
+BY_CHOICES = ("rank", "round", "phase")
+
+
+class TraceCompareError(ValueError):
+    """The two traces are not comparable (different methods/shapes)."""
+
+
+def _runs(events):
+    return [e for e in events if e["ev"] == "run"]
+
+
+def _check_pairable(ra: dict, rb: dict, k: int) -> None:
+    """Refuse clearly when run k of the two traces ran different
+    programs — method first (the acceptance case), then shape."""
+    if (ra["method"], ra["name"]) != (rb["method"], rb["name"]):
+        raise TraceCompareError(
+            f"cannot compare traces of different methods: run {k} is "
+            f"m={ra['method']} \"{ra['name']}\" in A but "
+            f"m={rb['method']} \"{rb['name']}\" in B — diff runs of the "
+            f"SAME method (re-run one side, or compare per-cell sweep "
+            f"artifacts of matching cells)")
+    for field in ("nprocs", "data_size", "ntimes"):
+        if ra[field] != rb[field]:
+            raise TraceCompareError(
+                f"cannot compare run {k} (m={ra['method']} "
+                f"\"{ra['name']}\"): {field} differs "
+                f"({ra[field]} in A vs {rb[field]} in B)")
+
+
+def _chained_samples(events) -> list[float] | None:
+    """The LAST ``chained.samples`` instant's per-trial seconds, if the
+    trace carries differencing evidence (harness/chained.py)."""
+    out = None
+    for e in events:
+        if e["ev"] == "instant" and e["name"] == "chained.samples":
+            s = e.get("args", {}).get("samples")
+            if isinstance(s, list) and len(s) >= 2:
+                out = [float(x) for x in s]
+    return out
+
+
+def _group(cells: dict[tuple, float], by: str) -> dict:
+    """Collapse a {(rank, round, bucket): s} rep onto the grouping key."""
+    sel = {"rank": 0, "round": 1, "phase": 2}[by]
+    out: dict = {}
+    for key, secs in cells.items():
+        out[key[sel]] = out.get(key[sel], 0.0) + secs
+    return out
+
+
+def _mean_by_key(per_rep: dict[int, dict], keyfn) -> dict:
+    acc: dict = {}
+    for cells in per_rep.values():
+        rep_acc: dict = {}
+        for key, secs in cells.items():
+            k = keyfn(key)
+            rep_acc[k] = rep_acc.get(k, 0.0) + secs
+        for k, secs in rep_acc.items():
+            acc.setdefault(k, []).append(secs)
+    return {k: sum(v) / len(v) for k, v in acc.items()}
+
+
+def _key_sort(by: str):
+    if by == "round":
+        return round_key
+    if by == "phase":
+        return str
+    return lambda k: k          # rank: ints
+
+
+def compare_traces(events_a: list[dict], events_b: list[dict],
+                   by: str = "rank") -> dict:
+    """Diff two event logs run-by-run. Raises :class:`TraceCompareError`
+    on mismatched runs; see module docstring for the result layout."""
+    if by not in BY_CHOICES:
+        raise ValueError(f"by must be one of {BY_CHOICES}")
+    runs_a, runs_b = _runs(events_a), _runs(events_b)
+    if len(runs_a) != len(runs_b):
+        raise TraceCompareError(
+            f"trace A has {len(runs_a)} runs but B has {len(runs_b)} — "
+            f"only same-shaped recordings diff cell-by-cell")
+    if not runs_a:
+        raise TraceCompareError("no runs recorded in either trace")
+    samples_a = _chained_samples(events_a)
+    samples_b = _chained_samples(events_b)
+    out = {"by": by, "runs": []}
+    for k, (ra, rb) in enumerate(zip(runs_a, runs_b)):
+        _check_pairable(ra, rb, k)
+        pa = bucket_cells(events_a, ra["id"])
+        pb = bucket_cells(events_b, rb["id"])
+        agg_a = aggregate_run(events_a, ra["id"])
+        agg_b = aggregate_run(events_b, rb["id"])
+        total_a = max((c["total"] for c in agg_a.values()), default=0.0)
+        total_b = max((c["total"] for c in agg_b.values()), default=0.0)
+
+        # dominant (rank, round) delta — computed on the full grid
+        # regardless of --by, so compare always names WHERE
+        ga = _mean_by_key(pa, lambda c: (c[0], c[1]))
+        gb = _mean_by_key(pb, lambda c: (c[0], c[1]))
+        deltas = {key: gb.get(key, 0.0) - ga.get(key, 0.0)
+                  for key in set(ga) | set(gb)}
+        dominant = None
+        if deltas:
+            dkey = max(deltas, key=lambda key: abs(deltas[key]))
+            # share denominator: the per-rep max-over-ranks wall delta
+            # from the SAME mean-across-reps grid the cell came from (the
+            # aggregate totals above are summed/scaled across reps, a
+            # different unit)
+            wall_a = _wall(ga)
+            wall_b = _wall(gb)
+            wall_delta = wall_b - wall_a
+            dominant = {
+                "rank": dkey[0], "round": dkey[1],
+                "delta_s": deltas[dkey],
+                "a_s": ga.get(dkey, 0.0), "b_s": gb.get(dkey, 0.0),
+                "share_of_total_delta": (deltas[dkey] / wall_delta
+                                         if wall_delta else None)}
+
+        # per-key table with sign tests over paired per-rep deltas
+        ka = _mean_by_key(pa, lambda c: _one(c, by))
+        kb = _mean_by_key(pb, lambda c: _one(c, by))
+        table = []
+        for key in sorted(set(ka) | set(kb), key=_key_sort(by)):
+            a_v, b_v = ka.get(key, 0.0), kb.get(key, 0.0)
+            pairs = []
+            for rep in sorted(set(pa) & set(pb)):
+                av = _group(pa[rep], by).get(key, 0.0)
+                bv = _group(pb[rep], by).get(key, 0.0)
+                pairs.append(bv - av)
+            table.append({
+                "key": key, "a_s": a_v, "b_s": b_v, "delta_s": b_v - a_v,
+                "delta_pct": ((b_v - a_v) / a_v * 100.0) if a_v else None,
+                "sign": sign_test(pairs)})
+
+        rec = {
+            "method": ra["method"], "name": ra["name"],
+            "nprocs": ra["nprocs"], "data_size": ra["data_size"],
+            "phase_source_a": ra["phase_source"],
+            "phase_source_b": rb["phase_source"],
+            "total_a_s": total_a, "total_b_s": total_b,
+            "total_delta_pct": ((total_b - total_a) / total_a * 100.0
+                                if total_a else None),
+            "dominant": dominant, "table": table}
+        if (len(runs_a) == 1 and samples_a and samples_b):
+            lo, hi = bootstrap_delta_ci(samples_a, samples_b)
+            rec["total_ci_pct"] = [lo * 100.0, hi * 100.0]
+        out["runs"].append(rec)
+    return out
+
+
+def _one(cell: tuple, by: str):
+    return cell[{"rank": 0, "round": 1, "phase": 2}[by]]
+
+
+def _wall(grid: dict) -> float:
+    """Max-over-ranks total of a {(rank, round): s} mean grid."""
+    per_rank: dict = {}
+    for (rank, _rnd), secs in grid.items():
+        per_rank[rank] = per_rank.get(rank, 0.0) + secs
+    return max(per_rank.values(), default=0.0)
+
+
+def compare_paths(path_a: str, path_b: str, by: str = "rank") -> dict:
+    """Diff two trace files, or two directories of per-cell traces
+    (matched by basename). Returns the compare result with source
+    labels attached; directory mode returns
+    ``{"grid": [...], "only_a": [...], "only_b": [...]}``."""
+    if os.path.isdir(path_a) and os.path.isdir(path_b):
+        names_a = {os.path.basename(p)
+                   for p in glob.glob(os.path.join(path_a,
+                                                   "*.trace.jsonl"))}
+        names_b = {os.path.basename(p)
+                   for p in glob.glob(os.path.join(path_b,
+                                                   "*.trace.jsonl"))}
+        common = sorted(names_a & names_b)
+        if not common:
+            raise TraceCompareError(
+                f"no matching *.trace.jsonl basenames between "
+                f"{path_a} and {path_b}")
+        grid = []
+        for name in common:
+            res = compare_traces(
+                load_events(os.path.join(path_a, name)),
+                load_events(os.path.join(path_b, name)), by=by)
+            res["a"], res["b"] = (os.path.join(path_a, name),
+                                  os.path.join(path_b, name))
+            res["cell"] = name
+            grid.append(res)
+        return {"by": by, "grid": grid,
+                "only_a": sorted(names_a - names_b),
+                "only_b": sorted(names_b - names_a)}
+    res = compare_traces(load_events(path_a), load_events(path_b), by=by)
+    res["a"], res["b"] = path_a, path_b
+    return res
+
+
+def _fmt_round(rnd) -> str:
+    from tpu_aggcomm.obs.trace import WHOLE_REP
+    if rnd == WHOLE_REP:
+        return "whole-rep"
+    return f"round {rnd}" if isinstance(rnd, int) else str(rnd)
+
+
+def _render_one(res: dict, by: str, lines: list) -> None:
+    for rec in res["runs"]:
+        lines.append(
+            f"run: m={rec['method']} \"{rec['name']}\" "
+            f"n={rec['nprocs']} d={rec['data_size']}")
+        dp = rec["total_delta_pct"]
+        lines.append(
+            f"  max-over-ranks total: A {rec['total_a_s']:.6f} s  "
+            f"B {rec['total_b_s']:.6f} s"
+            + (f"  delta {dp:+.1f}%" if dp is not None else ""))
+        if "total_ci_pct" in rec:
+            lo, hi = rec["total_ci_pct"]
+            lines.append(
+                f"  bootstrap 95% CI on whole-rep delta "
+                f"(chained trials): [{lo:+.1f}%, {hi:+.1f}%]")
+        d = rec["dominant"]
+        if d is not None:
+            share = d["share_of_total_delta"]
+            lines.append(
+                f"  dominant delta cell: rank {d['rank']}, "
+                f"{_fmt_round(d['round'])}: "
+                f"{d['delta_s']:+.6f} s "
+                f"({d['a_s']:.6f} -> {d['b_s']:.6f})"
+                + (f", {share * 100:.0f}% of total delta"
+                   if share is not None else "")
+                + f"  [src: A {rec['phase_source_a']}, "
+                  f"B {rec['phase_source_b']}]")
+        lines.append(f"  by {by}:")
+        for row in rec["table"]:
+            key = (_fmt_round(row["key"]) if by == "round"
+                   else f"rank {row['key']}" if by == "rank"
+                   else row["key"])
+            pct = (f"{row['delta_pct']:+.1f}%"
+                   if row["delta_pct"] is not None else "   n/a")
+            sg = row["sign"]
+            sig = (f"  sign p={sg['p']:.3f} (n={sg['n']})"
+                   if sg["p"] is not None else "")
+            lines.append(
+                f"    {key!s:>14}: A {row['a_s']:.6f}  "
+                f"B {row['b_s']:.6f}  {pct}{sig}")
+
+
+def render_compare(res: dict) -> str:
+    """Human-readable report of a :func:`compare_paths` result."""
+    lines = []
+    if "grid" in res:
+        lines.append(f"sweep-grid compare ({len(res['grid'])} matched "
+                     f"cells, by {res['by']}):")
+        for cell in res["grid"]:
+            lines.append(f"-- cell {cell['cell']} --")
+            _render_one(cell, res["by"], lines)
+        for side, names in (("A", res["only_a"]), ("B", res["only_b"])):
+            if names:
+                lines.append(f"only in {side}: {', '.join(names)}")
+    else:
+        lines.append(f"compare: {res['a']} vs {res['b']}")
+        _render_one(res, res["by"], lines)
+    return "\n".join(lines) + "\n"

@@ -13,6 +13,18 @@ legitimately mixes TPU rounds (~µs/rep) with CPU-fallback rounds
 "regression" that is just the fallback path. Lower is better (the
 headline metric is seconds per rep).
 
+The gate is statistical when the artifacts allow it: bench.py records
+the per-trial differenced samples (harness/chained.py) as a ``samples``
+list in its JSON line (parsed-schema v2; v1 artifacts simply lack the
+key), and when BOTH the newest round and its baseline carry at least
+``MIN_GATE_SAMPLES`` trials, the verdict uses a seeded percentile-
+bootstrap CI on the relative median delta (obs/metrics.py): a
+regression is flagged only when the point delta exceeds the tolerance
+AND the CI excludes zero — a noisy 30% blip with a CI straddling zero
+is jitter, not a regression. Without samples on either side the gate
+falls back to the point-estimate delta, and says so in the verdict
+(``gate: "point"`` + ``gate_note``).
+
 No jax anywhere here — bench.py's supervisor process imports this.
 """
 
@@ -24,12 +36,17 @@ import os
 import re
 
 __all__ = ["validate_bench", "validate_multichip", "load_history",
-           "check_regression", "DEFAULT_TOLERANCE"]
+           "check_regression", "DEFAULT_TOLERANCE", "MIN_GATE_SAMPLES"]
 
 #: Relative slowdown vs the best prior same-platform round that counts as
 #: a regression. Differenced-chain numbers jitter a few percent
 #: (harness/chained.py); 25% headroom keeps noise out of the signal.
 DEFAULT_TOLERANCE = 0.25
+
+#: Fewest per-trial samples per side for the bootstrap gate — below
+#: this a CI over resamples is theater, so the gate falls back to the
+#: point estimate (and notes it in the verdict).
+MIN_GATE_SAMPLES = 3
 
 
 def _require(obj: dict, key: str, types, errors: list[str],
@@ -53,7 +70,9 @@ def validate_bench(obj, where: str = "BENCH") -> list[str]:
     value:number|null, unit:str, ...}}``. ``parsed`` is the bench.py
     one-JSON-line output when rc==0 and the line parsed; extra keys
     (vs_baseline, platform, tpu_error, tpu_attempts, error) are typed
-    but optional."""
+    but optional, as is ``samples`` (parsed-schema v2: the per-trial
+    differenced seconds behind ``value`` — must be a non-empty list of
+    numbers when present; v1 artifacts predate it)."""
     errors: list[str] = []
     if not isinstance(obj, dict):
         return [f"{where}: top level must be an object"]
@@ -81,6 +100,13 @@ def validate_bench(obj, where: str = "BENCH") -> list[str]:
                 and not isinstance(parsed[opt], types):
             errors.append(f"{w}: optional key {opt!r} has wrong type "
                           f"{type(parsed[opt]).__name__}")
+    if "samples" in parsed and parsed["samples"] is not None:
+        s = parsed["samples"]
+        if not isinstance(s, list) or not s or not all(
+                isinstance(x, (int, float)) and not isinstance(x, bool)
+                for x in s):
+            errors.append(f"{w}: optional key 'samples' must be a "
+                          f"non-empty list of numbers")
     return errors
 
 
@@ -101,20 +127,44 @@ def validate_multichip(obj, where: str = "MULTICHIP") -> list[str]:
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")
 
 
-def load_history(root: str = ".", kind: str = "BENCH"
+def load_history(root: str = ".", kind: str = "BENCH", *,
+                 errors: list[str] | None = None
                  ) -> list[tuple[int, str, dict]]:
     """All ``<kind>_rNN.json`` under ``root`` as (round, path, blob),
-    sorted by round. Unparsable JSON raises — a corrupt artifact should
-    fail loudly, not vanish from the history."""
+    sorted by round. A missing or empty directory is an empty history,
+    not an error. Unparsable JSON raises by default — a corrupt
+    artifact should fail loudly, not vanish from the history — unless
+    the caller passes an ``errors`` list, in which case the corruption
+    is recorded there (one message per bad artifact) and the rest of
+    the history still loads: ``check_regression`` uses this so a single
+    mangled artifact yields a schema-error verdict (one JSON line,
+    nonzero exit) instead of a naked traceback."""
     out = []
     for path in glob.glob(os.path.join(root, f"{kind}_r*.json")):
         m = _ROUND_RE.search(os.path.basename(path))
         if not m:
             continue
-        with open(path) as fh:
-            out.append((int(m.group(1)), path, json.load(fh)))
+        try:
+            with open(path) as fh:
+                out.append((int(m.group(1)), path, json.load(fh)))
+        except ValueError as e:
+            if errors is None:
+                raise
+            errors.append(f"{os.path.basename(path)}: unparsable JSON "
+                          f"({e})")
     out.sort(key=lambda t: t[0])
     return out
+
+
+def _gate_samples(parsed: dict):
+    """The parsed blob's per-trial samples if usable for the bootstrap
+    gate (a list of >= MIN_GATE_SAMPLES numbers), else None."""
+    s = parsed.get("samples")
+    if (isinstance(s, list) and len(s) >= MIN_GATE_SAMPLES
+            and all(isinstance(x, (int, float))
+                    and not isinstance(x, bool) for x in s)):
+        return [float(x) for x in s]
+    return None
 
 
 def check_regression(root: str = ".",
@@ -127,19 +177,28 @@ def check_regression(root: str = ".",
         {"check": "regression", "ok": bool, "rounds": N,
          "schema_errors": [...], "current": {...} | null,
          "baseline": {...} | null, "delta_pct": float | null,
-         "tolerance_pct": float, "history": [...]}
+         "tolerance_pct": float, "gate": "bootstrap"|"point"|null,
+         "gate_note": str | null, "ci_delta_pct": [lo, hi] | null,
+         "history": [...]}
 
-    ``ok`` is False only when the newest measurable round is more than
-    ``tolerance`` slower than the best prior comparable round, or when
-    any artifact fails schema validation. No prior comparable round (or
+    ``ok`` is False only when the newest measurable round regresses
+    against the best prior comparable round, or when any artifact fails
+    schema validation (an unparsable artifact counts as a schema
+    error). The regression test itself: with >= MIN_GATE_SAMPLES
+    per-trial samples on BOTH sides, the point delta must exceed
+    ``tolerance`` AND the seeded 95% bootstrap CI on the relative
+    median delta must exclude zero (``gate: "bootstrap"``); otherwise
+    the point delta alone decides and ``gate_note`` records which side
+    lacked samples (``gate: "point"``). No prior comparable round (or
     no measurable current round) is ok=True with delta_pct null — a
-    missing baseline is not a regression.
+    missing or empty history is not a regression.
     """
     schema_errors: list[str] = []
-    history = load_history(root, "BENCH")
+    history = load_history(root, "BENCH", errors=schema_errors)
     for rnd, path, blob in history:
         schema_errors += validate_bench(blob, os.path.basename(path))
-    for rnd, path, blob in load_history(root, "MULTICHIP"):
+    for rnd, path, blob in load_history(root, "MULTICHIP",
+                                        errors=schema_errors):
         schema_errors += validate_multichip(blob, os.path.basename(path))
 
     measurable = [
@@ -148,7 +207,8 @@ def check_regression(root: str = ".",
         and isinstance(blob["parsed"].get("value"), (int, float))]
     rows = [{"round": rnd, "metric": p["metric"],
              "platform": p.get("platform", "unknown"),
-             "value": p["value"], "unit": p.get("unit", "")}
+             "value": p["value"], "unit": p.get("unit", ""),
+             "samples": _gate_samples(p)}
             for rnd, _path, p in measurable]
 
     verdict: dict = {"check": "regression", "ok": True,
@@ -157,10 +217,13 @@ def check_regression(root: str = ".",
                      "current": None, "baseline": None,
                      "delta_pct": None,
                      "tolerance_pct": tolerance * 100.0,
+                     "gate": None, "gate_note": None,
+                     "ci_delta_pct": None,
                      "history": rows}
     if schema_errors:
         verdict["ok"] = False
     if not rows:
+        verdict["gate_note"] = "no measurable bench history"
         return verdict
     cur = rows[-1]
     verdict["current"] = cur
@@ -168,11 +231,35 @@ def check_regression(root: str = ".",
              if r["metric"] == cur["metric"]
              and r["platform"] == cur["platform"]]
     if not prior:
+        verdict["gate_note"] = "no prior comparable round"
         return verdict
     best = min(prior, key=lambda r: r["value"])
     verdict["baseline"] = best
     delta = (cur["value"] - best["value"]) / best["value"]
     verdict["delta_pct"] = delta * 100.0
-    if delta > tolerance:
-        verdict["ok"] = False
+
+    if cur["samples"] and best["samples"]:
+        from tpu_aggcomm.obs.metrics import bootstrap_delta_ci
+        lo, hi = bootstrap_delta_ci(best["samples"], cur["samples"],
+                                    relative=True, seed=0)
+        verdict["gate"] = "bootstrap"
+        verdict["ci_delta_pct"] = [lo * 100.0, hi * 100.0]
+        # statistically significant (CI excludes zero on the slow side)
+        # AND practically significant (beyond the noise tolerance)
+        if delta > tolerance and lo > 0:
+            verdict["ok"] = False
+        elif delta > tolerance:
+            verdict["gate_note"] = (
+                "point delta exceeds tolerance but bootstrap CI "
+                "includes zero — not flagged")
+    else:
+        missing = ("baseline" if cur["samples"] else
+                   "current" if best["samples"] else
+                   "current and baseline")
+        verdict["gate"] = "point"
+        verdict["gate_note"] = (
+            f"samples missing on {missing} round(s); "
+            f"point-estimate delta only")
+        if delta > tolerance:
+            verdict["ok"] = False
     return verdict

@@ -1,0 +1,384 @@
+"""Static HTML dashboard over the bench history and trace files — jax-free.
+
+``cli inspect report [--out report.html] [--history-root DIR] [TRACE...]``
+writes ONE self-contained file: no external assets, no CDN, no
+dependencies — the data is inlined as JSON and a few hundred lines of
+vanilla JS render it. The file must stay viewable from a bare
+``file://`` open on a machine with no network, because the TPU build
+host is exactly that.
+
+Three panels:
+
+- **trajectory** — the headline metric per growth round from the
+  checked-in ``BENCH_r*.json`` artifacts, one SVG polyline per platform
+  (the history legitimately mixes TPU ~µs rounds with CPU-fallback
+  ~tens-of-µs rounds; plotting them as one line would be the cross-
+  platform comparison obs/regress.py exists to refuse). Rounds carrying
+  per-trial ``samples`` get min/max whiskers. MULTICHIP status rides
+  along as a per-round ok/skip marker row.
+- **per-method skew table** — for every run of every trace file passed
+  in: worst-round skew (max/mean over ranks), imbalance share, the
+  critical rank, and the dominant (round, phase) cell with its
+  PHASE_SOURCES provenance label (obs/metrics.py).
+- **straggler heatmap** — the (rank x round) mean-seconds grid per run,
+  colored relative to the run's own hottest cell, so the straggler is
+  visible at a glance.
+
+Empty inputs degrade to an honest "no data" panel, never a broken page.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tpu_aggcomm.obs.metrics import (cell_means, critical_path, round_stats,
+                                     run_events)
+from tpu_aggcomm.obs.regress import load_history
+from tpu_aggcomm.obs.trace import load_events, round_key
+
+__all__ = ["write_report", "build_payload", "render_html"]
+
+
+def _history_rows(root: str) -> tuple[list[dict], list[str]]:
+    errors: list[str] = []
+    rows = []
+    for rnd, path, blob in load_history(root, "BENCH", errors=errors):
+        p = blob.get("parsed")
+        if not isinstance(p, dict):
+            rows.append({"round": rnd, "value": None, "platform": None,
+                         "unit": None, "samples": None,
+                         "file": os.path.basename(path)})
+            continue
+        s = p.get("samples")
+        rows.append({
+            "round": rnd,
+            "value": p.get("value"),
+            "platform": p.get("platform", "unknown"),
+            "unit": p.get("unit", "s"),
+            "vs_baseline": p.get("vs_baseline"),
+            "samples": s if isinstance(s, list) else None,
+            "file": os.path.basename(path)})
+    return rows, errors
+
+
+def _multichip_rows(root: str, errors: list[str]) -> list[dict]:
+    return [{"round": rnd, "ok": blob.get("ok"),
+             "skipped": blob.get("skipped"),
+             "n_devices": blob.get("n_devices")}
+            for rnd, _path, blob in load_history(root, "MULTICHIP",
+                                                 errors=errors)]
+
+
+def _round_label(rnd) -> str:
+    from tpu_aggcomm.obs.trace import WHOLE_REP
+    if rnd == WHOLE_REP:
+        return "whole-rep"
+    return str(rnd)
+
+
+def _trace_runs(paths: list[str]) -> list[dict]:
+    """Per-run analytics bundles for the skew table and heatmap, JSON-
+    ready (round keys stringified; grids as row-major lists)."""
+    out = []
+    for path in paths:
+        events = load_events(path)
+        for run in run_events(events):
+            rid = run["id"]
+            stats = round_stats(events, rid)
+            cp = critical_path(events, rid)
+            grid = cell_means(events, rid)
+            ranks = sorted({rank for rank, _ in grid})
+            rounds = sorted({rnd for _, rnd in grid}, key=round_key)
+            cells = [[grid.get((rank, rnd)) for rnd in rounds]
+                     for rank in ranks]
+            worst = max(
+                (s for s in stats if s["skew"] is not None),
+                key=lambda s: s["skew"], default=None)
+            out.append({
+                "file": path, "run": rid,
+                "method": run["method"], "name": run["name"],
+                "nprocs": run["nprocs"], "data_size": run["data_size"],
+                "phase_source": run["phase_source"],
+                "worst_skew": worst["skew"] if worst else None,
+                "worst_skew_round": (_round_label(worst["round"])
+                                     if worst else None),
+                "imbalance": worst["imbalance"] if worst else None,
+                "critical_rank": cp["rank"] if cp else None,
+                "total_s": cp["total"] if cp else None,
+                "dominant": ({"round": _round_label(
+                                  cp["dominant"]["round"]),
+                              "bucket": cp["dominant"]["bucket"],
+                              "seconds": cp["dominant"]["seconds"],
+                              "share": cp["dominant"]["share"]}
+                             if cp and cp["dominant"] else None),
+                "heat": {"ranks": ranks,
+                         "rounds": [_round_label(r) for r in rounds],
+                         "cells": cells}})
+    return out
+
+
+def build_payload(history_root: str = ".",
+                  trace_paths: list[str] | None = None) -> dict:
+    """The dashboard's inlined data: bench/multichip history + per-run
+    trace analytics + any history-load errors (shown, not swallowed)."""
+    bench, errors = _history_rows(history_root)
+    multichip = _multichip_rows(history_root, errors)
+    return {"bench": bench, "multichip": multichip,
+            "runs": _trace_runs(list(trace_paths or [])),
+            "errors": errors}
+
+
+_TEMPLATE = """<!DOCTYPE html>
+<html lang="en"><head><meta charset="utf-8">
+<title>tpu_aggcomm dashboard</title>
+<style>
+ body {{ font: 13px/1.5 system-ui, sans-serif; margin: 1.5em;
+        color: #222; background: #fafafa; }}
+ h1 {{ font-size: 1.3em; }} h2 {{ font-size: 1.05em; margin-top: 1.6em; }}
+ .note {{ color: #777; }}
+ .err {{ color: #a00; }}
+ table {{ border-collapse: collapse; background: #fff; }}
+ th, td {{ border: 1px solid #ddd; padding: 3px 8px; text-align: right; }}
+ th {{ background: #f0f0f0; }}
+ td.l, th.l {{ text-align: left; }}
+ svg {{ background: #fff; border: 1px solid #ddd; }}
+ .heat td {{ width: 34px; height: 18px; padding: 0; text-align: center;
+            font-size: 10px; border: 1px solid #eee; }}
+ .legend span {{ display: inline-block; margin-right: 1.2em; }}
+ .swatch {{ display: inline-block; width: 10px; height: 10px;
+           margin-right: 4px; }}
+</style></head><body>
+<h1>tpu_aggcomm — bench trajectory &amp; straggler dashboard</h1>
+<p class="note">Self-contained snapshot: data inlined at generation
+time; lower is better everywhere (seconds per rep).</p>
+<div id="errors"></div>
+<h2>Bench trajectory (per platform)</h2>
+<div id="trajectory"></div>
+<h2>Per-method skew table (trace runs)</h2>
+<div id="skew"></div>
+<h2>Straggler heatmaps (rank &times; round, mean seconds)</h2>
+<div id="heat"></div>
+<script id="data" type="application/json">{payload}</script>
+<script>
+"use strict";
+var DATA = JSON.parse(document.getElementById("data").textContent);
+var COLORS = ["#1668b0", "#c2491d", "#2e7d32", "#7b1fa2", "#8d6e63"];
+
+function el(tag, attrs, text) {{
+  var e = document.createElement(tag);
+  for (var k in (attrs || {{}})) e.setAttribute(k, attrs[k]);
+  if (text !== undefined) e.textContent = text;
+  return e;
+}}
+function fmtS(v) {{
+  if (v === null || v === undefined) return "-";
+  if (v >= 1) return v.toFixed(3) + " s";
+  if (v >= 1e-3) return (v * 1e3).toFixed(3) + " ms";
+  return (v * 1e6).toFixed(3) + " \\u00b5s";
+}}
+
+(function errors() {{
+  var host = document.getElementById("errors");
+  (DATA.errors || []).forEach(function (m) {{
+    host.appendChild(el("p", {{class: "err"}}, "history error: " + m));
+  }});
+}})();
+
+(function trajectory() {{
+  var host = document.getElementById("trajectory");
+  var rows = DATA.bench.filter(function (r) {{
+    return r.value !== null && r.value !== undefined; }});
+  if (!rows.length) {{
+    host.appendChild(el("p", {{class: "note"}},
+                        "no measurable bench history"));
+    return;
+  }}
+  var W = 640, H = 260, PAD = 48;
+  var rounds = rows.map(function (r) {{ return r.round; }});
+  var rmin = Math.min.apply(null, rounds),
+      rmax = Math.max.apply(null, rounds);
+  var lo = Infinity, hi = 0;
+  rows.forEach(function (r) {{
+    var vs = (r.samples || []).concat([r.value]);
+    vs.forEach(function (v) {{ lo = Math.min(lo, v);
+                               hi = Math.max(hi, v); }});
+  }});
+  // log scale: the history mixes ~us TPU rounds with ~tens-of-us CPU ones
+  function x(rnd) {{
+    return PAD + (rmax === rmin ? 0.5 : (rnd - rmin) / (rmax - rmin))
+               * (W - 2 * PAD);
+  }}
+  function y(v) {{
+    var t = (Math.log(v) - Math.log(lo)) /
+            Math.max(1e-12, Math.log(hi) - Math.log(lo));
+    return H - PAD - t * (H - 2 * PAD);
+  }}
+  var NS = "http://www.w3.org/2000/svg";
+  var svg = document.createElementNS(NS, "svg");
+  svg.setAttribute("width", W); svg.setAttribute("height", H);
+  [lo, Math.sqrt(lo * hi), hi].forEach(function (v) {{
+    var t = document.createElementNS(NS, "text");
+    t.setAttribute("x", 4); t.setAttribute("y", y(v) + 4);
+    t.setAttribute("font-size", "10"); t.textContent = fmtS(v);
+    svg.appendChild(t);
+  }});
+  var platforms = [];
+  rows.forEach(function (r) {{
+    if (platforms.indexOf(r.platform) < 0) platforms.push(r.platform);
+  }});
+  platforms.forEach(function (plat, pi) {{
+    var pts = rows.filter(function (r) {{ return r.platform === plat; }});
+    var color = COLORS[pi % COLORS.length];
+    var line = document.createElementNS(NS, "polyline");
+    line.setAttribute("points", pts.map(function (r) {{
+      return x(r.round) + "," + y(r.value); }}).join(" "));
+    line.setAttribute("fill", "none");
+    line.setAttribute("stroke", color);
+    line.setAttribute("stroke-width", "1.5");
+    svg.appendChild(line);
+    pts.forEach(function (r) {{
+      if (r.samples && r.samples.length) {{
+        var w = document.createElementNS(NS, "line");
+        w.setAttribute("x1", x(r.round)); w.setAttribute("x2", x(r.round));
+        w.setAttribute("y1", y(Math.min.apply(null, r.samples)));
+        w.setAttribute("y2", y(Math.max.apply(null, r.samples)));
+        w.setAttribute("stroke", color); w.setAttribute("stroke-width", "1");
+        svg.appendChild(w);
+      }}
+      var c = document.createElementNS(NS, "circle");
+      c.setAttribute("cx", x(r.round)); c.setAttribute("cy", y(r.value));
+      c.setAttribute("r", 3); c.setAttribute("fill", color);
+      var title = document.createElementNS(NS, "title");
+      title.textContent = "r" + r.round + " [" + r.platform + "]: " +
+                          fmtS(r.value);
+      c.appendChild(title);
+      svg.appendChild(c);
+      var t = document.createElementNS(NS, "text");
+      t.setAttribute("x", x(r.round) - 6);
+      t.setAttribute("y", H - PAD + 14);
+      t.setAttribute("font-size", "10");
+      t.textContent = "r" + r.round;
+      svg.appendChild(t);
+    }});
+  }});
+  host.appendChild(svg);
+  var legend = el("div", {{class: "legend"}});
+  platforms.forEach(function (plat, pi) {{
+    var s = el("span");
+    var sw = el("span", {{class: "swatch"}});
+    sw.style.background = COLORS[pi % COLORS.length];
+    s.appendChild(sw);
+    s.appendChild(document.createTextNode(plat));
+    legend.appendChild(s);
+  }});
+  host.appendChild(legend);
+  if (DATA.multichip.length) {{
+    var mc = DATA.multichip.map(function (m) {{
+      return "r" + m.round + ":" +
+             (m.skipped ? "skip" : (m.ok ? "ok" : "FAIL"));
+    }}).join("  ");
+    host.appendChild(el("p", {{class: "note"}}, "multichip: " + mc));
+  }}
+}})();
+
+(function skewTable() {{
+  var host = document.getElementById("skew");
+  if (!DATA.runs.length) {{
+    host.appendChild(el("p", {{class: "note"}},
+        "no trace files passed — rerun with trace paths to populate"));
+    return;
+  }}
+  var tbl = el("table");
+  var hr = el("tr");
+  ["trace", "m", "name", "n", "total", "worst skew (round)",
+   "imbalance", "critical rank", "dominant cell", "provenance"]
+    .forEach(function (h, i) {{
+      hr.appendChild(el("th", i < 3 ? {{class: "l"}} : {{}}, h)); }});
+  tbl.appendChild(hr);
+  DATA.runs.forEach(function (r) {{
+    var tr = el("tr");
+    tr.appendChild(el("td", {{class: "l"}}, r.file + " #" + r.run));
+    tr.appendChild(el("td", {{class: "l"}}, String(r.method)));
+    tr.appendChild(el("td", {{class: "l"}}, r.name));
+    tr.appendChild(el("td", {{}}, String(r.nprocs)));
+    tr.appendChild(el("td", {{}}, fmtS(r.total_s)));
+    tr.appendChild(el("td", {{}}, r.worst_skew === null ? "-" :
+        r.worst_skew.toFixed(2) + " (" + r.worst_skew_round + ")"));
+    tr.appendChild(el("td", {{}}, r.imbalance === null ? "-" :
+        (r.imbalance * 100).toFixed(1) + "%"));
+    tr.appendChild(el("td", {{}}, r.critical_rank === null ? "-" :
+        String(r.critical_rank)));
+    tr.appendChild(el("td", {{class: "l"}}, r.dominant ?
+        r.dominant.round + " [" + r.dominant.bucket + "] " +
+        fmtS(r.dominant.seconds) +
+        (r.dominant.share !== null ?
+         " (" + (r.dominant.share * 100).toFixed(0) + "%)" : "")
+        : "-"));
+    tr.appendChild(el("td", {{class: "l"}}, r.phase_source));
+    tbl.appendChild(tr);
+  }});
+  host.appendChild(tbl);
+}})();
+
+(function heatmaps() {{
+  var host = document.getElementById("heat");
+  var any = false;
+  DATA.runs.forEach(function (r) {{
+    if (!r.heat.ranks.length) return;
+    any = true;
+    host.appendChild(el("p", {{}}, r.file + " #" + r.run +
+        " — m=" + r.method + " \\"" + r.name + "\\""));
+    var mx = 0;
+    r.heat.cells.forEach(function (row) {{
+      row.forEach(function (v) {{ if (v) mx = Math.max(mx, v); }});
+    }});
+    var tbl = el("table", {{class: "heat"}});
+    var hr = el("tr");
+    hr.appendChild(el("th", {{class: "l"}}, "rank\\\\round"));
+    r.heat.rounds.forEach(function (rd) {{
+      hr.appendChild(el("th", {{}}, rd)); }});
+    tbl.appendChild(hr);
+    r.heat.ranks.forEach(function (rank, ri) {{
+      var tr = el("tr");
+      tr.appendChild(el("th", {{class: "l"}}, String(rank)));
+      r.heat.cells[ri].forEach(function (v) {{
+        var td = el("td");
+        if (v === null || v === undefined) {{
+          td.style.background = "#f5f5f5";
+        }} else {{
+          var t = mx > 0 ? v / mx : 0;
+          td.style.background =
+            "rgba(198, 40, 40," + (0.08 + 0.92 * t).toFixed(3) + ")";
+          if (t > 0.55) td.style.color = "#fff";
+          td.textContent = (v * 1e3).toFixed(1);
+          td.title = fmtS(v);
+        }}
+        tr.appendChild(td);
+      }});
+      tbl.appendChild(tr);
+    }});
+    host.appendChild(tbl);
+  }});
+  if (!any) host.appendChild(el("p", {{class: "note"}},
+      "no per-cell slices in the traces passed (or none passed)"));
+}})();
+</script></body></html>
+"""
+
+
+def render_html(payload: dict) -> str:
+    """The complete dashboard document for one payload."""
+    # "</" must not appear inside the inline <script> JSON block — a
+    # trace run name containing "</script>" would end the element early
+    blob = json.dumps(payload).replace("</", "<\\/")
+    return _TEMPLATE.format(payload=blob)
+
+
+def write_report(out_path: str, *, history_root: str = ".",
+                 trace_paths: list[str] | None = None) -> str:
+    """Build the payload and write the dashboard; returns ``out_path``."""
+    doc = render_html(build_payload(history_root, trace_paths))
+    with open(out_path, "w") as fh:
+        fh.write(doc)
+    return out_path

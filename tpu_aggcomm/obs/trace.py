@@ -37,7 +37,8 @@ import time
 
 __all__ = ["TraceRecorder", "aggregate_run", "current", "disable", "enable",
            "enabled", "flush", "instant", "span", "summarize_trace",
-           "load_events", "WHOLE_REP", "BUCKET_FIELDS"]
+           "summarize_events", "load_events", "round_key", "WHOLE_REP",
+           "BUCKET_FIELDS"]
 
 #: ``round`` value of a slice that covers the whole rep (attributions with
 #: no per-round decomposition: attribute_total, the measured post/deliver
@@ -97,15 +98,20 @@ class _HostSpan:
         return False
 
 
-def _round_key(rnd):
+def round_key(rnd):
     """Program-order sort key over mixed round labels: the whole-rep
     pseudo-round first, then integer throttle rounds, then the TAM hop
-    labels ("P2" < "P3" < "P4")."""
+    labels ("P2" < "P3" < "P4"). Public — the analytics layer
+    (obs/metrics.py, obs/compare.py) orders its tables with the exact
+    key the recorder laid slices out with."""
     if rnd is None:
         return (-1,)
     if isinstance(rnd, int):
         return (0, rnd) if rnd == WHOLE_REP else (1, rnd)
     return (2, str(rnd))
+
+
+_round_key = round_key
 
 
 class TraceRecorder:
@@ -360,7 +366,10 @@ def aggregate_run(events: list[dict], run_id: int):
     Float-exact by construction on both paths.
 
     Returns ``{rank: {"post": s, "send_wait": s, "recv_wait": s,
-    "barrier": s, "total": s}}``.
+    "barrier": s, "total": s}}``. A run that recorded no span events at
+    all (zero rounds AND zero rep envelopes — e.g. an aborted dispatch)
+    re-aggregates to the empty dict rather than raising: there is
+    nothing to rebuild, and the analytics layer treats {} as "no data".
     """
     run = next(e for e in events
                if e["ev"] == "run" and e["id"] == run_id)
@@ -379,8 +388,10 @@ def aggregate_run(events: list[dict], run_id: int):
                 cols[field] += e["dur_s"]
 
     out: dict[int, dict[str, float]] = {}
+    if not reps:
+        return out
     if combine == "scale":
-        for rank, cols in reps[0].items():
+        for rank, cols in reps.get(0, {}).items():
             out[rank] = {k: v * ntimes for k, v in cols.items()}
         return out
     for rep in sorted(reps):
@@ -400,8 +411,16 @@ def load_events(path: str) -> list[dict]:
 def summarize_trace(path: str) -> str:
     """Round/rank critical-path summary of a trace file
     (``cli inspect trace <file>``). Works on the JSONL log; a Perfetto
-    ``.trace.json`` should be opened in the Perfetto UI instead."""
-    events = load_events(path)
+    ``.trace.json`` should be opened in the Perfetto UI instead.
+    Multiple files merge via :func:`tpu_aggcomm.obs.metrics
+    .summarize_traces`."""
+    return summarize_events(load_events(path))
+
+
+def summarize_events(events: list[dict]) -> str:
+    """The per-run summary body of :func:`summarize_trace`, over an
+    already-loaded event list (so the multi-file merge can prefix each
+    file's section without re-reading)."""
     runs = [e for e in events if e["ev"] == "run"]
     lines = []
     for run in runs:
