@@ -122,6 +122,28 @@ def measure() -> int:
         return RC_CORRECTNESS
 
 
+def _rpc_probe_s(dev) -> float | None:
+    """Median round-trip of a trivial warm dispatch — the tunnel's
+    per-dispatch RPC latency on TPU (~60-90 ms historically), µs-scale
+    on local CPU. Three samples after one warm-up; cheap everywhere."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    try:
+        f = jax.jit(lambda x: x + jnp.uint32(1))
+        x = jax.device_put(np.zeros((), np.uint32), dev)
+        jax.device_get(f(x))                      # compile + warm
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.device_get(f(x))
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts)
+    except Exception:
+        return None
+
+
 def _measure_inner() -> int:
     import jax
 
@@ -129,11 +151,15 @@ def _measure_inner() -> int:
                                                    xla_exchange_chain)
     from tpu_aggcomm.core.pattern import AggregatorPattern
     from tpu_aggcomm.harness.chained import differenced_trials
+    from tpu_aggcomm.obs import ledger
 
     p = AggregatorPattern(nprocs=PROCS, cb_nodes=CB_NODES,
                           data_size=DATA_SIZE, comm_size=3)
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
+    ledger.record_device(platform=dev.platform,
+                         device_kind=getattr(dev, "device_kind", None),
+                         rpc_probe_s=_rpc_probe_s(dev))
     W = DATA_SIZE // 4
 
     def make_chain(iters):
@@ -176,6 +202,11 @@ def _measure_inner() -> int:
     per_rep = statistics.median(per_reps)
 
     gbps = PROCS * CB_NODES * DATA_SIZE / per_rep / 1e9
+    try:
+        stats = dev.memory_stats() or {}
+    except Exception:
+        stats = {}
+    hbm_peak = stats.get("peak_bytes_in_use")
     print(json.dumps({
         "metric": METRIC,
         "value": per_rep,
@@ -186,6 +217,12 @@ def _measure_inner() -> int:
         # ``value`` — obs/regress.py's bootstrap gate needs both sides'
         # trials, not just the medians
         "samples": per_reps,
+        # parsed-schema v3 (obs/ledger.py): environment provenance +
+        # compile/HBM telemetry, so every past-vs-present delta carries
+        # its own audit trail
+        "manifest": ledger.manifest(),
+        "compile_seconds": ledger.total_compile_seconds(),
+        "hbm_peak_bytes": int(hbm_peak) if hbm_peak is not None else None,
     }))
     print(f"# effective bandwidth: {gbps:.2f} GB/s pattern-bytes "
           f"on {dev.device_kind}; path={'pallas' if on_tpu else 'xla'}; "
@@ -342,6 +379,17 @@ def check_regression() -> int:
               file=sys.stderr)
     if verdict["gate_note"]:
         print(f"# gate: {verdict['gate_note']}", file=sys.stderr)
+    if verdict.get("compile_delta_pct") is not None:
+        print(f"# compile-time delta vs baseline round: "
+              f"{verdict['compile_delta_pct']:+.1f}% "
+              f"(tolerance {verdict['compile_tolerance_pct']:.0f}%)",
+              file=sys.stderr)
+    if verdict.get("compile_note"):
+        print(f"# compile gate: {verdict['compile_note']}",
+              file=sys.stderr)
+    for d in verdict.get("manifest_drift") or []:
+        print(f"# manifest drift: {d['key']}: {d['a']} -> {d['b']}",
+              file=sys.stderr)
     # the one-JSON-line stdout contract holds in this mode too; the full
     # per-round history stays on stderr
     slim = {k: v for k, v in verdict.items() if k != "history"}

@@ -14,8 +14,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpu_aggcomm.obs.regress import (load_history, validate_bench,
-                                     validate_multichip)
+from tpu_aggcomm.obs.regress import (load_history, parsed_schema_version,
+                                     validate_bench, validate_multichip)
 
 
 def check(root: str) -> int:
@@ -38,7 +38,14 @@ def check(root: str) -> int:
                 for e in errors:
                     print(f"FAIL {e}")
             else:
-                print(f"ok   {os.path.basename(path)}")
+                # v1 = point estimate only, v2 = +samples, v3 = +ledger
+                # (manifest/compile_seconds/hbm_peak_bytes) — older
+                # versions stay valid forever; the tag just shows which
+                # gates (--check-regression) each round can feed
+                ver = parsed_schema_version(blob.get("parsed")
+                                            if kind == "BENCH" else None)
+                tag = f" (schema v{ver})" if kind == "BENCH" else ""
+                print(f"ok   {os.path.basename(path)}{tag}")
     if n_files == 0:
         print(f"FAIL no BENCH_r*/MULTICHIP_r*.json found under {root}")
         return 1

@@ -5,13 +5,29 @@
 # builds meaningless).
 #
 # CPU-only, marker-filtered (-m 'not slow'), bounded at 870 s. Prints
-# DOTS_PASSED=<count> (progress-dot count from the pytest tail) and
-# exits with pytest's return code. Run from anywhere; it cd's to the
-# repo root first. NOTE: JAX_PLATFORMS=cpu alone is not enough on the
-# tunnel host — unset PALLAS_AXON_POOL_IPS in your environment if a
-# sitecustomize forces the TPU platform (CLAUDE.md).
+# DOTS_PASSED=<count> (progress-dot count from the pytest tail), then
+# runs the jax-free supervisor checks (bench-artifact schema validation
+# + the --check-regression gate over the committed history) and exits
+# nonzero if either the suite or a post-step failed. Run from anywhere;
+# it cd's to the repo root first. NOTE: JAX_PLATFORMS=cpu alone is not
+# enough on the tunnel host — unset PALLAS_AXON_POOL_IPS in your
+# environment if a sitecustomize forces the TPU platform (CLAUDE.md).
 set -u
 cd "$(dirname "$0")/.."
 
-# ROADMAP.md "Tier-1 verify", verbatim:
+# ROADMAP.md "Tier-1 verify", verbatim — in a subshell so its trailing
+# `exit $rc` yields the suite's return code here instead of ending the
+# script before the jax-free post-steps below:
+(
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+)
+rc=$?
+
+# jax-free post-steps: the same artifact gates CI's supervisor runs —
+# schema-validate the committed bench history, then the regression
+# verdict (one JSON line on stdout; gate detail lands on stderr)
+post_rc=0
+python scripts/check_bench_schema.py || post_rc=1
+python bench.py --check-regression || post_rc=1
+if [ "$rc" -eq 0 ]; then rc=$post_rc; fi
+exit $rc

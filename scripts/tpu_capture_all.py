@@ -21,6 +21,8 @@ each logged with a PASS/FAIL marker so a partial run is still evidence:
    measured rounds + TAM hops, flagship roofline on the fused lowering
 6. scripts/tpu_flagship.py      — the 16,384x256 Theta shape on one
    chip: m=1 cells + the blocked-engine TAM cell, all chained-timed
+7. cli inspect ledger           — jax-free run-ledger pass over the
+   bench history: manifests, compile seconds, HBM peaks, env drift
 
 Concurrent-discipline note: stage 3 executes BOTH disciplines (the
 probe script runs pallas_dma and pallas_dma_conc); the wave-accounting
@@ -111,6 +113,14 @@ def main() -> int:
         record("flagship",
                stage("flagship",
                      [sys.executable, "scripts/tpu_flagship.py"]))
+        # run ledger over everything the session just wrote (plus the
+        # committed history): environment manifests, compile seconds,
+        # HBM peaks, and drift between consecutive rounds — jax-free,
+        # no kernels, safe even if an earlier stage half-failed
+        record("ledger",
+               stage("ledger",
+                     [sys.executable, "-m", "tpu_aggcomm.cli",
+                      "inspect", "ledger"]))
         if os.environ.get("TPU_AGGCOMM_TRACE"):
             # opt-in flight-recorder stage (TPU_AGGCOMM_TRACE=1): one
             # traced chained jax_sim run + a traced sweep pass, leaving

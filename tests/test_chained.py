@@ -61,13 +61,38 @@ def test_differenced_rejects_bad_lengths():
         differenced_trials(_factory(), x0, iters_small=5, iters_big=5)
 
 
+def test_jax_ici_chained_rep_rows_do_not_alias():
+    """The chained branch must hand out fresh Timer objects per rep —
+    rep rows must not alias (jax_sim/jax_shard already deep-copy via
+    Timer.from_array; jax_ici used to reuse ONE list for every rep, so
+    mutating any rep's timer silently rewrote all of save_all_timing's
+    rows)."""
+    from tpu_aggcomm.backends.jax_ici import JaxIciBackend
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+
+    p = AggregatorPattern(8, 3, data_size=16, comm_size=2)
+    b = JaxIciBackend()
+    b.run(compile_method(1, p), verify=True, chained=True, ntimes=2)
+    rows = b.last_rep_timers
+    assert len(rows) == 2
+    assert rows[0] is not rows[1]
+    assert rows[0][0] is not rows[1][0]
+    before = rows[1][0].total_time
+    rows[0][0].total_time += 1.0
+    assert rows[1][0].total_time == before
+
+
 def test_differenced_raises_when_unstable(monkeypatch):
     # force every diff non-positive by monkeypatching the clock to run
-    # backwards a fixed step per call
+    # backwards an ACCELERATING step per call: a fixed step cancels to
+    # ~ulp noise whose sign depends on how many clock reads precede the
+    # timed windows (the warmup/ledger instrumentation also reads it)
+    import itertools
     import tpu_aggcomm.harness.chained as ch
     import jax
-    ticks = iter(range(10_000, 0, -1))
-    monkeypatch.setattr(ch.time, "perf_counter", lambda: next(ticks) * 1e-3)
+    ticks = (-k * k * 1e-3 for k in itertools.count())
+    monkeypatch.setattr(ch.time, "perf_counter", lambda: next(ticks))
     x0 = jax.device_put(np.zeros((4, 4), np.uint32))
     with pytest.raises(RuntimeError, match="unstable"):
         differenced_trials(_factory(), x0, iters_small=2, iters_big=50,

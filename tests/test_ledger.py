@@ -1,0 +1,341 @@
+"""Run-ledger (tpu_aggcomm/obs/ledger.py) guarantees:
+
+- the manifest carries versions from package METADATA (never an import),
+  the scrubbed env summary (arming vars by NAME only — pool IPs must
+  never land in a committed artifact), and device facts only when a
+  jax-side caller recorded them;
+- parsed-schema v3 (manifest + compile_seconds + hbm_peak_bytes)
+  validates in obs/regress.py, v1/v2 artifacts stay valid, and
+  ``parsed_schema_version`` tells them apart;
+- the ``--check-regression`` compile gate fires only when BOTH compared
+  rounds carry compile_seconds (pre-v3 history: gate inactive, said so),
+  and manifest drift between the compared rounds rides in the verdict;
+- ``cli inspect ledger`` flags injected environment drift (differing
+  jax version strings) — the ISSUE 3 acceptance pin;
+- obs.ledger / obs.regress / obs.compare and ``bench.py
+  --check-regression`` survive a POISONED jax on PYTHONPATH (a dead
+  tunnel can hang ``import jax``; the supervisor side must never try);
+- ``--xprof`` produces a divergence report without touching the timed
+  path's records.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_aggcomm.harness.hostenv import env_summary
+from tpu_aggcomm.obs import ledger
+from tpu_aggcomm.obs.regress import (check_regression,
+                                     parsed_schema_version, validate_bench)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def fresh_ledger():
+    ledger.reset()
+    yield
+    ledger.reset()
+
+
+# ----------------------------------------------------------------- manifest
+
+def test_manifest_contents_and_caching(fresh_ledger):
+    m = ledger.manifest()
+    assert m["schema"] == ledger.SCHEMA_VERSION == 3
+    assert set(m["versions"]) == {"jax", "jaxlib", "libtpu"}
+    assert m["python"].count(".") == 2
+    assert "armed_vars" in m["env"] and "tunnel_armed" in m["env"]
+    assert m["platform"] is None  # no jax-side caller recorded yet
+    # cached: collect_manifest returns the live dict, manifest() a copy
+    assert ledger.collect_manifest() is ledger.collect_manifest()
+    m["versions"]["jax"] = "tampered"
+    assert ledger.collect_manifest()["versions"]["jax"] != "tampered"
+
+
+def test_record_device_fills_manifest(fresh_ledger):
+    ledger.record_device(platform="tpu", device_kind="TPU v5e",
+                         rpc_probe_s=0.07)
+    m = ledger.manifest()
+    assert m["platform"] == "tpu"
+    assert m["device_kind"] == "TPU v5e"
+    assert m["rpc_probe_s"] == pytest.approx(0.07)
+
+
+def test_env_summary_never_records_arming_values(monkeypatch):
+    """Arming variables appear by NAME only: the pool IP value must not
+    be reproducible from any committed artifact."""
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.11.12.13")
+    s = env_summary()
+    assert "PALLAS_AXON_POOL_IPS" in s["armed_vars"]
+    assert s["tunnel_armed"] is True
+    assert "10.11.12.13" not in json.dumps(s)
+
+
+def test_compile_records_and_total(fresh_ledger):
+    ledger.record_compile("a", seconds=0.5, kind="schedule-build",
+                          backend="local", cost=None)
+    rec = ledger.record_compile("b", seconds=1.5, kind="first-dispatch")
+    assert "cost" not in ledger.compile_records()[0]  # None extras dropped
+    assert rec["kind"] == "first-dispatch"
+    assert ledger.total_compile_seconds() == pytest.approx(2.0)
+
+
+def test_hbm_peak_tracks_max(fresh_ledger):
+    assert ledger.hbm_peak() is None
+    ledger.record_hbm_peak(100)
+    ledger.record_hbm_peak(None)   # absent sample: ignored, not zeroed
+    ledger.record_hbm_peak(50)
+    assert ledger.hbm_peak() == 100
+
+
+# -------------------------------------------------------------------- drift
+
+def _manifest(jax="0.4.37", platform="cpu", sha="abc"):
+    return {"schema": 3, "python": "3.11.0",
+            "versions": {"jax": jax, "jaxlib": "0.4.36", "libtpu": None},
+            "git_sha": sha, "env": {"tunnel_armed": False},
+            "platform": platform, "device_kind": None,
+            "rpc_probe_s": 0.001, "created_unix": 1.0}
+
+
+def test_diff_manifests_flags_versions_not_ignored_keys():
+    a = _manifest(jax="0.4.37", sha="abc")
+    b = _manifest(jax="0.4.99", sha="def")
+    b["created_unix"] = 2.0
+    b["rpc_probe_s"] = 0.09
+    drift = ledger.diff_manifests(a, b)
+    assert [d["key"] for d in drift] == ["versions.jax"]
+    assert drift[0]["a"] == "0.4.37" and drift[0]["b"] == "0.4.99"
+    assert ledger.diff_manifests(a, dict(a)) == []
+    assert ledger.diff_manifests(None, b) == []  # pre-v3 side: no drift
+
+
+# ------------------------------------------------------------- schema v3
+
+def _blob(value=1e-5, platform="cpu", **parsed_extra):
+    parsed = {"metric": "m", "value": value, "unit": "s",
+              "platform": platform}
+    parsed.update(parsed_extra)
+    return {"n": 32, "cmd": "bench", "rc": 0, "tail": "", "parsed": parsed}
+
+
+def test_validate_bench_v3_fields():
+    good = _blob(manifest=_manifest(), compile_seconds=2.5,
+                 hbm_peak_bytes=1024)
+    assert validate_bench(good) == []
+    assert validate_bench(_blob(hbm_peak_bytes=None)) == []
+    assert any("manifest" in e
+               for e in validate_bench(_blob(manifest="not-a-dict")))
+    assert any("compile_seconds" in e
+               for e in validate_bench(_blob(compile_seconds=-1.0)))
+    assert any("hbm_peak_bytes" in e
+               for e in validate_bench(_blob(hbm_peak_bytes=1.5)))
+
+
+def test_parsed_schema_version():
+    assert parsed_schema_version(None) == 1
+    assert parsed_schema_version(_blob()["parsed"]) == 1
+    assert parsed_schema_version(
+        _blob(samples=[1e-5, 1e-5, 1e-5])["parsed"]) == 2
+    assert parsed_schema_version(_blob(compile_seconds=1.0)["parsed"]) == 3
+    assert parsed_schema_version(_blob(manifest=_manifest())["parsed"]) == 3
+
+
+# ------------------------------------------------------------ compile gate
+
+def _write_round(tmp_path, rnd, blob):
+    (tmp_path / f"BENCH_r{rnd:02d}.json").write_text(json.dumps(blob))
+
+
+def test_compile_gate_fires_on_regression(tmp_path):
+    _write_round(tmp_path, 1, _blob(compile_seconds=1.0))
+    _write_round(tmp_path, 2, _blob(compile_seconds=2.0))  # +100% > 50%
+    v = check_regression(str(tmp_path))
+    assert not v["ok"]
+    assert v["delta_pct"] == pytest.approx(0.0)  # runtime unchanged
+    assert v["compile_delta_pct"] == pytest.approx(100.0)
+    assert "compile time regressed" in v["compile_note"]
+
+
+def test_compile_gate_within_tolerance(tmp_path):
+    _write_round(tmp_path, 1, _blob(compile_seconds=1.0))
+    _write_round(tmp_path, 2, _blob(compile_seconds=1.2))
+    v = check_regression(str(tmp_path))
+    assert v["ok"]
+    assert v["compile_delta_pct"] == pytest.approx(20.0)
+    assert v["compile_note"] is None
+
+
+def test_compile_gate_inactive_on_pre_v3(tmp_path):
+    _write_round(tmp_path, 1, _blob())               # pre-v3 baseline
+    _write_round(tmp_path, 2, _blob(compile_seconds=99.0))
+    v = check_regression(str(tmp_path))
+    assert v["ok"]
+    assert v["compile_delta_pct"] is None
+    assert "compile gate inactive" in v["compile_note"]
+
+
+def test_verdict_carries_manifest_drift(tmp_path):
+    _write_round(tmp_path, 1, _blob(manifest=_manifest(jax="0.4.37")))
+    _write_round(tmp_path, 2, _blob(manifest=_manifest(jax="0.4.99")))
+    v = check_regression(str(tmp_path))
+    assert v["ok"]  # drift is informational, not a regression
+    assert {"key": "versions.jax", "a": "0.4.37", "b": "0.4.99"} \
+        in v["manifest_drift"]
+    # the one-JSON-line contract: no env blocks inside history rows
+    assert all("manifest" not in r for r in v["history"])
+
+
+# ------------------------------------------------------- inspect ledger CLI
+
+def test_cli_inspect_ledger_flags_injected_drift(tmp_path, capsys):
+    """ISSUE 3 acceptance pin: two artifacts with differing jax version
+    strings must produce a DRIFT line."""
+    from tpu_aggcomm.cli import main
+
+    _write_round(tmp_path, 1, _blob(manifest=_manifest(jax="0.4.37"),
+                                    compile_seconds=1.0))
+    _write_round(tmp_path, 2, _blob(manifest=_manifest(jax="0.4.99"),
+                                    compile_seconds=1.1))
+    rc = main(["inspect", "ledger",
+               str(tmp_path / "BENCH_r01.json"),
+               str(tmp_path / "BENCH_r02.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "DRIFT versions.jax: 0.4.37 -> 0.4.99" in out
+    assert "compile 1 s" in out
+
+
+def test_cli_inspect_ledger_pre_v3_and_no_drift(tmp_path, capsys):
+    from tpu_aggcomm.cli import main
+
+    _write_round(tmp_path, 1, _blob())                       # pre-v3
+    _write_round(tmp_path, 2, _blob(manifest=_manifest()))
+    _write_round(tmp_path, 3, _blob(manifest=_manifest()))
+    rc = main(["inspect", "ledger"] + [
+        str(tmp_path / f"BENCH_r{r:02d}.json") for r in (1, 2, 3)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "(no ledger: pre-v3 artifact)" in out
+    assert "no environment drift" in out
+    assert "DRIFT" not in out
+
+
+def test_load_ledger_from_trace_jsonl(tmp_path):
+    p = tmp_path / "x.trace.jsonl"
+    with open(p, "w") as fh:
+        fh.write(json.dumps({"ev": "meta", "t0": 0}) + "\n")
+        fh.write(json.dumps({"ev": "ledger",
+                             "manifest": _manifest(platform="tpu")}) + "\n")
+    ent = ledger.load_ledger(str(p))
+    assert ent["manifest"]["versions"]["jax"] == "0.4.37"
+    assert ent["platform"] == "tpu"
+
+
+# ------------------------------------------------------------- jax freedom
+
+def test_supervisor_surface_survives_poisoned_jax(tmp_path):
+    """obs.ledger / obs.regress / obs.compare and the --check-regression
+    supervisor must keep working when ``import jax`` would blow up (the
+    dead-tunnel hang, made loud)."""
+    poison = tmp_path / "jax"
+    poison.mkdir()
+    (poison / "__init__.py").write_text(
+        "raise ImportError('poisoned jax: supervisor code must not "
+        "import jax')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
+
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import tpu_aggcomm.obs.ledger, tpu_aggcomm.obs.regress, "
+         "tpu_aggcomm.obs.compare; "
+         "import tpu_aggcomm.obs.ledger as L; L.manifest()"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--check-regression"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1                     # one-JSON-line contract
+    verdict = json.loads(lines[0])
+    assert verdict["check"] == "regression" and verdict["ok"]
+
+
+# ----------------------------------------------------- harness integration
+
+def test_chained_warmup_records_compile(fresh_ledger):
+    import jax
+    import numpy as np
+
+    from tpu_aggcomm.harness.chained import differenced_trials
+
+    def chain_factory(iters):
+        import jax.numpy as jnp
+        from jax import lax
+
+        @jax.jit
+        def chain(x):
+            def body(c, r):
+                return c + r.astype(jnp.uint32), ()
+            out, _ = lax.scan(body, x,
+                              jnp.arange(iters, dtype=jnp.int32))
+            return out
+        return chain
+
+    x0 = jax.device_put(np.zeros((64, 256), np.uint32))
+    differenced_trials(chain_factory, x0, iters_small=5, iters_big=505,
+                       trials=2, windows=1)
+    recs = [r for r in ledger.compile_records()
+            if r["kind"] == "compile+warmup"]
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["seconds"] > 0
+    assert rec["warmup_small_s"] > 0 and rec["warmup_big_s"] > 0
+    # jitted chains expose .lower(): the explicit lowering wall rides too
+    assert rec["lower_seconds"] > 0
+
+
+def test_runner_records_schedule_build_and_first_dispatch(fresh_ledger):
+    from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+
+    cfg = ExperimentConfig(nprocs=8, cb_nodes=2, data_size=64, comm_size=2,
+                           method=1, ntimes=2, backend="local", verify=True,
+                           results_csv=None)
+    run_experiment(cfg, out=io.StringIO())
+    kinds = {r["kind"] for r in ledger.compile_records()}
+    assert {"schedule-build", "first-dispatch"} <= kinds
+    assert ledger.total_compile_seconds() > 0
+
+
+def test_xprof_crosscheck_reports_divergence(tmp_path, fresh_ledger):
+    """--xprof: one extra profiled rep per method, a divergence report,
+    and the timed path's records untouched (same record count/fields as
+    a plain run)."""
+    from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+
+    out = io.StringIO()
+    cfg = ExperimentConfig(nprocs=8, cb_nodes=2, data_size=64, comm_size=2,
+                           method=1, ntimes=2, backend="local", verify=True,
+                           results_csv=None, xprof=str(tmp_path / "xp"))
+    recs = run_experiment(cfg, out=out)
+    assert len(recs) == 1 and recs[0]["method"] == 1
+    reports = ledger.xprof_reports()
+    assert len(reports) == 1
+    rep = reports[0]
+    assert rep["label"].startswith("m1 ") and "[local]" in rep["label"]
+    assert rep["reconstructed_s"] > 0
+    if rep["error"] is None:
+        # column-accurate source label: device span when a device plane
+        # parsed out of the profile, host wall otherwise
+        assert rep["source"] in ("xplane-device-span",
+                                 "host-wall(profiled)")
+        assert rep["total_s"] > 0 and rep["divergence_pct"] is not None
+    assert "xprof m1" in out.getvalue()

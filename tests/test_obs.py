@@ -80,7 +80,8 @@ def test_obs_package_imports_no_jax():
         [sys.executable, "-c",
          "import tpu_aggcomm.obs, tpu_aggcomm.obs.regress, "
          "tpu_aggcomm.obs.metrics, tpu_aggcomm.obs.compare, "
-         "tpu_aggcomm.obs.report_html, tpu_aggcomm.obs.perfetto, sys; "
+         "tpu_aggcomm.obs.report_html, tpu_aggcomm.obs.perfetto, "
+         "tpu_aggcomm.obs.ledger, sys; "
          "assert 'jax' not in sys.modules, 'obs imported jax'"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr
@@ -182,6 +183,31 @@ def test_perfetto_rank_tracks(tmp_path):
              if e.get("ph") == "M" and e["name"] == "thread_name"
              and e["pid"] == RANKS_PID}
     assert {f"rank {r}" for r in range(8)} <= names
+
+
+def test_perfetto_named_tracks_and_ledger(tmp_path):
+    """Satellite 6: the export names its process/thread tracks (method +
+    backend in the process_labels metadata, a named host-timeline
+    thread) and carries the run-ledger preamble as an instant at t=0."""
+    _recs, paths = _run("jax_sim", traced=True,
+                        prefix=str(tmp_path / "nm"))
+    pf = to_chrome_trace(load_events(paths[0]))
+    evs = pf["traceEvents"]
+    meta = [e for e in evs if e.get("ph") == "M"]
+    pnames = {e["args"]["name"] for e in meta
+              if e["name"] == "process_name"}
+    assert any(n.startswith("ranks (reconstructed)") for n in pnames)
+    labels = [e["args"]["labels"] for e in meta
+              if e["name"] == "process_labels"]
+    assert labels and any("m1" in lb and "[jax_sim]" in lb
+                          for lb in labels)
+    tnames = {e["args"]["name"] for e in meta if e["name"] == "thread_name"}
+    assert "host timeline" in tnames
+    ledgers = [e for e in evs
+               if e.get("ph") == "i" and e["name"] == "ledger.manifest"]
+    assert len(ledgers) == 1 and ledgers[0]["ts"] == 0.0
+    man = ledgers[0]["args"]["manifest"]
+    assert man["schema"] >= 3 and "versions" in man
 
 
 def test_cli_inspect_trace(tmp_path, capsys):

@@ -325,7 +325,10 @@ class JaxIciBackend:
             rep_attr = attribute_total(schedule, per_rep, weights=attr_w)
             for r, t in enumerate(timers):
                 t += Timer.from_array(rep_attr[r].as_array() * ntimes)
-            self.last_rep_timers = [rep_attr for _ in range(ntimes)]
+            # fresh Timer objects per rep — rep rows must not alias
+            self.last_rep_timers = [
+                [Timer.from_array(t.as_array()) for t in rep_attr]
+                for _ in range(ntimes)]
             recv_w = np.asarray(jax.device_get(warm))[:, :n_recv_slots, :]
             recv_np = lanes_to_bytes(recv_w, p.data_size)
             recv_bufs = self._split_recv(p, recv_np)

@@ -84,6 +84,14 @@ def build_parser() -> argparse.ArgumentParser:
                             "trace') and PREFIX.trace.json (Chrome/"
                             "Perfetto). Results CSVs and console output "
                             "are unchanged; off = zero overhead")
+    bench.add_argument("--xprof", metavar="LOGDIR", default=None,
+                       help="profile ONE extra rep per method under "
+                            "jax.profiler.trace into LOGDIR and print a "
+                            "divergence report: device timeline (or "
+                            "profiled host wall) vs the reconstructed "
+                            "attribution rep — a cross-check only; the "
+                            "timed path and the reconstructed cells are "
+                            "untouched")
 
     pt = sub.add_parser("pt2pt", help="2-rank latency microbenchmark "
                                       "(mpi_sendrecv_test.c)")
@@ -191,18 +199,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "sweep-trace directories) cell-by-cell; 'inspect "
                         "report' writes a self-contained HTML dashboard "
                         "over the BENCH_r*/MULTICHIP_r* history plus any "
-                        "trace files")
+                        "trace files; 'inspect ledger [FILE...]' prints "
+                        "the run-ledger manifests of bench artifacts / "
+                        "traces and flags environment drift between "
+                        "consecutive ones")
     ins.add_argument("what", nargs="?", choices=["trace", "compare",
-                                                 "report"], default=None,
+                                                 "report", "ledger"],
+                     default=None,
                      help="'trace' to summarize *.trace.jsonl files, "
                           "'compare' to diff two of them, 'report' for "
-                          "the HTML dashboard — instead of a compiled "
-                          "schedule")
+                          "the HTML dashboard, 'ledger' for run-ledger "
+                          "manifests + environment drift — instead of a "
+                          "compiled schedule")
     ins.add_argument("trace_file", nargs="*", default=[],
                      help="trace files: one or more to summarize "
                           "('trace'), exactly two files or directories to "
                           "diff ('compare'), zero or more to embed in the "
-                          "dashboard ('report')")
+                          "dashboard ('report'); for 'ledger': "
+                          "BENCH_r*.json and/or *.trace.jsonl artifacts "
+                          "(default: every BENCH_r*.json under "
+                          "--history-root)")
     ins.add_argument("--by", choices=["rank", "round", "phase"],
                      default="rank",
                      help="compare grouping key (default: rank)")
@@ -594,6 +610,20 @@ def _run_inspect(args) -> int:
                             trace_paths=args.trace_file)
         print(f"report written: {path}")
         return 0
+    if args.what == "ledger":
+        import glob
+        import os
+
+        from tpu_aggcomm.obs import ledger
+        paths = args.trace_file or sorted(
+            glob.glob(os.path.join(args.history_root, "BENCH_r*.json")))
+        if not paths:
+            raise SystemExit(
+                "inspect ledger: no artifacts found (pass BENCH_r*.json / "
+                "*.trace.jsonl files, or point --history-root at a "
+                "directory holding BENCH_r*.json)")
+        print(ledger.render_ledgers(paths), end="")
+        return 0
     if args.method is None:
         raise SystemExit("inspect: -m is required "
                          "(or use 'inspect trace <file>')")
@@ -836,7 +866,8 @@ def main(argv=None) -> int:
         prefix=args.prefix, barrier_type=args.barrier_type,
         backend=args.backend, verify=args.verify,
         results_csv=args.results_csv, profile_rounds=args.profile_rounds,
-        chained=args.chained, measured_phases=args.measured_phases)
+        chained=args.chained, measured_phases=args.measured_phases,
+        xprof=args.xprof)
     with _tracing(args.trace):
         run_experiment(cfg)
     return 0

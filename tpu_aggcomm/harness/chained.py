@@ -47,6 +47,23 @@ def xor_word(tok, lane_dtype):
             else tok.astype(jnp.uint8))
 
 
+def _slim_cost(raw) -> dict | None:
+    """The two HLO cost-analysis numbers worth keeping (flops, bytes
+    accessed) from jax's Lowered.cost_analysis() — which returns a dict
+    on current jax, or a per-device list of dicts on older versions."""
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    if not isinstance(raw, dict):
+        return None
+    out = {}
+    for key, name in (("flops", "flops"),
+                      ("bytes accessed", "bytes_accessed")):
+        v = raw.get(key)
+        if isinstance(v, (int, float)):
+            out[name] = float(v)
+    return out or None
+
+
 def differenced_trials(chain_factory, send0, *, iters_small: int,
                        iters_big: int, trials: int = 3,
                        windows: int = 3) -> list[float]:
@@ -74,10 +91,39 @@ def differenced_trials(chain_factory, send0, *, iters_small: int,
 
     f_small = chain_factory(iters_small)
     f_big = chain_factory(iters_big)
+    # compile telemetry for the run ledger (obs/ledger.py): the explicit
+    # lower() wall (host-side tracing/StableHLO emission — jitted chains
+    # expose .lower; plain callables skip) plus guarded HLO cost stats.
+    # Never lower().compile() here: the AOT path does not share the jit
+    # dispatch cache, so it would compile the chain a SECOND time through
+    # the tunnel just to time the first.
+    lower_s = cost = None
+    if hasattr(f_big, "lower"):
+        try:
+            t0 = time.perf_counter()
+            lowered = f_big.lower(send0)
+            lower_s = time.perf_counter() - t0
+            try:
+                cost = _slim_cost(lowered.cost_analysis())
+            except Exception:
+                cost = None
+        except Exception:
+            lower_s = None
     with trace.span("chained.warmup", iters_small=iters_small,
                     iters_big=iters_big):
+        t0 = time.perf_counter()
         int(jax.device_get(checksum(f_small(send0))))    # compile + warm
+        warm_small = time.perf_counter() - t0
+        t0 = time.perf_counter()
         int(jax.device_get(checksum(f_big(send0))))
+        warm_big = time.perf_counter() - t0
+    from tpu_aggcomm.obs import ledger
+    rec = ledger.record_compile(
+        f"chain(iters={iters_small}/{iters_big})",
+        seconds=warm_small + warm_big, kind="compile+warmup",
+        lower_seconds=lower_s, cost=cost,
+        warmup_small_s=warm_small, warmup_big_s=warm_big)
+    trace.instant("ledger.compile", **rec)
     per = []
     # noise budget: a jittery link can invert a diff; keep a floor so
     # small-trials windows=1 callers (chained pt2pt with -k 1) are not

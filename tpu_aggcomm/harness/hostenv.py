@@ -14,9 +14,29 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["scrubbed_cpu_env"]
+__all__ = ["scrubbed_cpu_env", "env_summary"]
 
 _ARMING_PREFIXES = ("PALLAS_AXON", "AXON_", "TPU_")
+
+
+def env_summary() -> dict:
+    """Scrubbed environment provenance for the run ledger
+    (tpu_aggcomm/obs/ledger.py).
+
+    Tunnel-arming variables are reported by NAME only — their values
+    (pool IPs and the like) are infrastructure addresses and must never
+    land in a committed artifact. ``JAX_PLATFORMS``/``XLA_FLAGS`` values
+    are included verbatim: they are the two knobs that decide which
+    backend and device mesh produced a number, exactly what a past-vs-
+    present comparison needs to audit.
+    """
+    return {
+        "armed_vars": sorted(k for k in os.environ
+                             if k.startswith(_ARMING_PREFIXES)),
+        "tunnel_armed": bool(os.environ.get("PALLAS_AXON_POOL_IPS")),
+        "jax_platforms": os.environ.get("JAX_PLATFORMS"),
+        "xla_flags": os.environ.get("XLA_FLAGS"),
+    }
 
 
 def scrubbed_cpu_env(n_devices: int | None = None) -> dict:

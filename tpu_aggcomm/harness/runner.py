@@ -4,6 +4,9 @@ console/CSV reporting, optional verification."""
 
 from __future__ import annotations
 
+import os
+import sys
+import time
 from dataclasses import dataclass
 
 from tpu_aggcomm.backends import get_backend
@@ -13,7 +16,7 @@ from tpu_aggcomm.harness.attribution import cell_recording
 from tpu_aggcomm.harness.report import (append_provenance, config_banner,
                                         save_all_timing, summarize_results)
 from tpu_aggcomm.harness.timer import max_reduce
-from tpu_aggcomm.obs import trace
+from tpu_aggcomm.obs import ledger, trace
 
 __all__ = ["ExperimentConfig", "run_experiment"]
 
@@ -45,6 +48,11 @@ class ExperimentConfig:
     #                                jax_sim; single-round schedules fall
     #                                back to the post/deliver split on
     #                                jax_sim, attributed-chained elsewhere
+    xprof: str | None = None     # --xprof LOGDIR: profile ONE extra rep
+    #                              per method under jax.profiler.trace and
+    #                              report its divergence vs the
+    #                              reconstructed attribution (cross-check
+    #                              only — the timed path is untouched)
 
 
 def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
@@ -107,9 +115,17 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
                 f"jax_shard for a chained run-all, or pick a non-TAM "
                 f"method with -m")
     # schedules do not depend on the iteration (only the fill seed does):
-    # compile once per method, reuse across iters
-    compiled = {m: compile_method(m, pattern, barrier_type=cfg.barrier_type)
-                for m in methods}
+    # compile once per method, reuse across iters. Schedule-build walls go
+    # to the run ledger (a list append outside any timed window).
+    compiled = {}
+    for m in methods:
+        t0 = time.perf_counter()
+        compiled[m] = compile_method(m, pattern,
+                                     barrier_type=cfg.barrier_type)
+        ledger.record_compile(
+            f"m{m}:{METHODS[m].name}",
+            seconds=time.perf_counter() - t0, kind="schedule-build",
+            backend=cfg.backend)
     if cfg.measured_phases:
         # fail upfront, like the chained TAM guard: the truncation
         # measurement exists for round-structured schedules everywhere
@@ -153,6 +169,7 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             if cfg.measured_phases:
                 kwargs["measured_phases"] = True
             rec = trace.current()
+            t_dispatch = time.perf_counter()
             if rec is not None:
                 # flight recorder: capture the attribution cell stream of
                 # this backend.run (delegations included) plus a measured
@@ -168,6 +185,16 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
                 recv, timers = backend.run(sched, ntimes=cfg.ntimes,
                                            iter_=i, verify=cfg.verify,
                                            **kwargs)
+            if i == 0:
+                # first dispatch of this method = XLA compile (for the
+                # compiled backends) + the run itself; an honest wall
+                # around that compile-containing boundary for the ledger
+                # — the label says "first-dispatch", never "compile"
+                ledger.record_compile(
+                    f"m{m}:{spec.name}",
+                    seconds=time.perf_counter() - t_dispatch,
+                    kind="first-dispatch", backend=cfg.backend)
+                _sample_device(rec)
             max_timer = max_reduce(timers)
             summarize_results(cfg.nprocs, cfg.cb_nodes, cfg.data_size,
                               cfg.comm_size, cfg.ntimes, cfg.agg_type,
@@ -199,5 +226,64 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
                 "timer0": timers[0], "max_timer": max_timer,
                 "backend_executed": executed, "phase_source": phases,
             })
+            if cfg.xprof and i == 0:
+                _xprof_crosscheck(backend, sched, cfg, m, spec.name,
+                                  max_timer, out=out)
         print("| --------------------------------------", file=out)
     return records
+
+
+def _sample_device(rec) -> None:
+    """Record device facts + an HBM sample in the ledger (and, when
+    tracing, the trace's HBM counter track).
+
+    Only consults jax when a backend already imported it — the local/
+    native oracles must stay jax-free (a dead tunnel can hang any fresh
+    jax initialization, and runner-level telemetry must never change
+    which processes touch jax). ``memory_stats`` is None/raising on
+    platforms without an allocator report (CPU): recorded as absent,
+    never guessed."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+    try:
+        d = jax.devices()[0]
+        ledger.record_device(platform=d.platform,
+                             device_kind=getattr(d, "device_kind", None))
+        stats = getattr(d, "memory_stats", lambda: None)() or {}
+    except Exception:
+        return
+    peak = stats.get("peak_bytes_in_use")
+    ledger.record_hbm_peak(peak)
+    if rec is not None and stats:
+        rec.hbm_sample(bytes_in_use=stats.get("bytes_in_use"),
+                       peak_bytes=peak)
+
+
+def _xprof_crosscheck(backend, sched, cfg, method: int, name: str,
+                      max_timer, *, out=None) -> dict:
+    """``--xprof``: run ONE extra plain rep under ``jax.profiler.trace``
+    and report its divergence against the reconstructed attribution
+    (max-over-ranks total / ntimes). The extra rep runs AFTER the timed
+    run and outside every recording window, so round semantics and the
+    timed path are untouched; the reconstructed cells stay the source of
+    truth (obs/ledger.py docstring)."""
+    logdir = os.path.join(cfg.xprof, f"m{method}_{name}")
+    profiled = None
+    err = None
+    try:
+        import jax
+        t0 = time.perf_counter()
+        with jax.profiler.trace(logdir):
+            backend.run(sched, ntimes=1, iter_=0, verify=False)
+        profiled = time.perf_counter() - t0
+    except Exception as e:  # profiler or backend trouble: report, not raise
+        err = f"{type(e).__name__}: {e}"
+    recon = max_timer.total_time / max(cfg.ntimes, 1)
+    report = ledger.xprof_report(
+        label=f"m{method} {name} [{cfg.backend}]", logdir=logdir,
+        profiled_wall_s=profiled, reconstructed_s=recon, error=err)
+    trace.instant("ledger.xprof",
+                  **{k: v for k, v in report.items() if k != "logdir"})
+    print(f"| {ledger.render_xprof(report)}", file=out)
+    return report

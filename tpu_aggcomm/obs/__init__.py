@@ -23,6 +23,11 @@ The observability subsystem of the framework (ISSUE 1):
 - :mod:`tpu_aggcomm.obs.report_html` — self-contained static HTML
   dashboard over the bench history and trace files
   (``cli inspect report``).
+- :mod:`tpu_aggcomm.obs.ledger` — run ledger (ISSUE 3): environment
+  manifest (versions, git sha, scrubbed env, device identity, tunnel
+  RPC probe), per-method compile/first-dispatch telemetry, HBM peak,
+  opt-in device-profiler cross-check (``--xprof``), and manifest drift
+  detection across artifacts (``cli inspect ledger``).
 
 Tracing is OFF by default and zero-cost when off: ``trace.span(...)``
 returns a shared no-op context manager, and nothing here imports jax, so

@@ -1,0 +1,487 @@
+"""The run ledger: environment provenance + compile/HBM telemetry.
+
+Every headline number in this repo is a past-vs-present comparison —
+against the reference baseline, against prior rounds (``bench.py
+--check-regression``), between traces (``inspect compare``) — and such
+a delta is only auditable when both sides record what produced them.
+This module is that record, in three parts:
+
+- **manifest** — environment provenance captured once per process:
+  python/jax/jaxlib/libtpu versions (from package *metadata*, never by
+  importing jax), git sha, the scrubbed env summary
+  (``harness.hostenv.env_summary`` — arming variables by name only),
+  plus device facts (platform, device kind, tunnel RPC-latency probe)
+  recorded by the jax-side callers via :func:`record_device`.
+- **compile records** — wall times bracketing compilation measured by
+  ``harness/chained.py`` (chain warmup + ``lower()`` walls + HLO cost
+  stats) and ``harness/runner.py`` (schedule build, first dispatch),
+  appended via :func:`record_compile`. These are honest HOST walls
+  around compile-containing boundaries; a "compile+warmup" record means
+  compile AND one execution — the label never oversells
+  (report.py:PHASE_SOURCES discipline).
+- **xprof cross-check** — the ``--xprof`` divergence report between an
+  independently profiled rep (``jax.profiler.trace``) and the
+  reconstructed attribution total. Cross-check ONLY: reconstructed
+  cells stay the source of truth; the report exists to catch the
+  reconstruction drifting from device reality, not to replace it. The
+  device timeline total is parsed out of the profiler's ``*.xplane.pb``
+  with a minimal stdlib protobuf wire-format reader (no tensorboard /
+  tensorflow dependency — the container has neither).
+
+No jax anywhere here (like obs/metrics.py): bench.py's jax-free
+supervisor and the ``inspect ledger`` CLI import this on a machine
+where ``import jax`` may hang on a dead tunnel. Versions come from
+``importlib.metadata``, which reads dist-info without importing.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+from tpu_aggcomm.harness.hostenv import env_summary
+
+__all__ = ["SCHEMA_VERSION", "collect_manifest", "manifest",
+           "record_device", "record_compile", "compile_records",
+           "total_compile_seconds", "record_hbm_peak", "hbm_peak",
+           "reset", "diff_manifests", "DRIFT_IGNORE", "load_ledger",
+           "render_manifest", "render_ledgers", "xprof_report",
+           "xprof_reports", "render_xprof", "xplane_device_seconds"]
+
+#: The bench parsed-schema version this ledger feeds: v3 = v2 (samples)
+#: + ``manifest`` + ``compile_seconds`` + ``hbm_peak_bytes``
+#: (obs/regress.py validates all three).
+SCHEMA_VERSION = 3
+
+_MANIFEST: dict | None = None
+_COMPILES: list[dict] = []
+_XPROF: list[dict] = []
+_HBM_PEAK: int | None = None
+
+
+def _pkg_version(name: str) -> str | None:
+    try:
+        from importlib import metadata
+        return metadata.version(name)
+    except Exception:
+        return None
+
+
+def _git_sha() -> str | None:
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    try:
+        r = subprocess.run(["git", "rev-parse", "--short=12", "HEAD"],
+                           cwd=root, capture_output=True, text=True,
+                           timeout=10)
+    except Exception:
+        return None
+    return r.stdout.strip() or None if r.returncode == 0 else None
+
+
+def collect_manifest() -> dict:
+    """The process manifest, captured once and cached (the LIVE dict —
+    :func:`record_device` mutates it; external consumers should call
+    :func:`manifest` for a copy)."""
+    global _MANIFEST
+    if _MANIFEST is None:
+        _MANIFEST = {
+            "schema": SCHEMA_VERSION,
+            "python": "%d.%d.%d" % sys.version_info[:3],
+            "versions": {
+                "jax": _pkg_version("jax"),
+                "jaxlib": _pkg_version("jaxlib"),
+                "libtpu": (_pkg_version("libtpu")
+                           or _pkg_version("libtpu-nightly")),
+            },
+            "git_sha": _git_sha(),
+            "env": env_summary(),
+            "platform": None,
+            "device_kind": None,
+            "rpc_probe_s": None,
+            "created_unix": time.time(),
+        }
+    return _MANIFEST
+
+
+def manifest() -> dict:
+    """A JSON-able copy of the process manifest (device facts included
+    if a jax-side caller has recorded them)."""
+    m = collect_manifest()
+    out = dict(m)
+    out["versions"] = dict(m["versions"])
+    out["env"] = dict(m["env"])
+    return out
+
+
+def record_device(*, platform: str | None = None,
+                  device_kind: str | None = None,
+                  rpc_probe_s: float | None = None) -> None:
+    """Fill the manifest's device facts. Called from jax-side code
+    (bench.py's measure child, harness/runner.py) — the ledger itself
+    never touches jax, so these arrive as plain values."""
+    m = collect_manifest()
+    if platform is not None:
+        m["platform"] = str(platform)
+    if device_kind is not None:
+        m["device_kind"] = str(device_kind)
+    if rpc_probe_s is not None:
+        m["rpc_probe_s"] = float(rpc_probe_s)
+
+
+def record_compile(label: str, *, seconds: float, kind: str = "compile",
+                   **extra) -> dict:
+    """Append one compile-telemetry record (``seconds`` is a host wall
+    around a compile-containing boundary; ``kind`` says which boundary:
+    "schedule-build", "first-dispatch", "compile+warmup", "lower").
+    Extra keys (lower_seconds, cost, iters, backend...) ride along;
+    None values are dropped."""
+    rec = {"label": str(label), "seconds": float(seconds),
+           "kind": str(kind)}
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    _COMPILES.append(rec)
+    return rec
+
+
+def compile_records() -> list[dict]:
+    return list(_COMPILES)
+
+
+def total_compile_seconds() -> float:
+    """Total wall seconds across every compile record — the one number
+    the bench artifact carries (``compile_seconds``) and the regression
+    compile gate compares."""
+    return sum(r["seconds"] for r in _COMPILES)
+
+
+def record_hbm_peak(nbytes) -> None:
+    """Track the worst HBM peak a jax-side caller observed
+    (``device.memory_stats()['peak_bytes_in_use']``)."""
+    global _HBM_PEAK
+    if nbytes is None:
+        return
+    n = int(nbytes)
+    _HBM_PEAK = n if _HBM_PEAK is None else max(_HBM_PEAK, n)
+
+
+def hbm_peak() -> int | None:
+    return _HBM_PEAK
+
+
+def xprof_reports() -> list[dict]:
+    return list(_XPROF)
+
+
+def reset() -> None:
+    """Forget everything (tests only — the whole point of the ledger is
+    that production processes never clear it)."""
+    global _MANIFEST, _HBM_PEAK
+    _MANIFEST = None
+    _HBM_PEAK = None
+    _COMPILES.clear()
+    _XPROF.clear()
+
+
+# ---------------------------------------------------------------------------
+# Manifest diffing (environment drift between artifacts).
+
+#: Flattened-key prefixes that are EXPECTED to differ between rounds and
+#: therefore never count as environment drift: timestamps, the tunnel's
+#: per-run RPC latency, and the git sha (every round is a new commit by
+#: construction — code change is what the round IS, not drift).
+DRIFT_IGNORE = ("created_unix", "rpc_probe_s", "git_sha")
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    out: dict = {}
+    for k, v in (d or {}).items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+def diff_manifests(a: dict | None, b: dict | None) -> list[dict]:
+    """Environment drift between two manifests: ``[{"key", "a", "b"}]``
+    for every flattened key that differs, DRIFT_IGNORE keys excluded.
+    Either side None (a pre-v3 artifact) yields no drift — absence of
+    evidence is reported by the caller, not invented here."""
+    if not isinstance(a, dict) or not isinstance(b, dict):
+        return []
+    fa, fb = _flatten(a), _flatten(b)
+    drift = []
+    for k in sorted(set(fa) | set(fb)):
+        if k.startswith(DRIFT_IGNORE):
+            continue
+        va, vb = fa.get(k), fb.get(k)
+        if va != vb:
+            drift.append({"key": k, "a": va, "b": vb})
+    return drift
+
+
+# ---------------------------------------------------------------------------
+# Loading ledgers back out of artifacts.
+
+def load_ledger(path: str) -> dict:
+    """The ledger view of one artifact: ``{"file", "manifest",
+    "compile_seconds", "hbm_peak_bytes", "platform", "value"}`` (missing
+    fields None). Accepts a driver-wrapped ``BENCH_rNN.json``
+    (``{"parsed": {...}}``), a bare bench JSON line, or a
+    ``*.trace.jsonl`` event log (the ledger preamble event)."""
+    out = {"file": path, "manifest": None, "compile_seconds": None,
+           "hbm_peak_bytes": None, "platform": None, "value": None}
+    if path.endswith(".jsonl"):
+        with open(path) as fh:
+            for line in fh:
+                if not line.strip():
+                    continue
+                e = json.loads(line)
+                if e.get("ev") == "ledger":
+                    out["manifest"] = e.get("manifest")
+                    m = out["manifest"] or {}
+                    out["platform"] = m.get("platform")
+                    break
+        return out
+    with open(path) as fh:
+        blob = json.load(fh)
+    parsed = blob.get("parsed") if isinstance(blob.get("parsed"), dict) \
+        else blob if isinstance(blob, dict) else {}
+    if isinstance(parsed, dict):
+        out["manifest"] = parsed.get("manifest") \
+            if isinstance(parsed.get("manifest"), dict) else None
+        for k in ("compile_seconds", "hbm_peak_bytes", "platform", "value"):
+            out[k] = parsed.get(k, out[k])
+    return out
+
+
+def _fmt(v, unit: str = "") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}{unit}"
+    return f"{v}{unit}"
+
+
+def render_manifest(m: dict | None, indent: str = "  ") -> str:
+    """Human block for one manifest (``inspect ledger``)."""
+    if not isinstance(m, dict):
+        return f"{indent}(no ledger: pre-v3 artifact)\n"
+    v = m.get("versions") or {}
+    e = m.get("env") or {}
+    lines = [
+        f"{indent}platform {_fmt(m.get('platform'))}  "
+        f"device_kind {_fmt(m.get('device_kind'))}  "
+        f"rpc probe {_fmt(m.get('rpc_probe_s'), ' s')}",
+        f"{indent}jax {_fmt(v.get('jax'))}  jaxlib {_fmt(v.get('jaxlib'))}  "
+        f"libtpu {_fmt(v.get('libtpu'))}  python {_fmt(m.get('python'))}  "
+        f"git {_fmt(m.get('git_sha'))}",
+        f"{indent}env: JAX_PLATFORMS={_fmt(e.get('jax_platforms'))}  "
+        f"tunnel_armed={e.get('tunnel_armed')}  "
+        f"armed_vars={e.get('armed_vars')}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def render_ledgers(paths: list[str]) -> str:
+    """``inspect ledger [FILE...]``: per-artifact manifest blocks plus
+    DRIFT lines between each consecutive pair that both carry a
+    manifest — differing jax versions, platforms, or armed environments
+    between compared rounds must jump off the page."""
+    entries = [load_ledger(p) for p in paths]
+    lines: list[str] = []
+    for ent in entries:
+        lines.append(f"== {os.path.basename(ent['file'])} ==")
+        lines.append(render_manifest(ent["manifest"]).rstrip("\n"))
+        if ent["compile_seconds"] is not None \
+                or ent["hbm_peak_bytes"] is not None:
+            lines.append(
+                f"  compile {_fmt(ent['compile_seconds'], ' s')}  "
+                f"hbm peak {_fmt(ent['hbm_peak_bytes'], ' B')}")
+    prev = None
+    for ent in entries:
+        if ent["manifest"] is None:
+            continue
+        if prev is not None:
+            drift = diff_manifests(prev["manifest"], ent["manifest"])
+            a = os.path.basename(prev["file"])
+            b = os.path.basename(ent["file"])
+            lines.append(f"-- {a} -> {b} --")
+            if drift:
+                for d in drift:
+                    lines.append(f"  DRIFT {d['key']}: "
+                                 f"{_fmt(d['a'])} -> {_fmt(d['b'])}")
+            else:
+                lines.append("  no environment drift")
+        prev = ent
+    if not entries:
+        lines.append("no artifacts given")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# xprof cross-check: device timeline vs reconstructed attribution rounds.
+#
+# jax.profiler.trace writes XSpace protobufs (*.xplane.pb). The repo may
+# not install tensorboard/tensorflow, so the device timeline total is
+# recovered with a minimal protobuf wire-format walk over the stable
+# XSpace/XPlane/XLine/XEvent field numbers (xplane.proto):
+#   XSpace.planes=1; XPlane.name=2 .lines=3;
+#   XLine.timestamp_ns=3 .events=4; XEvent.offset_ps=2 .duration_ps=3.
+
+def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
+    shift = result = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def _walk(buf: bytes, start: int, end: int):
+    """Yield (field_number, wire_type, value) over one message's bytes;
+    length-delimited values come as (start, end) slices."""
+    i = start
+    while i < end:
+        tag, i = _read_varint(buf, i)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+            yield field, wt, v
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            yield field, wt, (i, i + ln)
+            i += ln
+        elif wt == 5:
+            yield field, wt, None
+            i += 4
+        elif wt == 1:
+            yield field, wt, None
+            i += 8
+        else:
+            return  # unknown wire type: stop rather than misparse
+
+
+def xplane_device_seconds(path: str) -> dict | None:
+    """The device-plane timeline span of one ``*.xplane.pb``:
+    ``{"plane", "span_s", "events"}`` for the device plane (name
+    containing "/device:") with the widest event span, or None when the
+    profile has no device plane (CPU-only profiles often don't) or the
+    file does not parse."""
+    try:
+        with open(path, "rb") as fh:
+            buf = fh.read()
+    except OSError:
+        return None
+    best = None
+    try:
+        for f, wt, v in _walk(buf, 0, len(buf)):
+            if f != 1 or wt != 2:
+                continue
+            ps, pe = v
+            name = ""
+            line_slices = []
+            for f2, wt2, v2 in _walk(buf, ps, pe):
+                if f2 == 2 and wt2 == 2:
+                    name = buf[v2[0]:v2[1]].decode(errors="replace")
+                elif f2 == 3 and wt2 == 2:
+                    line_slices.append(v2)
+            if "/device:" not in name:
+                continue
+            lo = hi = None
+            nev = 0
+            for (ls, le) in line_slices:
+                ts_ns = 0
+                ev_slices = []
+                for f3, wt3, v3 in _walk(buf, ls, le):
+                    if f3 == 3 and wt3 == 0:
+                        ts_ns = v3
+                    elif f3 == 4 and wt3 == 2:
+                        ev_slices.append(v3)
+                for (es, ee) in ev_slices:
+                    off = dur = None
+                    for f4, wt4, v4 in _walk(buf, es, ee):
+                        if f4 == 2 and wt4 == 0:
+                            off = v4
+                        elif f4 == 3 and wt4 == 0:
+                            dur = v4
+                    if off is None:
+                        continue
+                    start_ps = ts_ns * 1000 + off
+                    end_ps = start_ps + (dur or 0)
+                    lo = start_ps if lo is None else min(lo, start_ps)
+                    hi = end_ps if hi is None else max(hi, end_ps)
+                    nev += 1
+            if nev and hi is not None:
+                span = (hi - lo) / 1e12
+                if best is None or span > best["span_s"]:
+                    best = {"plane": name, "span_s": span, "events": nev}
+    except (IndexError, ValueError):
+        return None
+    return best
+
+
+def xprof_report(*, label: str, logdir: str,
+                 profiled_wall_s: float | None,
+                 reconstructed_s: float | None,
+                 error: str | None = None) -> dict:
+    """Build (and record) the divergence report for one profiled rep.
+
+    ``source`` is column-accurate about what the profiled side IS:
+    "xplane-device-span" when a device plane parsed out of the profile,
+    "host-wall(profiled)" when only the host wall around the profiled
+    dispatch exists (a tunneled dispatch makes that an overestimate —
+    the report says which it is, never overselling). The reconstructed
+    side stays the source of truth either way."""
+    device = None
+    try:
+        pbs = sorted(glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                               recursive=True), key=os.path.getmtime)
+        if pbs:
+            device = xplane_device_seconds(pbs[-1])
+    except OSError:
+        device = None
+    if device is not None:
+        total, source = device["span_s"], "xplane-device-span"
+    elif profiled_wall_s is not None:
+        total, source = profiled_wall_s, "host-wall(profiled)"
+    else:
+        total = source = None
+    div = None
+    if total is not None and reconstructed_s:
+        div = (total - reconstructed_s) / reconstructed_s * 100.0
+    report = {
+        "label": label, "logdir": logdir,
+        "profiled_wall_s": profiled_wall_s,
+        "device_span_s": device["span_s"] if device else None,
+        "device_plane": device["plane"] if device else None,
+        "reconstructed_s": reconstructed_s,
+        "total_s": total, "source": source,
+        "divergence_pct": div, "error": error,
+    }
+    _XPROF.append(report)
+    return report
+
+
+def render_xprof(report: dict) -> str:
+    if report.get("error"):
+        return (f"xprof {report['label']}: unavailable "
+                f"({report['error']})")
+    div = report.get("divergence_pct")
+    div_s = f"{div:+.1f}%" if div is not None else "n/a"
+    total = report.get("total_s")
+    recon = report.get("reconstructed_s")
+    return (f"xprof {report['label']}: profiled "
+            f"{_fmt(total, ' s')} [{report.get('source')}] vs "
+            f"reconstructed rep {_fmt(recon, ' s')} -> divergence "
+            f"{div_s} (cross-check only; reconstructed cells remain "
+            f"the source of truth)")

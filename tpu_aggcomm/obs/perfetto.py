@@ -5,7 +5,9 @@ form), loadable in ``ui.perfetto.dev`` or ``chrome://tracing``:
 
 - pid 1, "host (measured)": real perf_counter windows (``host_span``)
   and instants — dispatch loops, chained differencing windows, oracle
-  delivery events.
+  delivery events — plus the ``hbm`` counter tracks
+  (``device.memory_stats()`` samples, host-sampled outside the timed
+  path).
 - pid 2, "ranks (reconstructed)": one thread (track) per logical rank.
   Rep envelopes and per-round bucket slices from the attribution cell
   stream. Every slice's args carry the exact attributed seconds
@@ -15,6 +17,12 @@ form), loadable in ``ui.perfetto.dev`` or ``chrome://tracing``:
 - pid 2, tid 0: the ``bytes_in_flight`` counter track (payload bytes
   entering flight per throttle round).
 
+Multi-run legibility: the process names carry the backend(s) and the
+``process_labels`` metadata lists every run (``m<id> <method name>
+[backend]``), so a sweep export's tracks are identifiable in the UI
+without opening a slice. The run-ledger preamble (obs/ledger.py) lands
+as a ``ledger.manifest`` instant at ts 0 with the manifest in its args.
+
 Slices within each track are sorted by timestamp, so ``ts`` is
 monotonically non-decreasing per track (pinned by the round-trip
 tests). Timestamps are microseconds (the format's unit).
@@ -22,10 +30,14 @@ tests). Timestamps are microseconds (the format's unit).
 
 from __future__ import annotations
 
-__all__ = ["to_chrome_trace", "HOST_PID", "RANKS_PID"]
+__all__ = ["to_chrome_trace", "HOST_PID", "RANKS_PID", "HBM_TID"]
 
 HOST_PID = 1
 RANKS_PID = 2
+
+#: Host-process thread id of the HBM counter tracks (tid 1 is the host
+#: span/instant timeline).
+HBM_TID = 2
 
 
 def _meta(pid: int, tid: int, what: str, name: str) -> dict:
@@ -33,15 +45,31 @@ def _meta(pid: int, tid: int, what: str, name: str) -> dict:
             "args": {"name": name}}
 
 
+def _run_label(run: dict) -> str:
+    return f"m{run.get('method')} {run.get('name')} [{run.get('backend')}]"
+
+
 def to_chrome_trace(events: list[dict]) -> dict:
     """Convert flight-recorder events to a Chrome trace dict."""
     runs = {e["id"]: e for e in events if e["ev"] == "run"}
+    backends = sorted({str(r.get("backend")) for r in runs.values()})
+    ranks_name = "ranks (reconstructed)"
+    if backends:
+        ranks_name += " — " + "/".join(backends)
+    run_labels = ", ".join(_run_label(runs[k]) for k in sorted(runs))
     out: list[dict] = [
         _meta(HOST_PID, 0, "process_name", "host (measured)"),
-        _meta(RANKS_PID, 0, "process_name", "ranks (reconstructed)"),
+        _meta(HOST_PID, 1, "thread_name", "host timeline"),
+        _meta(RANKS_PID, 0, "process_name", ranks_name),
         _meta(RANKS_PID, 0, "thread_name", "bytes_in_flight"),
     ]
+    if run_labels:
+        for pid in (HOST_PID, RANKS_PID):
+            out.append({"ph": "M", "pid": pid, "tid": 0,
+                        "name": "process_labels",
+                        "args": {"labels": run_labels}})
     ranks_seen: set[int] = set()
+    hbm_seen = False
     slices: list[dict] = []
     for e in events:
         ev = e["ev"]
@@ -55,6 +83,22 @@ def to_chrome_trace(events: list[dict]) -> dict:
                 "ph": "i", "pid": HOST_PID, "tid": 1, "name": e["name"],
                 "cat": "host", "ts": e["ts"], "s": "t",
                 "args": e.get("args", {})})
+        elif ev == "ledger":
+            # the run-ledger preamble: environment manifest as an
+            # instant at the origin, args carry the whole manifest
+            slices.append({
+                "ph": "i", "pid": HOST_PID, "tid": 1,
+                "name": "ledger.manifest", "cat": "ledger", "ts": 0.0,
+                "s": "p", "args": {"manifest": e.get("manifest")}})
+        elif ev == "hbm":
+            hbm_seen = True
+            for key in ("bytes_in_use", "peak_bytes"):
+                if e.get(key) is None:
+                    continue
+                slices.append({
+                    "ph": "C", "pid": HOST_PID, "tid": HBM_TID,
+                    "name": f"hbm_{key}", "ts": e["ts"],
+                    "args": {"bytes": e[key]}})
         elif ev == "span":
             run = runs.get(e["run"], {})
             rank = e["rank"]
@@ -83,6 +127,8 @@ def to_chrome_trace(events: list[dict]) -> dict:
                 "args": {"bytes": e["value"]}})
         # "run"/"timer"/"meta" events carry no timeline geometry
 
+    if hbm_seen:
+        out.append(_meta(HOST_PID, HBM_TID, "thread_name", "hbm"))
     for rank in sorted(ranks_seen):
         out.append(_meta(RANKS_PID, rank + 1, "thread_name",
                          f"rank {rank}"))

@@ -25,6 +25,18 @@ is jitter, not a regression. Without samples on either side the gate
 falls back to the point-estimate delta, and says so in the verdict
 (``gate: "point"`` + ``gate_note``).
 
+Parsed-schema v3 (obs/ledger.py) adds a ``manifest`` block plus
+``compile_seconds`` and ``hbm_peak_bytes`` to the bench line, and the
+verdict grows a compile-time gate beside the runtime gate: a
+point-estimate comparison of total compile wall seconds against the
+same baseline round the runtime gate chose, active only when BOTH
+rounds carry ``compile_seconds`` (the checked-in v1/v2 history is
+unaffected). Compile walls through the tunnel jitter far more than
+differenced runtimes, so the compile tolerance is wider
+(``COMPILE_TOLERANCE``). Manifest drift between the compared rounds is
+reported in the verdict (informational — drift explains a delta, it is
+not itself a failure).
+
 No jax anywhere here — bench.py's supervisor process imports this.
 """
 
@@ -36,7 +48,8 @@ import os
 import re
 
 __all__ = ["validate_bench", "validate_multichip", "load_history",
-           "check_regression", "DEFAULT_TOLERANCE", "MIN_GATE_SAMPLES"]
+           "check_regression", "parsed_schema_version",
+           "DEFAULT_TOLERANCE", "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE"]
 
 #: Relative slowdown vs the best prior same-platform round that counts as
 #: a regression. Differenced-chain numbers jitter a few percent
@@ -47,6 +60,12 @@ DEFAULT_TOLERANCE = 0.25
 #: this a CI over resamples is theater, so the gate falls back to the
 #: point estimate (and notes it in the verdict).
 MIN_GATE_SAMPLES = 3
+
+#: Relative compile-time slowdown that counts as a compile regression.
+#: Compile walls include one-off XLA autotuning and (on TPU) tunnel
+#: RPCs, so they jitter far more than differenced runtimes — 50%
+#: headroom flags real compile blowups without crying wolf.
+COMPILE_TOLERANCE = 0.50
 
 
 def _require(obj: dict, key: str, types, errors: list[str],
@@ -107,7 +126,44 @@ def validate_bench(obj, where: str = "BENCH") -> list[str]:
                 for x in s):
             errors.append(f"{w}: optional key 'samples' must be a "
                           f"non-empty list of numbers")
+    # parsed-schema v3 (obs/ledger.py): manifest + compile/HBM telemetry
+    if "manifest" in parsed and parsed["manifest"] is not None:
+        m = parsed["manifest"]
+        if not isinstance(m, dict):
+            errors.append(f"{w}: optional key 'manifest' must be an "
+                          f"object")
+        else:
+            for k, types in (("schema", int), ("versions", dict),
+                             ("env", dict), ("python", str)):
+                if k in m and m[k] is not None \
+                        and not isinstance(m[k], types):
+                    errors.append(
+                        f"{w}.manifest: key {k!r} must be "
+                        f"{types.__name__}, got {type(m[k]).__name__}")
+    if "compile_seconds" in parsed and parsed["compile_seconds"] is not None:
+        c = parsed["compile_seconds"]
+        if not isinstance(c, (int, float)) or isinstance(c, bool) or c < 0:
+            errors.append(f"{w}: optional key 'compile_seconds' must be "
+                          f"a non-negative number")
+    if "hbm_peak_bytes" in parsed and parsed["hbm_peak_bytes"] is not None:
+        h = parsed["hbm_peak_bytes"]
+        if not isinstance(h, int) or isinstance(h, bool) or h < 0:
+            errors.append(f"{w}: optional key 'hbm_peak_bytes' must be "
+                          f"a non-negative integer or null")
     return errors
+
+
+def parsed_schema_version(parsed) -> int:
+    """Which parsed-schema generation a bench line belongs to: 3 when it
+    carries any ledger field (manifest/compile_seconds/hbm_peak_bytes),
+    2 when it carries per-trial samples, 1 otherwise (including the
+    degenerate parsed=null artifacts of failed rounds)."""
+    if not isinstance(parsed, dict):
+        return 1
+    if any(parsed.get(k) is not None
+           for k in ("manifest", "compile_seconds", "hbm_peak_bytes")):
+        return 3
+    return 2 if parsed.get("samples") is not None else 1
 
 
 def validate_multichip(obj, where: str = "MULTICHIP") -> list[str]:
@@ -179,7 +235,9 @@ def check_regression(root: str = ".",
          "baseline": {...} | null, "delta_pct": float | null,
          "tolerance_pct": float, "gate": "bootstrap"|"point"|null,
          "gate_note": str | null, "ci_delta_pct": [lo, hi] | null,
-         "history": [...]}
+         "compile_delta_pct": float | null,
+         "compile_tolerance_pct": float, "compile_note": str | null,
+         "manifest_drift": [{"key","a","b"}, ...], "history": [...]}
 
     ``ok`` is False only when the newest measurable round regresses
     against the best prior comparable round, or when any artifact fails
@@ -205,11 +263,22 @@ def check_regression(root: str = ".",
         (rnd, path, blob["parsed"]) for rnd, path, blob in history
         if isinstance(blob.get("parsed"), dict)
         and isinstance(blob["parsed"].get("value"), (int, float))]
+    def _compile_s(p):
+        c = p.get("compile_seconds")
+        return float(c) if isinstance(c, (int, float)) \
+            and not isinstance(c, bool) else None
+
     rows = [{"round": rnd, "metric": p["metric"],
              "platform": p.get("platform", "unknown"),
              "value": p["value"], "unit": p.get("unit", ""),
-             "samples": _gate_samples(p)}
+             "samples": _gate_samples(p),
+             "compile_seconds": _compile_s(p)}
             for rnd, _path, p in measurable]
+    # manifests looked up per round when the compile gate fires — kept
+    # OUT of the verdict rows (the one-JSON-line contract should not
+    # ship whole env blocks per round)
+    manifests = {rnd: p.get("manifest") for rnd, _path, p in measurable
+                 if isinstance(p.get("manifest"), dict)}
 
     verdict: dict = {"check": "regression", "ok": True,
                      "rounds": len(history),
@@ -219,6 +288,10 @@ def check_regression(root: str = ".",
                      "tolerance_pct": tolerance * 100.0,
                      "gate": None, "gate_note": None,
                      "ci_delta_pct": None,
+                     "compile_delta_pct": None,
+                     "compile_tolerance_pct": COMPILE_TOLERANCE * 100.0,
+                     "compile_note": None,
+                     "manifest_drift": [],
                      "history": rows}
     if schema_errors:
         verdict["ok"] = False
@@ -262,4 +335,32 @@ def check_regression(root: str = ".",
             f"point-estimate delta only")
         if delta > tolerance:
             verdict["ok"] = False
+
+    # compile-time gate (parsed-schema v3): one total per round, so this
+    # is always a deterministic point comparison against the SAME
+    # baseline round the runtime gate chose — one coherent verdict, and
+    # reproducible from the same artifacts by construction.
+    ccur, cbase = cur["compile_seconds"], best["compile_seconds"]
+    if ccur is not None and cbase is not None and cbase > 0:
+        cdelta = (ccur - cbase) / cbase
+        verdict["compile_delta_pct"] = cdelta * 100.0
+        if cdelta > COMPILE_TOLERANCE:
+            verdict["ok"] = False
+            verdict["compile_note"] = (
+                f"compile time regressed: {ccur:.3f}s vs baseline "
+                f"{cbase:.3f}s")
+    else:
+        missing = ("baseline" if ccur is not None else
+                   "current" if cbase is not None else
+                   "current and baseline")
+        verdict["compile_note"] = (
+            f"compile_seconds missing on {missing} round(s) "
+            f"(pre-v3 artifacts); compile gate inactive")
+
+    # environment drift between the compared rounds — informational:
+    # drift EXPLAINS a delta (different jax, different platform knobs),
+    # it is not itself a regression
+    from tpu_aggcomm.obs.ledger import diff_manifests
+    verdict["manifest_drift"] = diff_manifests(
+        manifests.get(best["round"]), manifests.get(cur["round"]))
     return verdict

@@ -7,7 +7,7 @@ vanilla JS render it. The file must stay viewable from a bare
 ``file://`` open on a machine with no network, because the TPU build
 host is exactly that.
 
-Three panels:
+Four panels:
 
 - **trajectory** — the headline metric per growth round from the
   checked-in ``BENCH_r*.json`` artifacts, one SVG polyline per platform
@@ -16,6 +16,9 @@ Three panels:
   platform comparison obs/regress.py exists to refuse). Rounds carrying
   per-trial ``samples`` get min/max whiskers. MULTICHIP status rides
   along as a per-round ok/skip marker row.
+- **run ledger** — per-round compile seconds, HBM peak, jax version and
+  environment drift vs the previous manifest-carrying round
+  (parsed-schema v3, obs/ledger.py); pre-v3 rounds show dashes.
 - **per-method skew table** — for every run of every trace file passed
   in: worst-round skew (max/mean over ranks), imbalance share, the
   critical rank, and the dominant (round, phase) cell with its
@@ -41,16 +44,30 @@ __all__ = ["write_report", "build_payload", "render_html"]
 
 
 def _history_rows(root: str) -> tuple[list[dict], list[str]]:
+    from tpu_aggcomm.obs.ledger import diff_manifests
     errors: list[str] = []
     rows = []
+    prev_manifest = None  # latest manifest-carrying round seen so far
     for rnd, path, blob in load_history(root, "BENCH", errors=errors):
         p = blob.get("parsed")
         if not isinstance(p, dict):
             rows.append({"round": rnd, "value": None, "platform": None,
                          "unit": None, "samples": None,
+                         "compile_seconds": None, "hbm_peak_bytes": None,
+                         "jax": None, "drift": [],
                          "file": os.path.basename(path)})
             continue
         s = p.get("samples")
+        # parsed-schema v3 run-ledger fields (obs/ledger.py); pre-v3
+        # rounds keep None everywhere and an empty drift list
+        m = p.get("manifest")
+        m = m if isinstance(m, dict) else None
+        drift = [f"{d['key']}: {d['a']} -> {d['b']}"
+                 for d in diff_manifests(prev_manifest, m)] \
+            if m is not None and prev_manifest is not None else []
+        if m is not None:
+            prev_manifest = m
+        versions = m.get("versions") if m else None
         rows.append({
             "round": rnd,
             "value": p.get("value"),
@@ -58,6 +75,10 @@ def _history_rows(root: str) -> tuple[list[dict], list[str]]:
             "unit": p.get("unit", "s"),
             "vs_baseline": p.get("vs_baseline"),
             "samples": s if isinstance(s, list) else None,
+            "compile_seconds": p.get("compile_seconds"),
+            "hbm_peak_bytes": p.get("hbm_peak_bytes"),
+            "jax": (versions or {}).get("jax"),
+            "drift": drift,
             "file": os.path.basename(path)})
     return rows, errors
 
@@ -155,6 +176,8 @@ time; lower is better everywhere (seconds per rep).</p>
 <div id="errors"></div>
 <h2>Bench trajectory (per platform)</h2>
 <div id="trajectory"></div>
+<h2>Run ledger (compile / HBM / environment)</h2>
+<div id="ledger"></div>
 <h2>Per-method skew table (trace runs)</h2>
 <div id="skew"></div>
 <h2>Straggler heatmaps (rank &times; round, mean seconds)</h2>
@@ -280,6 +303,45 @@ function fmtS(v) {{
     }}).join("  ");
     host.appendChild(el("p", {{class: "note"}}, "multichip: " + mc));
   }}
+}})();
+
+(function ledgerPane() {{
+  var host = document.getElementById("ledger");
+  var rows = DATA.bench.filter(function (r) {{
+    return r.compile_seconds !== null && r.compile_seconds !== undefined
+        || r.hbm_peak_bytes !== null && r.hbm_peak_bytes !== undefined
+        || r.jax; }});
+  if (!rows.length) {{
+    host.appendChild(el("p", {{class: "note"}},
+        "no run-ledger data in the history (pre-v3 artifacts only)"));
+    return;
+  }}
+  var tbl = el("table");
+  var hr = el("tr");
+  ["round", "platform", "jax", "compile", "HBM peak", "env drift vs prev"]
+    .forEach(function (h, i) {{
+      hr.appendChild(el("th", i === 5 ? {{class: "l"}} : {{}}, h)); }});
+  tbl.appendChild(hr);
+  rows.forEach(function (r) {{
+    var tr = el("tr");
+    tr.appendChild(el("td", {{}}, "r" + r.round));
+    tr.appendChild(el("td", {{}}, r.platform || "-"));
+    tr.appendChild(el("td", {{}}, r.jax || "-"));
+    tr.appendChild(el("td", {{}}, fmtS(r.compile_seconds)));
+    tr.appendChild(el("td", {{}},
+        r.hbm_peak_bytes === null || r.hbm_peak_bytes === undefined ? "-" :
+        (r.hbm_peak_bytes / 1048576).toFixed(1) + " MiB"));
+    var td = el("td", {{class: "l"}});
+    if (!r.drift.length) {{
+      td.textContent = "none";
+    }} else {{
+      r.drift.forEach(function (d) {{
+        td.appendChild(el("div", {{class: "err"}}, d)); }});
+    }}
+    tr.appendChild(td);
+    tbl.appendChild(tr);
+  }});
+  host.appendChild(tbl);
 }})();
 
 (function skewTable() {{

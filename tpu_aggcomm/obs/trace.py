@@ -36,9 +36,9 @@ import json
 import time
 
 __all__ = ["TraceRecorder", "aggregate_run", "current", "disable", "enable",
-           "enabled", "flush", "instant", "span", "summarize_trace",
-           "summarize_events", "load_events", "round_key", "WHOLE_REP",
-           "BUCKET_FIELDS"]
+           "enabled", "flush", "hbm_sample", "instant", "span",
+           "summarize_trace", "summarize_events", "load_events",
+           "round_key", "WHOLE_REP", "BUCKET_FIELDS"]
 
 #: ``round`` value of a slice that covers the whole rep (attributions with
 #: no per-round decomposition: attribute_total, the measured post/deliver
@@ -134,6 +134,13 @@ class TraceRecorder:
     - ``{"ev": "timer", "run": k, "rank": q, ...}`` — the FINAL Timer
       columns the run reported, per rank (the round-trip ground truth).
     - ``{"ev": "host_span" | "instant", ...}`` — measured host windows.
+    - ``{"ev": "ledger", "manifest": {...}}`` — the run-ledger preamble
+      (obs/ledger.py): the environment manifest this trace was recorded
+      under. Written at enable time, refreshed on flush so device facts
+      recorded mid-run (platform, device kind) are included.
+    - ``{"ev": "hbm", "ts": µs, "bytes_in_use": n, "peak_bytes": n}`` —
+      ``device.memory_stats()`` samples (HBM counter track in the
+      Perfetto export). Host-sampled OUTSIDE the timed path.
     """
 
     SCHEMA_VERSION = 1
@@ -143,6 +150,14 @@ class TraceRecorder:
         self._events: list[dict] = [
             {"ev": "meta", "schema": self.SCHEMA_VERSION,
              "created_unix": time.time()}]
+        # the run-ledger preamble rides in every trace; ledger failure
+        # (e.g. a sandboxed git) must never break tracing itself
+        try:
+            from tpu_aggcomm.obs import ledger
+            self._events.append({"ev": "ledger",
+                                 "manifest": ledger.manifest()})
+        except Exception:
+            pass
         self._cursor_us = 0.0           # reconstructed-timeline cursor
         self._next_run = 0
 
@@ -154,6 +169,13 @@ class TraceRecorder:
         self._events.append({
             "ev": "instant", "name": name,
             "ts": (time.perf_counter() - self._t0) * 1e6, "args": args})
+
+    def hbm_sample(self, *, bytes_in_use=None, peak_bytes=None) -> None:
+        """One HBM usage sample on the host timeline (sampled after a
+        dispatch returns — never inside the timed path)."""
+        self._events.append({
+            "ev": "hbm", "ts": (time.perf_counter() - self._t0) * 1e6,
+            "bytes_in_use": bytes_in_use, "peak_bytes": peak_bytes})
 
     # -- reconstructed-timeline API --------------------------------------
     def record_method_run(self, schedule, *, method: int, name: str,
@@ -320,6 +342,16 @@ class TraceRecorder:
         """Write ``<prefix>.trace.jsonl`` (the event log) and
         ``<prefix>.trace.json`` (Chrome/Perfetto). Returns both paths."""
         from tpu_aggcomm.obs.perfetto import to_chrome_trace
+        # refresh the ledger preamble: device facts (platform, kind) are
+        # recorded by jax-side code after the recorder was created
+        try:
+            from tpu_aggcomm.obs import ledger
+            for e in self._events:
+                if e.get("ev") == "ledger":
+                    e["manifest"] = ledger.manifest()
+                    break
+        except Exception:
+            pass
         jsonl = f"{prefix}.trace.jsonl"
         with open(jsonl, "w") as fh:
             for e in self._events:
@@ -508,6 +540,15 @@ def instant(name: str, **args) -> None:
     rec = _RECORDER
     if rec is not None:
         rec.instant(name, **args)
+
+
+def hbm_sample(**kwargs) -> None:
+    """An HBM usage sample when tracing is on; a single ``is None``
+    check otherwise (callers may skip even querying memory_stats when
+    tracing is off — see harness/runner.py)."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.hbm_sample(**kwargs)
 
 
 def flush(prefix: str):
