@@ -1,26 +1,55 @@
 #!/usr/bin/env python
 """Validate every BENCH_r*.json / MULTICHIP_r*.json bench-history artifact
 against the shared schema (tpu_aggcomm/obs/regress.py — the same
-definitions ``bench.py --check-regression`` consumes).
+definitions ``bench.py --check-regression`` consumes), plus every
+``TUNE_*.json`` tuned-schedule cache artifact (tune/cache.py): a corrupt
+or stale tune entry must fail validation here instead of silently
+steering ``--auto`` runs.
 
 Usage: ``python scripts/check_bench_schema.py [root]`` (default: repo
 root). Prints one line per artifact, exits nonzero if any artifact is
-invalid or the history is empty. jax-free; wired into the test suite via
-tests/test_obs.py.
+invalid or the bench history is empty (an absent tune cache is fine —
+tuning is optional; a present-but-broken one is not). jax-free; wired
+into the test suite via tests/test_obs.py.
 """
 
+import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tpu_aggcomm.obs.regress import (load_history, parsed_schema_version,
-                                     validate_bench, validate_multichip)
+                                     validate_bench, validate_multichip,
+                                     validate_tune)
 
 
 def check(root: str) -> int:
     n_files = 0
     n_errors = 0
+    n_tune = 0
+    from tpu_aggcomm.tune.cache import tune_paths
+    for path in tune_paths(root):
+        n_files += 1
+        n_tune += 1
+        name = os.path.basename(path)
+        try:
+            with open(path) as fh:
+                blob = json.load(fh)
+        except (OSError, ValueError) as e:
+            n_errors += 1
+            print(f"FAIL {name}: unparsable JSON ({e})")
+            continue
+        errors = validate_tune(blob, name)
+        if errors:
+            n_errors += len(errors)
+            for e in errors:
+                print(f"FAIL {e}")
+        else:
+            tag = blob.get("schema", "?")
+            syn = ", synthetic" if blob.get("synthetic") else ""
+            print(f"ok   {name} ({tag}{syn})")
+    n_hist = 0
     for kind, validate in (("BENCH", validate_bench),
                            ("MULTICHIP", validate_multichip)):
         # unparsable JSON must FAIL the check, not traceback out of it
@@ -28,10 +57,12 @@ def check(root: str) -> int:
         history = load_history(root, kind, errors=load_errors)
         for e in load_errors:
             n_files += 1
+            n_hist += 1
             n_errors += 1
             print(f"FAIL {e}")
         for rnd, path, blob in history:
             n_files += 1
+            n_hist += 1
             errors = validate(blob, os.path.basename(path))
             if errors:
                 n_errors += len(errors)
@@ -46,10 +77,12 @@ def check(root: str) -> int:
                                             if kind == "BENCH" else None)
                 tag = f" (schema v{ver})" if kind == "BENCH" else ""
                 print(f"ok   {os.path.basename(path)}{tag}")
-    if n_files == 0:
+    if n_hist == 0:
+        # an absent tune cache is fine; an absent bench history is not
         print(f"FAIL no BENCH_r*/MULTICHIP_r*.json found under {root}")
         return 1
-    print(f"{n_files} artifact(s), {n_errors} schema error(s)")
+    print(f"{n_files} artifact(s) ({n_tune} tune), "
+          f"{n_errors} schema error(s)")
     return 1 if n_errors else 0
 
 

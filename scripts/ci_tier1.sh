@@ -29,5 +29,14 @@ rc=$?
 post_rc=0
 python scripts/check_bench_schema.py || post_rc=1
 python bench.py --check-regression || post_rc=1
+# tuned-schedule cache replay (tune/race.py, jax-free): every committed
+# TUNE_*.json must re-derive its recorded elimination order and winner
+# byte-for-byte from its own samples — an artifact that cannot reproduce
+# its verdict must not steer --auto runs. No artifacts = nothing to
+# replay = fine (tuning is optional; a broken cache is not).
+for f in TUNE_*.json; do
+  [ -e "$f" ] || continue
+  python -m tpu_aggcomm.cli tune --replay "$f" || post_rc=1
+done
 if [ "$rc" -eq 0 ]; then rc=$post_rc; fi
 exit $rc

@@ -121,6 +121,32 @@ def main() -> int:
                stage("ledger",
                      [sys.executable, "-m", "tpu_aggcomm.cli",
                       "inspect", "ledger"]))
+        if os.environ.get("TPU_AGGCOMM_TUNE"):
+            # opt-in autotuner stage (TPU_AGGCOMM_TUNE=1): one real
+            # tuned cell on the live chip — racing chained differenced
+            # batches over the m=1-vs-m=3 throttle grid the Theta
+            # scripts sweep by hand, persisting TUNE_*.json keyed by
+            # this session's manifest fingerprint. Runs AFTER the
+            # mosaic/bench stages proved the tunnel healthy; small
+            # chain lengths keep each batch's tunnel dwell short.
+            record("tune",
+                   stage("tune",
+                         [sys.executable, "-m", "tpu_aggcomm.cli",
+                          "tune", "-n", "32", "-d", "2048",
+                          "--methods", "1,3", "--cb-nodes", "14",
+                          "--comm-sizes", "8", "--backend", "jax_sim",
+                          "--batch-trials", "3", "--max-batches", "4",
+                          "--iters-small", "50", "--iters-big", "550"]))
+            # jax-free re-derivation of what was just written — the
+            # same check ci_tier1.sh runs over committed artifacts
+            tunes = sorted(f for f in os.listdir(REPO)
+                           if f.startswith("TUNE_")
+                           and f.endswith(".json"))
+            for f in tunes:
+                record(f"tune-replay:{f}",
+                       stage(f"tune-replay:{f}",
+                             [sys.executable, "-m", "tpu_aggcomm.cli",
+                              "tune", "--replay", f]))
         if os.environ.get("TPU_AGGCOMM_TRACE"):
             # opt-in flight-recorder stage (TPU_AGGCOMM_TRACE=1): one
             # traced chained jax_sim run + a traced sweep pass, leaving

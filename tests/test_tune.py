@@ -1,0 +1,447 @@
+"""Autotuner (tpu_aggcomm/tune/) guarantees:
+
+- the search space refuses dead / TAM / unknown method ids and mixed
+  traffic directions, NAMING the offending ids (the ``inspect compare``
+  TraceCompareError discipline applied to tuning grids);
+- the seeded racing loop converges to the injected-fast oracle winner
+  on a synthetic skew grid, deterministically (same samples in → same
+  eliminations and winner out);
+- ``cli tune --replay`` re-derives the stored elimination order and
+  winner byte for byte from the committed TUNE artifact — including in
+  a subprocess where ``import jax`` is POISONED (the --auto/replay path
+  must run on the supervisor side of a dead tunnel);
+- the tuned-schedule cache is keyed by the v3 ledger manifest
+  fingerprint: manifest drift (e.g. a jax version change) turns a hit
+  into a named miss, and ``--auto`` falls back to the explicit flags
+  with a stderr warning;
+- ``obs/regress.validate_tune`` accepts every artifact ``save_tune``
+  writes and rejects corrupted ones;
+- ``JaxSimBackend.measure_trial_samples`` returns FRESH differenced
+  trials per call (no per-schedule sample memoization — racing needs
+  new measurements every batch) while reusing the compiled chains;
+- the ``inspect report`` dashboard inlines a tuner pane from
+  ``TUNE_*.json`` jax-free.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_aggcomm.tune import cache
+from tpu_aggcomm.tune.race import (RaceError, make_synthetic_sampler, race,
+                                   replay_record)
+from tpu_aggcomm.tune.space import (Candidate, SpaceError, build_space,
+                                    parse_cid, space_direction)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMMITTED_TUNE = os.path.join(REPO, "TUNE_local_n8_d64_p1_a2m.json")
+
+
+# ------------------------------------------------------------ search space
+
+class TestSpace:
+    def test_grid_is_cartesian_in_input_order(self):
+        cands = build_space([3, 1], [2, 4], [8], [1], nprocs=8)
+        assert [c.cid for c in cands] == [
+            "m3:a2:c8:t1", "m3:a4:c8:t1", "m1:a2:c8:t1", "m1:a4:c8:t1"]
+
+    def test_cid_roundtrip(self):
+        c = Candidate(method=3, cb_nodes=14, comm_size=8, agg_type=2)
+        assert parse_cid(c.cid) == c
+        with pytest.raises(SpaceError, match="malformed"):
+            parse_cid("m3:a14")
+
+    def test_unknown_ids_named(self):
+        with pytest.raises(SpaceError, match=r"unknown method id\(s\) \[99\]"):
+            build_space([1, 99], [2], [8], [1], nprocs=8)
+
+    def test_dead_ids_named_with_method_name(self):
+        from tpu_aggcomm.core.methods import METHODS
+        with pytest.raises(SpaceError) as ei:
+            build_space([21], [2], [8], [1], nprocs=8)
+        assert "m=21" in str(ei.value)
+        assert METHODS[21].name in str(ei.value)
+
+    def test_tam_ids_need_opt_in(self):
+        with pytest.raises(SpaceError, match=r"TAM method id\(s\) \[15\]"):
+            build_space([15], [2], [8], [1], nprocs=8)
+
+    def test_mixed_directions_named_per_direction(self):
+        with pytest.raises(SpaceError) as ei:
+            build_space([1, 2], [2], [8], [1], nprocs=8)
+        msg = str(ei.value)
+        assert "all_to_many: [1]" in msg and "many_to_all: [2]" in msg
+
+    def test_axis_range_guards(self):
+        with pytest.raises(SpaceError, match=r"cb_nodes value\(s\) \[9\]"):
+            build_space([1], [9], [8], [1], nprocs=8)
+        with pytest.raises(SpaceError, match=r"agg_type value\(s\) \[7\]"):
+            build_space([1], [2], [8], [7], nprocs=8)
+        with pytest.raises(SpaceError, match="empty tuning grid"):
+            build_space([1], [], [8], [1], nprocs=8)
+
+    def test_space_direction(self):
+        assert space_direction([1, 3]) == "all_to_many"
+        assert space_direction([2]) == "many_to_all"
+
+
+# ------------------------------------------------------------- racing loop
+
+def _oracle_race(**kw):
+    cids = [c.cid for c in build_space([1, 3, 7], [4], [8], [1], nprocs=8)]
+    sampler = make_synthetic_sampler("100,m3*0.5", batch_trials=3, seed=0)
+    return cids, race(cids, sampler, **kw)
+
+
+class TestRace:
+    def test_converges_to_injected_oracle_winner(self):
+        cids, res = _oracle_race()
+        assert parse_cid(res.winner).method == 3
+        # the 2x-slower candidates must actually be ELIMINATED by the
+        # CI gate, not merely outlived
+        out = {e["candidate"] for e in res.eliminations}
+        assert out == {c for c in cids if parse_cid(c).method != 3}
+        for e in res.eliminations:
+            lo, hi = e["ci_pct"]
+            assert 0 < lo < hi
+            assert e["leader"] == res.winner
+
+    def test_deterministic(self):
+        _, a = _oracle_race()
+        _, b = _oracle_race()
+        assert a.winner == b.winner
+        assert a.eliminations == b.eliminations
+        assert a.samples == b.samples
+
+    def test_replay_reproduces_from_record(self):
+        cids, res = _oracle_race(max_batches=4, alpha=0.05, seed=7)
+        rec = {"seed": 7, "alpha": 0.05, "n_boot": 2000, "max_batches": 4,
+               "order": cids, "samples": res.samples,
+               "eliminations": res.eliminations, "winner": res.winner}
+        # JSON round trip first: the replay path consumes artifacts
+        rec = json.loads(json.dumps(rec))
+        out = replay_record(rec)
+        assert out.winner == res.winner
+        assert json.loads(json.dumps(out.eliminations)) == rec["eliminations"]
+
+    def test_replay_truncated_record_raises(self):
+        cids, res = _oracle_race()
+        rec = {"seed": 0, "alpha": 0.05, "n_boot": 2000, "max_batches": 6,
+               "order": cids,
+               "samples": {c: b[:0] for c, b in res.samples.items()}}
+        with pytest.raises(RaceError, match="no recorded batch"):
+            replay_record(rec)
+
+    def test_bad_inputs(self):
+        with pytest.raises(RaceError, match="at least one"):
+            race([], lambda c, b: [1.0])
+        with pytest.raises(RaceError, match="duplicate"):
+            race(["x", "x"], lambda c, b: [1.0])
+        with pytest.raises(RaceError, match="empty batch"):
+            race(["x", "y"], lambda c, b: [])
+        with pytest.raises(RaceError, match="malformed synthetic spec"):
+            make_synthetic_sampler("100,m3x0.5")
+
+    def test_inseparable_candidates_survive(self):
+        # identical distributions: nobody should be eliminated
+        cids = ["m1:a2:c8:t1", "m1:a4:c8:t1"]
+        sampler = make_synthetic_sampler("100", batch_trials=3, seed=0)
+        res = race(cids, sampler, max_batches=3)
+        assert res.survivors == cids
+        assert res.eliminations == []
+
+
+# ------------------------------------------------------------- tuned cache
+
+def _manifest(jax="0.9.9"):
+    return {"schema": 3, "versions": {"jax": jax, "jaxlib": jax},
+            "python": "3.11.0", "platform": "cpu",
+            "env": {"tunnel_armed": False, "armed_vars": []},
+            "created_unix": 1e9, "git_sha": "abc"}
+
+
+class TestCache:
+    def test_fingerprint_tracks_drift_only(self):
+        a = cache.manifest_fingerprint(_manifest())
+        assert a == cache.manifest_fingerprint(_manifest())
+        # DRIFT_IGNORE keys (timestamps, git sha) don't move it
+        m = _manifest()
+        m["created_unix"] = 2e9
+        m["git_sha"] = "def"
+        assert cache.manifest_fingerprint(m) == a
+        # a drift-relevant key does
+        assert cache.manifest_fingerprint(_manifest(jax="1.0.0")) != a
+
+    def _save(self, root, man):
+        cids, res = _oracle_race()
+        key = cache.tune_key(nprocs=8, data_size=64, proc_node=1,
+                             direction="all_to_many", backend="local",
+                             manifest=man)
+        win = parse_cid(res.winner)
+        return key, cache.save_tune(
+            str(root), key=key, manifest=man,
+            space={"methods": [1, 3, 7], "cb_nodes": [4],
+                   "comm_sizes": [8], "agg_types": [1]},
+            race={"seed": 0, "alpha": 0.05, "n_boot": 2000,
+                  "max_batches": 6, "batch_trials": 3, "order": cids,
+                  "samples": res.samples, "eliminations": res.eliminations,
+                  "winner": res.winner, "batches_run": res.batches_run,
+                  "survivors": res.survivors},
+            winner={"method": win.method, "cb_nodes": win.cb_nodes,
+                    "comm_size": win.comm_size, "agg_type": win.agg_type},
+            synthetic=True)
+
+    def test_lookup_hit_and_drift_miss(self, tmp_path):
+        man = _manifest()
+        key, path = self._save(tmp_path, man)
+        entry, note = cache.lookup(str(tmp_path), key, manifest=man)
+        assert note is None and entry["winner"]["method"] == 3
+        # same shape, drifted environment: named miss
+        man2 = _manifest(jax="1.0.0")
+        key2 = cache.tune_key(nprocs=8, data_size=64, proc_node=1,
+                              direction="all_to_many", backend="local",
+                              manifest=man2)
+        entry, note = cache.lookup(str(tmp_path), key2, manifest=man2)
+        assert entry is None
+        assert "manifest drift" in note and "versions.jax" in note
+
+    def test_lookup_misses_are_distinguished(self, tmp_path):
+        key = cache.tune_key(nprocs=8, data_size=64, proc_node=1,
+                             direction="all_to_many", backend="local",
+                             manifest=_manifest())
+        entry, note = cache.lookup(str(tmp_path), key, manifest=_manifest())
+        assert entry is None and note.startswith("no tuned entry")
+        path = cache.artifact_path(str(tmp_path), key)
+        with open(path, "w") as fh:
+            fh.write("{not json")
+        entry, note = cache.lookup(str(tmp_path), key, manifest=_manifest())
+        assert entry is None and "unreadable" in note
+        with open(path, "w") as fh:
+            json.dump({"schema": "tune-v0"}, fh)
+        entry, note = cache.lookup(str(tmp_path), key, manifest=_manifest())
+        assert entry is None and "invalid tune artifact" in note
+
+    def test_lookup_different_context(self, tmp_path):
+        man = _manifest()
+        key, path = self._save(tmp_path, man)
+        # overwrite the stored key's nprocs: the filename matches but
+        # the context does not — must be a named miss, not a hit
+        blob = cache.load_tune(path)
+        blob["key"]["nprocs"] = 16
+        with open(path, "w") as fh:
+            json.dump(blob, fh)
+        entry, note = cache.lookup(str(tmp_path), key, manifest=man)
+        assert entry is None and "different context" in note
+
+    def test_artifact_filename_excludes_fingerprint(self):
+        k1 = cache.tune_key(nprocs=8, data_size=64, proc_node=1,
+                            direction="all_to_many", backend="local",
+                            manifest=_manifest())
+        k2 = cache.tune_key(nprocs=8, data_size=64, proc_node=1,
+                            direction="all_to_many", backend="local",
+                            manifest=_manifest(jax="1.0.0"))
+        assert k1["fingerprint"] != k2["fingerprint"]
+        assert (cache.artifact_path(".", k1) == cache.artifact_path(".", k2)
+                == "./TUNE_local_n8_d64_p1_a2m.json")
+
+    def test_validate_tune_accepts_saved_rejects_corrupt(self, tmp_path):
+        from tpu_aggcomm.obs.regress import validate_tune
+        man = _manifest()
+        _, path = self._save(tmp_path, man)
+        blob = json.loads(json.dumps(cache.load_tune(path)))
+        assert validate_tune(blob, "t") == []
+        bad = json.loads(json.dumps(blob))
+        bad["schema"] = "tune-v0"
+        assert validate_tune(bad, "t")
+        bad = json.loads(json.dumps(blob))
+        bad["race"]["winner"] = "m9:a9:c9:t9"      # no samples for it
+        assert validate_tune(bad, "t")
+        bad = json.loads(json.dumps(blob))
+        bad["winner"]["method"] = 7                # cid inconsistency
+        assert validate_tune(bad, "t")
+        bad = json.loads(json.dumps(blob))
+        bad["race"]["samples"] = {}
+        assert validate_tune(bad, "t")
+
+
+# ------------------------------------------------------------- CLI surface
+
+class TestCli:
+    def test_tune_synthetic_then_replay(self, tmp_path, capsys):
+        from tpu_aggcomm.cli import main
+        rc = main(["tune", "-n", "8", "-d", "64", "--backend", "local",
+                   "--methods", "1,3,7", "--synthetic", "100,m3*0.5",
+                   "--tune-root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "winner: m3:a4:c8:t1" in out
+        path = os.path.join(str(tmp_path), "TUNE_local_n8_d64_p1_a2m.json")
+        assert os.path.exists(path)
+        rc = main(["tune", "--replay", path])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "REPRODUCED" in out
+
+    def test_tune_space_error_exits_named(self, tmp_path, capsys):
+        from tpu_aggcomm.cli import main
+        with pytest.raises(SystemExit) as ei:
+            main(["tune", "-n", "8", "--methods", "1,2",
+                  "--tune-root", str(tmp_path)])
+        assert "all_to_many: [1]" in str(ei.value)
+
+    def test_committed_artifact_replays(self, capsys):
+        """The checked-in TUNE artifact must reproduce its verdict —
+        the exact check ci_tier1.sh runs."""
+        from tpu_aggcomm.cli import main
+        assert os.path.exists(COMMITTED_TUNE), "committed TUNE artifact gone"
+        rc = main(["tune", "--replay", COMMITTED_TUNE])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "REPRODUCED" in out
+
+    def test_replay_detects_tampered_record(self, tmp_path, capsys):
+        from tpu_aggcomm.cli import main
+        blob = cache.load_tune(COMMITTED_TUNE)
+        # claim a different winner than the samples support
+        loser = next(c for c in blob["race"]["order"]
+                     if c != blob["race"]["winner"])
+        blob["race"]["winner"] = loser
+        blob["winner"] = {
+            "method": parse_cid(loser).method,
+            "cb_nodes": parse_cid(loser).cb_nodes,
+            "comm_size": parse_cid(loser).comm_size,
+            "agg_type": parse_cid(loser).agg_type}
+        p = tmp_path / "TUNE_local_n8_d64_p1_a2m.json"
+        p.write_text(json.dumps(blob))
+        rc = main(["tune", "--replay", str(p)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "MISMATCH" in out
+
+    def test_auto_hit_applies_winner(self, tmp_path, capsys):
+        from tpu_aggcomm.cli import main
+        rc = main(["tune", "-n", "8", "-d", "64", "--backend", "local",
+                   "--methods", "1,3", "--cb-nodes", "4", "--comm-sizes",
+                   "8", "--agg-types", "1", "--synthetic", "100,m3*0.5",
+                   "--tune-root", str(tmp_path)])
+        assert rc == 0
+        capsys.readouterr()
+        rc = main(["-n", "8", "-a", "2", "-d", "64", "-c", "2", "-m", "1",
+                   "--backend", "local", "--auto",
+                   "--tune-root", str(tmp_path),
+                   "--results-csv", str(tmp_path / "r.csv")])
+        cap = capsys.readouterr()
+        assert rc == 0
+        assert ("auto: tuned -m 3 -a 4 -c 8 -t 1 [synthetic tune]"
+                in cap.out)
+
+    def test_auto_miss_warns_and_falls_back(self, tmp_path, capsys):
+        from tpu_aggcomm.cli import main
+        rc = main(["-n", "8", "-a", "2", "-d", "64", "-c", "2", "-m", "1",
+                   "--backend", "local", "--auto",
+                   "--tune-root", str(tmp_path),
+                   "--results-csv", str(tmp_path / "r.csv")])
+        cap = capsys.readouterr()
+        assert rc == 0
+        assert "no tuned entry" in cap.err
+        assert "falling back to -m 1" in cap.err
+
+    def test_replay_survives_poisoned_jax(self, tmp_path):
+        """The tier-1 replay step must run where jax cannot import —
+        same poisoning recipe as the ledger supervisor test."""
+        poison = tmp_path / "jax"
+        poison.mkdir()
+        (poison / "__init__.py").write_text(
+            "raise ImportError('poisoned jax: tune --replay must not "
+            "import jax')\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_aggcomm.cli", "tune", "--replay",
+             COMMITTED_TUNE],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "REPRODUCED" in r.stdout
+
+
+# ---------------------------------------------------------- measured batches
+
+def test_measure_trial_samples_fresh_per_call():
+    """Racing needs NEW samples every batch: the tune hook must bypass
+    measure_per_rep's per-schedule sample memoization while keeping the
+    compiled chains cached (one tune_chains entry, reused)."""
+    from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+
+    backend = JaxSimBackend()
+    sched = compile_method(1, AggregatorPattern(
+        nprocs=8, cb_nodes=2, data_size=64, proc_node=1, comm_size=2))
+    a = backend.measure_trial_samples(sched, iters_small=2, iters_big=12,
+                                      trials=2, windows=1)
+    b = backend.measure_trial_samples(sched, iters_small=2, iters_big=12,
+                                      trials=2, windows=1)
+    assert len(a) == len(b) == 2
+    assert all(isinstance(x, float) for x in a + b)
+    assert a is not b                       # no memoized list handed back
+    keys = [k for k in backend._chain_cache if "tune_chains" in k]
+    assert len(keys) == 1                   # chains compiled exactly once
+
+
+def test_jax_sim_sampler_races_end_to_end(tmp_path):
+    """Small measured race on the CPU mesh: the full sampler → race →
+    save → lookup loop with a real backend (no assertion on who wins —
+    CPU timings are not the oracle; the artifact contract is)."""
+    from tpu_aggcomm.obs.ledger import manifest
+    from tpu_aggcomm.tune.measure import make_jax_sim_sampler
+
+    cands = [c.cid for c in build_space([1], [2, 4], [2], [1], nprocs=8)]
+    sampler = make_jax_sim_sampler(nprocs=8, data_size=64, proc_node=1,
+                                   iters_small=2, iters_big=12,
+                                   batch_trials=2, windows=1)
+    res = race(cands, sampler, max_batches=2)
+    assert res.winner in cands
+    assert all(len(b) == 2 for bl in res.samples.values() for b in bl)
+    man = manifest()
+    key = cache.tune_key(nprocs=8, data_size=64, proc_node=1,
+                         direction="all_to_many", backend="jax_sim",
+                         manifest=man)
+    win = parse_cid(res.winner)
+    cache.save_tune(
+        str(tmp_path), key=key, manifest=man,
+        space={"methods": [1], "cb_nodes": [2, 4], "comm_sizes": [2],
+               "agg_types": [1]},
+        race={"seed": 0, "alpha": 0.05, "n_boot": 2000, "max_batches": 2,
+              "batch_trials": 2, "order": cands, "samples": res.samples,
+              "eliminations": res.eliminations, "winner": res.winner,
+              "batches_run": res.batches_run, "survivors": res.survivors},
+        winner={"method": win.method, "cb_nodes": win.cb_nodes,
+                "comm_size": win.comm_size, "agg_type": win.agg_type})
+    entry, note = cache.lookup(str(tmp_path), key, manifest=man)
+    assert note is None
+    assert entry["winner"]["method"] == 1
+
+
+# ------------------------------------------------------------ report pane
+
+def test_report_payload_and_pane(tmp_path):
+    import shutil
+
+    from tpu_aggcomm.obs.report_html import build_payload, render_html
+
+    shutil.copy(COMMITTED_TUNE, tmp_path / os.path.basename(COMMITTED_TUNE))
+    (tmp_path / "TUNE_local_n9_d64_p1_a2m.json").write_text("{corrupt")
+    payload = build_payload(history_root=str(tmp_path))
+    rows = {r["file"]: r for r in payload["tune"]}
+    good = rows[os.path.basename(COMMITTED_TUNE)]
+    assert good["error"] is None
+    assert parse_cid(good["winner_cid"]).method == good["winner"]["method"]
+    assert good["synthetic"] is True
+    assert good["eliminations"] and good["medians"]
+    assert "unparsable JSON" in rows["TUNE_local_n9_d64_p1_a2m.json"]["error"]
+    html = render_html(payload)
+    assert 'id="tune"' in html and "tunePane" in html
+    assert os.path.basename(COMMITTED_TUNE) in html

@@ -1109,3 +1109,31 @@ class JaxSimBackend:
         self._chain_cache[key] = (per_rep, tuple(samples))
         self.last_samples = list(samples)
         return per_rep
+
+    def measure_trial_samples(self, schedule, *, iters_small: int = 50,
+                              iters_big: int = 1050, trials: int = 3,
+                              windows: int = 1) -> list[float]:
+        """FRESH per-trial differenced seconds for the autotuner
+        (tune/measure.py): the same serial-chain scaffold as
+        measure_per_rep, but the SAMPLES are never cached — every racing
+        batch must be a new measurement, or the tuner's CI over batches
+        degenerates to a replay of the first batch. Only the jitted
+        chain pair and the initial send buffer are memoized (per
+        schedule and chain lengths), so repeat batches re-TIME without
+        re-COMPILING — the distinction that matters through the
+        tunnel."""
+        key = (self._key(schedule), "tune_chains", iters_small, iters_big)
+        if key not in self._chain_cache:
+            p = schedule.pattern
+            make_chain = self._chain_factory(self._one_rep(schedule), p)
+            chains = {iters_small: make_chain(iters_small),
+                      iters_big: make_chain(iters_big)}
+            send0 = jax.device_put(self._global_send(p, 0), self._dev())
+            self._chain_cache[key] = (chains, send0)
+        chains, send0 = self._chain_cache[key]
+        samples = differenced_trials(lambda it: chains[it], send0,
+                                     iters_small=iters_small,
+                                     iters_big=iters_big,
+                                     trials=trials, windows=windows)
+        self.last_samples = list(samples)
+        return list(samples)

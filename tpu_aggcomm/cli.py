@@ -77,6 +77,16 @@ def build_parser() -> argparse.ArgumentParser:
                             "phase columns marked 'measured-rounds/-hops/"
                             "-split...+attributed(...)' in the "
                             "provenance sidecar")
+    bench.add_argument("--auto", action="store_true",
+                       help="resolve -m/-a/-c/-t from the tuned-schedule "
+                            "cache (TUNE_*.json under --tune-root, written "
+                            "by 'tune') for this shape/backend; explicit "
+                            "warning + fallback to the given flags on a "
+                            "cache miss, schema failure, or environment "
+                            "drift vs the tuning manifest")
+    bench.add_argument("--tune-root", default=".",
+                       help="directory holding TUNE_*.json artifacts "
+                            "(default: .)")
     bench.add_argument("--results-csv", default="results.csv")
     bench.add_argument("--trace", metavar="PREFIX", default=None,
                        help="flight recorder: write PREFIX.trace.jsonl "
@@ -187,6 +197,74 @@ def build_parser() -> argparse.ArgumentParser:
     sw.add_argument("--comm-sizes", type=str, default=None,
                     help="comma-separated throttle values (default: the "
                          "Theta grid 1,2,4,...,8192,999999999)")
+    sw.add_argument("--auto", action="store_true",
+                    help="resolve the METHOD from the tuned-schedule "
+                         "cache for this shape/backend (the throttle "
+                         "axis is what the sweep itself varies); "
+                         "warning + fallback to -m on a miss or drift")
+    sw.add_argument("--tune-root", default=".",
+                    help="directory holding TUNE_*.json (default: .)")
+
+    # tune — statistical racing search + persistent tuned-schedule cache
+    tn = sub.add_parser(
+        "tune", help="statistical racing search over (method, cb_nodes, "
+                     "-c, -t) for one fixed shape/backend: batches of "
+                     "chained differenced trials per surviving candidate; "
+                     "elimination only when the seeded bootstrap CI on "
+                     "the median delta vs the leader excludes zero "
+                     "(obs/metrics.py — same samples in, same winner "
+                     "out). Persists TUNE_*.json keyed by (shape, "
+                     "direction, backend, manifest fingerprint); "
+                     "--replay re-derives the verdict jax-free from the "
+                     "recorded samples")
+    tn.add_argument("-n", "--nprocs", type=int, default=32)
+    tn.add_argument("-d", dest="data_size", type=int, default=2048)
+    tn.add_argument("-p", dest="proc_node", type=int, default=1)
+    tn.add_argument("--backend", choices=BACKENDS, default="jax_sim",
+                    help="measured tuning rides the chained jax_sim "
+                         "scaffold; other values are only meaningful "
+                         "with --synthetic")
+    tn.add_argument("--methods", default="1,3",
+                    help="comma-separated method ids (one direction "
+                         "only; dead ids m=21/22 refused by name)")
+    tn.add_argument("--cb-nodes", default="4",
+                    help="comma-separated aggregator counts (-a axis)")
+    tn.add_argument("--comm-sizes", default="8",
+                    help="comma-separated throttle values (-c axis)")
+    tn.add_argument("--agg-types", default="1",
+                    help="comma-separated placement policies (-t axis)")
+    tn.add_argument("--batch-trials", type=int, default=3,
+                    help="chained differenced trials per candidate per "
+                         "racing batch")
+    tn.add_argument("--max-batches", type=int, default=6,
+                    help="racing rounds before declaring the surviving "
+                         "leader the winner")
+    tn.add_argument("--alpha", type=float, default=0.05,
+                    help="CI level for elimination (bootstrap 1-alpha)")
+    tn.add_argument("--seed", type=int, default=0,
+                    help="bootstrap + synthetic-sampler seed (recorded "
+                         "in the artifact: verdicts are reproducible)")
+    tn.add_argument("--iters-small", type=int, default=50)
+    tn.add_argument("--iters-big", type=int, default=1050)
+    tn.add_argument("--windows", type=int, default=1,
+                    help="timing windows per trial (min taken)")
+    tn.add_argument("--include-tam", action="store_true",
+                    help="allow the hierarchical-engine methods "
+                         "m=15/16 in the grid")
+    tn.add_argument("--tune-root", default=".",
+                    help="where TUNE_*.json is written/kept (default: .)")
+    tn.add_argument("--synthetic", metavar="SPEC", default=None,
+                    help="race a seeded synthetic latency model instead "
+                         "of measuring: 'BASE_US[,mID*FACTOR]...' (e.g. "
+                         "'100,m3*0.5' makes m=3 the 2x-faster oracle); "
+                         "jax-free, deterministic — the artifact it "
+                         "writes replays like a measured one")
+    tn.add_argument("--replay", metavar="TUNE_JSON", default=None,
+                    help="re-derive the elimination order and winner "
+                         "from a TUNE_*.json's recorded samples (no "
+                         "backend, no jax); exits nonzero unless the "
+                         "re-derivation matches the stored record "
+                         "byte-for-byte")
 
     # inspect — print a compiled schedule's round structure
     ins = sub.add_parser(
@@ -510,6 +588,8 @@ def _run_sweep(args) -> int:
 
     nprocs = args.nprocs if args.nprocs is not None \
         else _default_nprocs(args.backend)
+    if getattr(args, "auto", False):
+        _resolve_auto(args, nprocs, sweep=True)
     if args.comm_sizes:
         grid = [int(x) for x in args.comm_sizes.split(",") if x.strip()]
         if not grid:
@@ -578,6 +658,191 @@ def _run_sweep(args) -> int:
                 with open(_sweep_sidecar(args.results_csv), "a") as f:
                     f.write(json.dumps(rec) + "\n")
     return 0
+
+
+def _ints(csv_text: str) -> list[int]:
+    try:
+        vals = [int(x) for x in str(csv_text).split(",") if x.strip()]
+    except ValueError:
+        raise SystemExit(f"tune: not a comma-separated int list: "
+                         f"{csv_text!r}")
+    if not vals:
+        raise SystemExit(f"tune: empty axis value {csv_text!r}")
+    return vals
+
+
+def _run_tune(args) -> int:
+    """The autotuner: racing search (measured or synthetic) persisting a
+    TUNE_*.json, or --replay re-deriving a stored verdict jax-free."""
+    import json
+    import os
+
+    from tpu_aggcomm.tune import cache
+    from tpu_aggcomm.tune import race as race_mod
+    from tpu_aggcomm.tune import space as space_mod
+
+    if args.replay:
+        from tpu_aggcomm.obs.regress import validate_tune
+        try:
+            entry = cache.load_tune(args.replay)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"tune --replay: cannot read "
+                             f"{args.replay}: {e}")
+        errors = validate_tune(entry, os.path.basename(args.replay))
+        if errors:
+            for e in errors:
+                print(f"FAIL {e}", file=sys.stderr)
+            raise SystemExit(f"tune --replay: {args.replay} failed "
+                             f"schema validation ({len(errors)} "
+                             f"error(s))")
+        rec = entry["race"]
+        try:
+            res = race_mod.replay_record(rec)
+        except race_mod.RaceError as e:
+            raise SystemExit(f"tune --replay: {e}")
+        # byte-for-byte: the derived eliminations (every field, CI
+        # bounds included — floats round-trip JSON exactly) and winner
+        # must equal the stored record, or the artifact is inconsistent
+        # with its own samples
+        same = (res.winner == rec.get("winner")
+                and json.loads(json.dumps(res.eliminations))
+                == rec.get("eliminations"))
+        print(f"replay {os.path.basename(args.replay)}: winner "
+              f"{res.winner} after {len(res.eliminations)} "
+              f"elimination(s) over {res.batches_run} batch(es) -> "
+              f"{'REPRODUCED' if same else 'MISMATCH vs stored record'}")
+        for e in res.eliminations:
+            print(f"  batch {e['batch']}: {e['candidate']} out vs "
+                  f"leader {e['leader']} "
+                  f"(CI [{e['ci_pct'][0]:+.1f}%, {e['ci_pct'][1]:+.1f}%])")
+        return 0 if same else 1
+
+    methods = _ints(args.methods)
+    cbs = _ints(args.cb_nodes)
+    comms = _ints(args.comm_sizes)
+    aggs = _ints(args.agg_types)
+    try:
+        cands = space_mod.build_space(methods, cbs, comms, aggs,
+                                      nprocs=args.nprocs,
+                                      include_tam=args.include_tam)
+    except space_mod.SpaceError as e:
+        raise SystemExit(f"tune: {e}")
+    cids = [c.cid for c in cands]
+
+    if args.synthetic:
+        try:
+            sampler = race_mod.make_synthetic_sampler(
+                args.synthetic, batch_trials=args.batch_trials,
+                seed=args.seed)
+        except race_mod.RaceError as e:
+            raise SystemExit(f"tune --synthetic: {e}")
+    else:
+        if args.backend not in SINGLE_DEVICE_BACKENDS:
+            raise SystemExit(
+                f"tune: measured tuning rides the chained jax_sim "
+                f"scaffold (got --backend {args.backend}); pass "
+                f"--backend jax_sim, or --synthetic SPEC for a "
+                f"backend-free run")
+        from tpu_aggcomm.tune.measure import make_jax_sim_sampler
+        sampler = make_jax_sim_sampler(
+            nprocs=args.nprocs, data_size=args.data_size,
+            proc_node=args.proc_node, iters_small=args.iters_small,
+            iters_big=args.iters_big, batch_trials=args.batch_trials,
+            windows=args.windows)
+
+    print(f"tune: racing {len(cids)} candidate(s) "
+          f"({'synthetic ' + args.synthetic if args.synthetic else 'measured, chained jax_sim'}), "
+          f"n={args.nprocs} d={args.data_size} p={args.proc_node}, "
+          f"batches of {args.batch_trials} trial(s), seed {args.seed}")
+    res = race_mod.race(cids, sampler, max_batches=args.max_batches,
+                        alpha=args.alpha, seed=args.seed)
+
+    from tpu_aggcomm.obs.ledger import manifest
+    man = manifest()
+    direction = space_mod.space_direction(methods)
+    key = cache.tune_key(nprocs=args.nprocs, data_size=args.data_size,
+                         proc_node=args.proc_node, direction=direction,
+                         backend=args.backend, manifest=man)
+    win = space_mod.parse_cid(res.winner)
+    race_rec = {"seed": int(args.seed), "alpha": float(args.alpha),
+                "n_boot": 2000, "max_batches": int(args.max_batches),
+                "batch_trials": int(args.batch_trials), "order": cids,
+                "samples": res.samples,
+                "eliminations": res.eliminations, "winner": res.winner,
+                "batches_run": res.batches_run,
+                "survivors": res.survivors}
+    path = cache.save_tune(
+        args.tune_root, key=key, manifest=man,
+        space={"methods": methods, "cb_nodes": cbs,
+               "comm_sizes": comms, "agg_types": aggs},
+        race=race_rec,
+        winner={"method": win.method, "cb_nodes": win.cb_nodes,
+                "comm_size": win.comm_size, "agg_type": win.agg_type},
+        synthetic=bool(args.synthetic))
+
+    meds = res.medians()
+    for e in res.eliminations:
+        print(f"  batch {e['batch']}: {e['candidate']} out vs leader "
+              f"{e['leader']} "
+              f"(CI [{e['ci_pct'][0]:+.1f}%, {e['ci_pct'][1]:+.1f}%])")
+    for cid in res.survivors:
+        if cid != res.winner:
+            print(f"  survivor (not separable from winner at "
+                  f"alpha={args.alpha:g}): {cid} "
+                  f"median {meds[cid] * 1e6:.2f} us")
+    print(f"winner: {res.winner} (median {meds[res.winner] * 1e6:.2f} "
+          f"us/rep) after {res.batches_run} batch(es)")
+    print(f"tuned cache written: {path}")
+    return 0
+
+
+def _resolve_auto(args, nprocs: int, *, sweep: bool = False) -> None:
+    """--auto: swap the explicit -m (and for run: -a/-c/-t) for the
+    tuned winner of this (shape, direction, backend), when a
+    fingerprint-valid cache entry exists. Any miss — no artifact,
+    schema failure, manifest drift, un-directed -m 0 — warns on stderr
+    and keeps the explicit flags: the cache may steer, never strand."""
+    from tpu_aggcomm.core.methods import METHODS
+    from tpu_aggcomm.obs.ledger import manifest
+    from tpu_aggcomm.tune import cache
+
+    if args.method not in METHODS:
+        print(f"auto: -m {args.method} does not name a direction "
+              f"(m=0 runs all methods); keeping explicit flags",
+              file=sys.stderr)
+        return
+    direction = METHODS[args.method].direction.value
+    if args.backend not in DEVICE_FREE_BACKENDS:
+        # device facts (platform/device_kind) are part of the tuning
+        # fingerprint; record them before computing ours so a valid
+        # entry is not rejected for an asymmetry we created
+        try:
+            from tpu_aggcomm.tune.measure import record_device_facts
+            record_device_facts()
+        except Exception:
+            pass
+    man = manifest()
+    key = cache.tune_key(nprocs=nprocs, data_size=args.data_size,
+                         proc_node=args.proc_node, direction=direction,
+                         backend=args.backend, manifest=man)
+    entry, note = cache.lookup(args.tune_root, key, manifest=man)
+    if entry is None:
+        print(f"auto: {note}; falling back to -m {args.method}",
+              file=sys.stderr)
+        return
+    win = entry["winner"]
+    tag = " [synthetic tune]" if entry.get("synthetic") else ""
+    src = cache.artifact_path(args.tune_root, key)
+    if sweep:
+        args.method = int(win["method"])
+        print(f"auto: tuned method -m {args.method}{tag} from {src}")
+    else:
+        args.method = int(win["method"])
+        args.cb_nodes = int(win["cb_nodes"])
+        args.comm_size = int(win["comm_size"])
+        args.agg_type = int(win["agg_type"])
+        print(f"auto: tuned -m {args.method} -a {args.cb_nodes} "
+              f"-c {args.comm_size} -t {args.agg_type}{tag} from {src}")
 
 
 def _run_inspect(args) -> int:
@@ -855,10 +1120,14 @@ def main(argv=None) -> int:
         return _run_inspect(args)
     if args.command == "analyze":
         return _run_analyze(args)
+    if args.command == "tune":
+        return _run_tune(args)
 
     from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
     nprocs = args.nprocs if args.nprocs is not None \
         else _default_nprocs(args.backend)
+    if args.auto:
+        _resolve_auto(args, nprocs)
     cfg = ExperimentConfig(
         nprocs=nprocs, cb_nodes=args.cb_nodes, method=args.method,
         data_size=args.data_size, comm_size=args.comm_size, iters=args.iters,

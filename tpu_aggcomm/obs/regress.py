@@ -47,9 +47,10 @@ import json
 import os
 import re
 
-__all__ = ["validate_bench", "validate_multichip", "load_history",
-           "check_regression", "parsed_schema_version",
-           "DEFAULT_TOLERANCE", "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE"]
+__all__ = ["validate_bench", "validate_multichip", "validate_tune",
+           "load_history", "check_regression", "parsed_schema_version",
+           "DEFAULT_TOLERANCE", "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE",
+           "TUNE_SCHEMAS"]
 
 #: Relative slowdown vs the best prior same-platform round that counts as
 #: a regression. Differenced-chain numbers jitter a few percent
@@ -177,6 +178,119 @@ def validate_multichip(obj, where: str = "MULTICHIP") -> list[str]:
     _require(obj, "ok", bool, errors, where)
     _require(obj, "skipped", bool, errors, where)
     _require(obj, "tail", str, errors, where)
+    return errors
+
+
+#: Accepted TUNE artifact schema tags (versioned like the bench
+#: parsed-schema generations: a new tag is a new entry here, old tags
+#: stay valid forever).
+TUNE_SCHEMAS = ("tune-v1",)
+
+
+def _is_num(x) -> bool:
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def validate_tune(obj, where: str = "TUNE") -> list[str]:
+    """Schema errors (empty list = valid) for one ``TUNE_*.json``
+    tuned-schedule cache artifact (tune/cache.py). A corrupt or stale
+    artifact must FAIL here so ``--auto`` falls back loudly instead of
+    being silently steered by garbage: the winner must be a recorded
+    candidate, every sample batch must be a non-empty list of numbers,
+    and every elimination must name candidate + leader present in the
+    sample record."""
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    schema = obj.get("schema")
+    if schema not in TUNE_SCHEMAS:
+        errors.append(f"{where}: unknown schema tag {schema!r} "
+                      f"(expected one of {list(TUNE_SCHEMAS)})")
+        return errors
+    key = obj.get("key")
+    if not isinstance(key, dict):
+        errors.append(f"{where}: missing/invalid 'key' object")
+    else:
+        for k, types in (("nprocs", int), ("data_size", int),
+                         ("proc_node", int), ("direction", str),
+                         ("backend", str), ("fingerprint", str)):
+            _require(key, k, types, errors, f"{where}.key")
+        if key.get("direction") not in ("all_to_many", "many_to_all",
+                                        None):
+            errors.append(f"{where}.key: direction must be "
+                          f"'all_to_many' or 'many_to_all', got "
+                          f"{key.get('direction')!r}")
+    if "manifest" in obj and obj["manifest"] is not None \
+            and not isinstance(obj["manifest"], dict):
+        errors.append(f"{where}: 'manifest' must be null or an object")
+    race = obj.get("race")
+    if not isinstance(race, dict):
+        errors.append(f"{where}: missing/invalid 'race' object")
+        return errors
+    w = f"{where}.race"
+    for k, types in (("seed", int), ("alpha", float), ("n_boot", int),
+                     ("max_batches", int), ("winner", str),
+                     ("batches_run", int)):
+        _require(race, k, types, errors, w)
+    samples = race.get("samples")
+    if not isinstance(samples, dict) or not samples:
+        errors.append(f"{w}: 'samples' must be a non-empty object "
+                      f"(cid -> list of batches)")
+        samples = {}
+    for cid, batches in samples.items():
+        if not isinstance(batches, list) or not all(
+                isinstance(b, list) and b and all(_is_num(x) for x in b)
+                for b in batches):
+            errors.append(f"{w}.samples[{cid!r}]: every batch must be "
+                          f"a non-empty list of numbers")
+    order = race.get("order")
+    if order is not None:
+        if not isinstance(order, list) \
+                or sorted(order) != sorted(samples):
+            errors.append(f"{w}: 'order' must list exactly the sampled "
+                          f"candidate ids")
+    winner = race.get("winner")
+    if samples and isinstance(winner, str) and winner not in samples:
+        errors.append(f"{w}: winner {winner!r} has no recorded samples")
+    elims = race.get("eliminations")
+    if not isinstance(elims, list):
+        errors.append(f"{w}: 'eliminations' must be a list")
+    else:
+        for i, e in enumerate(elims):
+            if not isinstance(e, dict):
+                errors.append(f"{w}.eliminations[{i}]: must be an object")
+                continue
+            for k in ("batch", "candidate", "leader", "ci_pct"):
+                if k not in e:
+                    errors.append(f"{w}.eliminations[{i}]: missing {k!r}")
+            for k in ("candidate", "leader"):
+                if samples and e.get(k) is not None \
+                        and e.get(k) not in samples:
+                    errors.append(f"{w}.eliminations[{i}]: {k} "
+                                  f"{e.get(k)!r} has no recorded samples")
+            ci = e.get("ci_pct")
+            if ci is not None and (not isinstance(ci, list)
+                                   or len(ci) != 2
+                                   or not all(_is_num(x) for x in ci)):
+                errors.append(f"{w}.eliminations[{i}]: ci_pct must be "
+                              f"[lo, hi]")
+    win = obj.get("winner")
+    if not isinstance(win, dict):
+        errors.append(f"{where}: missing/invalid 'winner' object")
+    else:
+        for k in ("method", "cb_nodes", "comm_size", "agg_type"):
+            _require(win, k, int, errors, f"{where}.winner")
+        if isinstance(race.get("winner"), str) \
+                and all(isinstance(win.get(k), int)
+                        for k in ("method", "cb_nodes", "comm_size",
+                                  "agg_type")):
+            cid = (f"m{win['method']}:a{win['cb_nodes']}:"
+                   f"c{win['comm_size']}:t{win['agg_type']}")
+            if cid != race["winner"]:
+                errors.append(f"{where}: winner object {cid} disagrees "
+                              f"with race.winner {race['winner']!r}")
+    if "synthetic" in obj and not isinstance(obj["synthetic"], bool):
+        errors.append(f"{where}: 'synthetic' must be a bool")
     return errors
 
 

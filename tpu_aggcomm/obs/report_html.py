@@ -139,13 +139,57 @@ def _trace_runs(paths: list[str]) -> list[dict]:
     return out
 
 
+def _tune_rows(root: str) -> list[dict]:
+    """Tuner pane data from every TUNE_*.json under the history root —
+    jax-free (tune/cache.py + statistics): winner per shape, the
+    per-candidate pooled medians, and the elimination trace with its CI
+    bounds. Schema-invalid artifacts become error rows, not crashes."""
+    import statistics
+
+    from tpu_aggcomm.obs.regress import validate_tune
+    from tpu_aggcomm.tune.cache import load_tune, tune_paths
+
+    rows = []
+    for path in tune_paths(root):
+        name = os.path.basename(path)
+        try:
+            blob = load_tune(path)
+        except (OSError, ValueError) as e:
+            rows.append({"file": name, "error": f"unparsable JSON ({e})"})
+            continue
+        errors = validate_tune(blob, name)
+        if errors:
+            rows.append({"file": name, "error": errors[0]})
+            continue
+        race = blob["race"]
+        samples = race["samples"]
+        medians = {cid: statistics.median([x for b in batches for x in b])
+                   for cid, batches in samples.items() if any(batches)}
+        rows.append({
+            "file": name, "error": None, "key": blob["key"],
+            "winner_cid": race["winner"], "winner": blob["winner"],
+            "synthetic": bool(blob.get("synthetic")),
+            "batches_run": race.get("batches_run"),
+            "alpha": race.get("alpha"),
+            "order": race.get("order") or list(samples),
+            "medians": medians,
+            "survivors": race.get("survivors") or [],
+            "eliminations": [
+                {"batch": e.get("batch"), "candidate": e.get("candidate"),
+                 "leader": e.get("leader"), "ci_pct": e.get("ci_pct")}
+                for e in race.get("eliminations", [])]})
+    return rows
+
+
 def build_payload(history_root: str = ".",
                   trace_paths: list[str] | None = None) -> dict:
-    """The dashboard's inlined data: bench/multichip history + per-run
-    trace analytics + any history-load errors (shown, not swallowed)."""
+    """The dashboard's inlined data: bench/multichip history + tuner
+    cache + per-run trace analytics + any history-load errors (shown,
+    not swallowed)."""
     bench, errors = _history_rows(history_root)
     multichip = _multichip_rows(history_root, errors)
     return {"bench": bench, "multichip": multichip,
+            "tune": _tune_rows(history_root),
             "runs": _trace_runs(list(trace_paths or [])),
             "errors": errors}
 
@@ -178,6 +222,8 @@ time; lower is better everywhere (seconds per rep).</p>
 <div id="trajectory"></div>
 <h2>Run ledger (compile / HBM / environment)</h2>
 <div id="ledger"></div>
+<h2>Autotuner cache (winner per shape)</h2>
+<div id="tune"></div>
 <h2>Per-method skew table (trace runs)</h2>
 <div id="skew"></div>
 <h2>Straggler heatmaps (rank &times; round, mean seconds)</h2>
@@ -342,6 +388,90 @@ function fmtS(v) {{
     tbl.appendChild(tr);
   }});
   host.appendChild(tbl);
+}})();
+
+(function tunePane() {{
+  var host = document.getElementById("tune");
+  var rows = DATA.tune || [];
+  if (!rows.length) {{
+    host.appendChild(el("p", {{class: "note"}},
+        "no TUNE_*.json artifacts under the history root " +
+        "(run `cli tune` to populate the tuned-schedule cache)"));
+    return;
+  }}
+  rows.forEach(function (t) {{
+    if (t.error) {{
+      host.appendChild(el("p", {{class: "err"}},
+          "tune artifact error: " + t.error));
+      return;
+    }}
+    var k = t.key;
+    var head = el("p", {{}});
+    head.appendChild(el("b", {{}}, t.file));
+    head.appendChild(document.createTextNode(
+        " — n=" + k.nprocs + " d=" + k.data_size + " p=" + k.proc_node +
+        " " + k.direction + " [" + k.backend + "]" +
+        (t.synthetic ? " (synthetic)" : "") +
+        "  winner: " + t.winner_cid +
+        " after " + t.batches_run + " batch(es)"));
+    host.appendChild(head);
+    // elimination order lookup: cid -> batch it fell at
+    var elim = {{}};
+    (t.eliminations || []).forEach(function (e) {{
+      elim[e.candidate] = e; }});
+    // CI bar scale: widest upper bound across all eliminations
+    var maxHi = 0;
+    (t.eliminations || []).forEach(function (e) {{
+      if (e.ci_pct && e.ci_pct.length === 2)
+        maxHi = Math.max(maxHi, e.ci_pct[1]); }});
+    var tbl = el("table");
+    var hr = el("tr");
+    ["candidate", "median", "status", "CI vs leader (% slower)"]
+      .forEach(function (h, i) {{
+        hr.appendChild(el("th", i !== 1 ? {{class: "l"}} : {{}}, h)); }});
+    tbl.appendChild(hr);
+    (t.order || []).forEach(function (cid) {{
+      var tr = el("tr");
+      tr.appendChild(el("td", {{class: "l"}}, cid));
+      var med = t.medians ? t.medians[cid] : null;
+      tr.appendChild(el("td", {{}},
+          med === null || med === undefined ? "-" : fmtS(med)));
+      var e = elim[cid];
+      var status = cid === t.winner_cid ? "winner" :
+          (e ? "eliminated @ batch " + e.batch + " (vs " + e.leader + ")"
+             : "survivor (not separable)");
+      tr.appendChild(el("td", {{class: "l"}}, status));
+      var td = el("td", {{class: "l"}});
+      if (e && e.ci_pct && e.ci_pct.length === 2 && maxHi > 0) {{
+        var lo = Math.max(0, e.ci_pct[0]), hi = e.ci_pct[1];
+        var wrap = el("span");
+        wrap.style.display = "inline-block";
+        wrap.style.width = "160px";
+        wrap.style.height = "10px";
+        wrap.style.background = "#eee";
+        wrap.style.position = "relative";
+        wrap.style.verticalAlign = "middle";
+        var bar = el("span");
+        bar.style.display = "inline-block";
+        bar.style.position = "absolute";
+        bar.style.left = (lo / maxHi * 160).toFixed(1) + "px";
+        bar.style.width =
+            Math.max(2, (hi - lo) / maxHi * 160).toFixed(1) + "px";
+        bar.style.height = "10px";
+        bar.style.background = "#c2491d";
+        wrap.appendChild(bar);
+        td.appendChild(wrap);
+        td.appendChild(document.createTextNode(
+            " [+" + e.ci_pct[0].toFixed(1) + "%, +" +
+            e.ci_pct[1].toFixed(1) + "%]"));
+      }} else {{
+        td.textContent = "-";
+      }}
+      tr.appendChild(td);
+      tbl.appendChild(tr);
+    }});
+    host.appendChild(tbl);
+  }});
 }})();
 
 (function skewTable() {{

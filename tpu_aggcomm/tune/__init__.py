@@ -1,0 +1,40 @@
+"""Autotuner: statistical racing search over (method, cb_nodes, -c, -t).
+
+The reference answers "which posting/throttling/sync algorithm minimizes
+max-over-ranks completion time for this traffic pattern?" by hand: the
+Theta job scripts (script_theta_*_256.sh) enumerate ``-m``/``-c`` cells
+and a human reads the CSVs. This package closes that loop with the
+measurement machinery the repo already trusts:
+
+- :mod:`tpu_aggcomm.tune.space` — the candidate grid over
+  ``(method_id, cb_nodes, comm_size, agg_type)`` for one fixed
+  shape/backend, with the direction and dead-method guards (an m=1 grid
+  never mixes m=2 methods; m=21/22 are refused by name);
+- :mod:`tpu_aggcomm.tune.race` — the statistical racing loop: each
+  surviving candidate gets batches of chained differenced trials, and a
+  candidate is eliminated only when the seeded bootstrap CI on its
+  median delta vs the current leader excludes zero
+  (``obs/metrics.bootstrap_delta_ci`` — same samples in, same
+  eliminations and winner out, byte for byte);
+- :mod:`tpu_aggcomm.tune.cache` — the persistent tuned-schedule cache:
+  one ``TUNE_*.json`` per ``(shape, direction, backend)`` key, stamped
+  with a manifest fingerprint from the v3 run ledger so environment
+  drift (jax/libtpu/device-kind change) invalidates the entry instead
+  of silently serving a stale winner;
+- :mod:`tpu_aggcomm.tune.measure` — the jax-side sampler (fresh
+  ``harness/chained.py`` differenced trials per racing batch on the
+  jax_sim backend). The ONLY module here that touches jax; everything
+  else stays importable under a poisoned/absent jax, because
+  ``cli tune --replay`` must re-derive a verdict from artifacts on a
+  machine where ``import jax`` may hang on a dead tunnel (the
+  bench.py --check-regression discipline).
+
+Entry points: ``python -m tpu_aggcomm.cli tune`` (search + persist),
+``cli tune --replay TUNE_*.json`` (jax-free re-derivation), and
+``--auto`` on the run/sweep commands (cache-resolved method with an
+explicit warning + fallback on miss or drift).
+"""
+
+from __future__ import annotations
+
+__all__ = ["space", "race", "cache"]

@@ -1,0 +1,66 @@
+"""The jax-side tuner sampler — the ONLY tune module that touches jax.
+
+Real tuning batches are fresh serial-chained differenced trials on the
+jax_sim backend (``harness/chained.py`` scaffold — the honest
+measurement through a tunneled TPU). The backend's ``measure_per_rep``
+memoizes per schedule, which is exactly wrong for racing: every batch
+must be a NEW measurement or the CI over batches collapses to the first
+batch's samples. The sampler therefore drives the cache-bypassing
+``JaxSimBackend.measure_trial_samples`` hook, while still reusing the
+backend instance so jit-compiled chains are shared across batches of
+the SAME candidate (re-timing is cheap; re-compiling per batch through
+the tunnel is not).
+
+Device facts are recorded into the ledger manifest before the first
+sample (mirroring ``harness/runner._sample_device``) so the fingerprint
+stamped into the TUNE artifact matches what a later ``--auto`` run in
+the same environment computes.
+"""
+
+from __future__ import annotations
+
+__all__ = ["record_device_facts", "make_jax_sim_sampler"]
+
+
+def record_device_facts() -> None:
+    """Fill the ledger manifest's platform/device_kind from the live
+    jax client, so tune fingerprints and later --auto lookups see the
+    same environment. Safe no-op when the device query fails."""
+    import jax
+
+    from tpu_aggcomm.obs import ledger
+    try:
+        dev = jax.devices()[0]
+        ledger.record_device(platform=dev.platform,
+                             device_kind=dev.device_kind)
+    except Exception:
+        pass
+
+
+def make_jax_sim_sampler(*, nprocs: int, data_size: int, proc_node: int,
+                         iters_small: int = 50, iters_big: int = 1050,
+                         batch_trials: int = 3, windows: int = 1):
+    """``sampler(cid, batch) -> list[float]`` over the single-device
+    simulation backend: one compiled schedule per candidate (memoized),
+    fresh differenced trials per batch."""
+    from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+    from tpu_aggcomm.tune.space import parse_cid
+
+    record_device_facts()
+    backend = JaxSimBackend()
+    schedules: dict[str, object] = {}
+
+    def sampler(cid: str, batch: int) -> list[float]:
+        if cid not in schedules:
+            c = parse_cid(cid)
+            schedules[cid] = compile_method(c.method, AggregatorPattern(
+                nprocs=nprocs, cb_nodes=c.cb_nodes,
+                data_size=max(data_size, 1), proc_node=proc_node,
+                comm_size=c.comm_size, placement=c.agg_type))
+        return backend.measure_trial_samples(
+            schedules[cid], iters_small=iters_small, iters_big=iters_big,
+            trials=batch_trials, windows=windows)
+
+    return sampler
