@@ -4,7 +4,9 @@ against the shared schema (tpu_aggcomm/obs/regress.py — the same
 definitions ``bench.py --check-regression`` consumes), plus every
 ``TUNE_*.json`` tuned-schedule cache artifact (tune/cache.py): a corrupt
 or stale tune entry must fail validation here instead of silently
-steering ``--auto`` runs.
+steering ``--auto`` runs — and every ``TRAFFIC_*.json`` static traffic
+audit (obs/traffic.py, traffic-v1): a committed audit whose verdict its
+own numbers contradict must fail too.
 
 Usage: ``python scripts/check_bench_schema.py [root]`` (default: repo
 root). Prints one line per artifact, exits nonzero if any artifact is
@@ -21,13 +23,36 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tpu_aggcomm.obs.regress import (load_history, parsed_schema_version,
                                      validate_bench, validate_multichip,
-                                     validate_tune)
+                                     validate_traffic, validate_tune)
 
 
 def check(root: str) -> int:
+    import glob
     n_files = 0
     n_errors = 0
     n_tune = 0
+    n_traffic = 0
+    # TRAFFIC_*.json static-audit artifacts (obs/traffic.py): like the
+    # tune cache, absence is fine, a present-but-broken one is not
+    for path in sorted(glob.glob(os.path.join(root, "TRAFFIC_*.json"))):
+        n_files += 1
+        n_traffic += 1
+        name = os.path.basename(path)
+        try:
+            with open(path) as fh:
+                blob = json.load(fh)
+        except (OSError, ValueError) as e:
+            n_errors += 1
+            print(f"FAIL {name}: unparsable JSON ({e})")
+            continue
+        errors = validate_traffic(blob, name)
+        if errors:
+            n_errors += len(errors)
+            for e in errors:
+                print(f"FAIL {e}")
+        else:
+            verdict = blob.get("conformance", {}).get("verdict", "?")
+            print(f"ok   {name} ({blob.get('schema', '?')}, {verdict})")
     from tpu_aggcomm.tune.cache import tune_paths
     for path in tune_paths(root):
         n_files += 1
@@ -81,7 +106,7 @@ def check(root: str) -> int:
         # an absent tune cache is fine; an absent bench history is not
         print(f"FAIL no BENCH_r*/MULTICHIP_r*.json found under {root}")
         return 1
-    print(f"{n_files} artifact(s) ({n_tune} tune), "
+    print(f"{n_files} artifact(s) ({n_tune} tune, {n_traffic} traffic), "
           f"{n_errors} schema error(s)")
     return 1 if n_errors else 0
 

@@ -7,7 +7,8 @@
 # CPU-only, marker-filtered (-m 'not slow'), bounded at 870 s. Prints
 # DOTS_PASSED=<count> (progress-dot count from the pytest tail), then
 # runs the jax-free supervisor checks (bench-artifact schema validation
-# + the --check-regression gate over the committed history) and exits
+# + the --check-regression gate over the committed history + the static
+# throttle-conformance sweep over every method) and exits
 # nonzero if either the suite or a post-step failed. Run from anywhere;
 # it cd's to the repo root first. NOTE: JAX_PLATFORMS=cpu alone is not
 # enough on the tunnel host — unset PALLAS_AXON_POOL_IPS in your
@@ -29,6 +30,12 @@ rc=$?
 post_rc=0
 python scripts/check_bench_schema.py || post_rc=1
 python bench.py --check-regression || post_rc=1
+# static throttle-conformance gate (obs/traffic.py, jax-free): every
+# method's in-flight accounting must respect its documented -c bound —
+# a schedule generator that over-posts invalidates the -c semantics the
+# whole benchmark studies, and this catches it with no backend at all
+python -m tpu_aggcomm.cli inspect traffic -m 0 -n 32 -a 8 -c 4 \
+  > /dev/null || post_rc=1
 # tuned-schedule cache replay (tune/race.py, jax-free): every committed
 # TUNE_*.json must re-derive its recorded elimination order and winner
 # byte-for-byte from its own samples — an artifact that cannot reproduce

@@ -81,7 +81,7 @@ def test_obs_package_imports_no_jax():
          "import tpu_aggcomm.obs, tpu_aggcomm.obs.regress, "
          "tpu_aggcomm.obs.metrics, tpu_aggcomm.obs.compare, "
          "tpu_aggcomm.obs.report_html, tpu_aggcomm.obs.perfetto, "
-         "tpu_aggcomm.obs.ledger, sys; "
+         "tpu_aggcomm.obs.ledger, tpu_aggcomm.obs.traffic, sys; "
          "assert 'jax' not in sys.modules, 'obs imported jax'"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr
@@ -169,9 +169,17 @@ def test_perfetto_valid_and_monotone(tmp_path):
     assert slices, "no reconstructed rank slices"
     for e in slices:
         assert e["args"]["phase_source"] in PHASE_SOURCES
-    # one counter track with bytes-in-flight samples
+    # counter tracks: byte-valued ones carry args.bytes, the traffic_*
+    # count-valued ones carry args.value (never mislabeled as bytes)
     counters = [e for e in evs if e.get("ph") == "C"]
-    assert counters and all("bytes" in e["args"] for e in counters)
+    assert counters
+    for e in counters:
+        key = ("bytes" if e["name"] == "bytes_in_flight"
+               or e["name"].startswith("hbm_") else "value")
+        assert key in e["args"], (e["name"], e["args"])
+    names = {e["name"] for e in counters}
+    assert {"bytes_in_flight", "traffic_msgs",
+            "traffic_max_incast"} <= names
 
 
 def test_perfetto_rank_tracks(tmp_path):

@@ -1,0 +1,415 @@
+"""Traffic auditor (tpu_aggcomm/obs/traffic.py) guarantees:
+
+- the whole audit path — single-method audit AND the -m 0 conformance
+  sweep — runs where jax cannot import (poisoned-jax subprocess: the
+  same recipe as the tune --replay and supervisor pins);
+- ``Schedule.data_edges()`` carries a real receiver slot (joined from
+  ``recv_slot_table``) for nonblocking-send AND SENDRECV methods — the
+  historical slot_dst=-1 placeholder is a regression;
+- the in-flight accounting proves CONFORMS for every non-dead method
+  over a grid of (nprocs, cb_nodes, comm_size) shapes, and REFUTES a
+  synthetic over-poster naming the offending (rank, round, count);
+- m=13's ``-b`` barrier modes audit to distinct barrier signatures
+  (none / one per rep / one per block);
+- the measured overlay joins the static matrix with flight-recorder
+  round walls FLOAT-EXACTLY (the walls are obs.metrics.round_stats
+  verbatim; eff_bps and frac_roofline are pure arithmetic on them);
+- the traffic-v1 artifact written by ``inspect traffic --json``
+  validates under obs.regress.validate_traffic (the same check
+  scripts/check_bench_schema.py applies to committed TRAFFIC_*.json);
+- satellite: inspect trace/compare/ledger exit nonzero with a one-line
+  stderr message — no traceback — on missing or corrupt artifacts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_aggcomm.core.methods import METHODS, compile_method
+from tpu_aggcomm.core.pattern import AggregatorPattern
+from tpu_aggcomm.core.schedule import Op, OpKind, Schedule
+from tpu_aggcomm.obs.traffic import (TrafficError, audit_schedule,
+                                     conformance_sweep, documented_bound,
+                                     incast_depths, inflight_audit,
+                                     measured_overlay, pearson, round_edges,
+                                     round_traffic)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pattern(nprocs=8, cb_nodes=2, data_size=64, comm_size=2,
+             proc_node=1, placement=1):
+    return AggregatorPattern(nprocs=nprocs, cb_nodes=cb_nodes,
+                             data_size=data_size, proc_node=proc_node,
+                             comm_size=comm_size, placement=placement)
+
+
+# ------------------------------------------------------------- jax-free pin
+
+def _poisoned_env(tmp_path):
+    """A sys.path entry where ``import jax`` raises — the audit must not
+    even try (same recipe as tests/test_tune.py's --replay pin)."""
+    poison = tmp_path / "jax"
+    poison.mkdir()
+    (poison / "__init__.py").write_text(
+        "raise ImportError('poisoned jax: the traffic auditor must not "
+        "import jax')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
+    return env
+
+
+def test_audit_survives_poisoned_jax(tmp_path):
+    """The ISSUE acceptance command, byte-for-byte, where jax is broken."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "traffic",
+         "-m", "3", "-n", "32", "-a", "8", "-c", "4"],
+        cwd=REPO, env=_poisoned_env(tmp_path), capture_output=True,
+        text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "conformance: CONFORMS" in r.stdout
+    assert "max incast" in r.stdout
+    assert "dst" in r.stdout          # the per-round matrix actually printed
+
+
+def test_sweep_survives_poisoned_jax(tmp_path):
+    """The ci_tier1.sh gate command, byte-for-byte, where jax is broken."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "traffic",
+         "-m", "0", "-n", "32", "-a", "8", "-c", "4"],
+        cwd=REPO, env=_poisoned_env(tmp_path), capture_output=True,
+        text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "REFUTED: 0 of" in r.stdout
+
+
+# --------------------------------------------- data_edges slot_dst (sat. 1)
+
+@pytest.mark.parametrize("method", [1, 6])
+def test_data_edges_carries_receiver_slot(method):
+    """Regression: the send-side rows of ``data_edges()`` must join the
+    receiver's slot from ``recv_slot_table`` — m=1 (nonblocking ISSEND
+    sends) and m=6 (paired SENDRECV) both used to emit the -1
+    placeholder in column 3."""
+    sched = compile_method(method, _pattern())
+    rtable = sched.recv_slot_table()
+    edges = sched.data_edges()
+    assert len(edges) > 0
+    for src, dst, _sslot, dslot, _rnd in edges:
+        key = (int(src), int(dst))
+        assert key in rtable, f"send {key} has no matching recv"
+        assert int(dslot) == rtable[key], (
+            f"edge {key}: slot_dst {int(dslot)} != recv_slot_table "
+            f"{rtable[key]}")
+    assert not (edges[:, 3] == -1).any()
+
+
+# ------------------------------------------------------- matrix accounting
+
+def test_round_edges_match_data_edges():
+    """The traffic matrix and the schedule's own edge view must agree on
+    the payload universe (network edges; COPY tracked apart)."""
+    sched = compile_method(1, _pattern())
+    per_round = round_edges(sched)
+    d = sched.pattern.data_size
+    # m=1 posts real MPI self-sends (ISSEND to self) — they ARE edges
+    from_edges = {}
+    for src, dst, _ss, _ds, rnd in sched.data_edges():
+        from_edges[(int(rnd), int(src), int(dst))] = d
+    from_traffic = {(r, s, t): b
+                    for r, c in per_round.items()
+                    for (s, t), b in c["edges"].items()}
+    assert from_traffic == from_edges
+
+
+def test_incast_depths_counts_distinct_sources():
+    edges = {(0, 7): 64, (1, 7): 64, (2, 7): 64, (3, 5): 64}
+    assert incast_depths(edges) == {7: 3, 5: 1}
+
+
+def test_round_traffic_summary_totals():
+    sched = compile_method(1, _pattern())
+    rt = round_traffic(sched)
+    assert rt is not None
+    audit = audit_schedule(sched)
+    assert sum(r["bytes"] for r in rt.values()) == audit["totals"]["bytes"]
+    assert all(set(v) == {"msgs", "bytes", "max_incast"}
+               for v in rt.values())
+
+
+def test_tam_engine_raises_traffic_error():
+    sched = compile_method(15, _pattern(proc_node=4))
+    with pytest.raises(TrafficError):
+        round_edges(sched)
+    with pytest.raises(TrafficError):
+        inflight_audit(sched)
+    assert audit_schedule(sched)["conformance"]["verdict"] == "EXEMPT"
+
+
+# ------------------------------------------------- conformance (tentpole)
+
+def test_conformance_grid_all_methods():
+    """Every non-dead method CONFORMS (or is EXEMPT) on a grid of small
+    shapes — the static proof that the schedule generators respect the
+    -c semantics the benchmark studies. Dead methods are audited too
+    (m=22 documents its own unthrottled bound)."""
+    for nprocs, cb, c in [(4, 1, 1), (8, 2, 2), (8, 4, 3), (16, 4, 8),
+                          (12, 3, 2)]:
+        rows = conformance_sweep(nprocs, cb, c, data_size=256)
+        assert len(rows) == len(METHODS)
+        refuted = [r for r in rows if r["verdict"] == "REFUTED"]
+        assert not refuted, (
+            f"n={nprocs} a={cb} c={c}: {[(r['method'], r['peak'], r['bound']) for r in refuted]}")
+        for r in rows:
+            if r["verdict"] == "CONFORMS":
+                assert r["peak"] <= r["bound"]
+
+
+def test_conformance_property_hypothesis():
+    """Property form of the grid test: random small shapes, every
+    dispatched method stays within its documented bound."""
+    hyp = pytest.importorskip("hypothesis")
+    st = pytest.importorskip("hypothesis.strategies")
+
+    @hyp.settings(max_examples=25, deadline=None)
+    @hyp.given(nprocs=st.integers(2, 16), cb=st.integers(1, 8),
+               c=st.integers(1, 16))
+    def prop(nprocs, cb, c):
+        hyp.assume(cb <= nprocs)
+        rows = conformance_sweep(nprocs, cb, c, data_size=64,
+                                 include_dead=False)
+        assert all(r["verdict"] != "REFUTED" for r in rows)
+
+    prop()
+
+
+def test_refuted_overposter_names_offender():
+    """A hand-built schedule that posts 3 rendezvous sends before its
+    waitall under a -c 1 throttle must be REFUTED with the offending
+    (rank, round, count) named — the auditor cannot only ever agree."""
+    p = _pattern(nprocs=4, cb_nodes=2, comm_size=1)
+    bound, _ = documented_bound(12, p)
+    assert bound == 1                       # min(c, cb) = min(1, 2)
+    programs = [[
+        Op(OpKind.ISSEND, peer=1, slot=0, round=0, token=0, nbytes=64),
+        Op(OpKind.ISSEND, peer=2, slot=1, round=0, token=1, nbytes=64),
+        Op(OpKind.ISSEND, peer=3, slot=2, round=0, token=2, nbytes=64),
+        Op(OpKind.WAITALL, tokens=(0, 1, 2)),
+    ]]
+    for r in (1, 2, 3):
+        programs.append([
+            Op(OpKind.IRECV, peer=0, slot=0, round=0, token=0, nbytes=64),
+            Op(OpKind.WAITALL, tokens=(0,)),
+        ])
+    sched = Schedule(pattern=p, method_id=12, name="synthetic overposter",
+                     programs=programs)
+    audit = audit_schedule(sched)
+    conf = audit["conformance"]
+    assert conf["verdict"] == "REFUTED"
+    assert conf["peak"] == 3 and conf["bound"] == 1
+    assert conf["offenders"][0] == {"rank": 0, "round": 0, "count": 3}
+    # and the CLI renderer surfaces it
+    from tpu_aggcomm.obs.traffic import render_audit
+    text = render_audit(audit)
+    assert "REFUTED" in text and "rank    0 round   0: 3 outstanding" in text
+
+
+def test_m13_barrier_modes_distinct_signatures():
+    """m=13's -b modes compile different programs from the same pattern;
+    the audit's barrier signature must tell them apart (0 = none,
+    1 = one per rep in the last round, 2 = one per block)."""
+    p = _pattern()
+    sigs = {}
+    for bt in (0, 1, 2):
+        audit = audit_schedule(compile_method(13, p, barrier_type=bt))
+        assert audit["conformance"]["verdict"] == "CONFORMS"
+        sigs[bt] = audit["barrier_rounds"]
+    assert sigs[0] == {}
+    assert sum(sigs[1].values()) == 1
+    assert sum(sigs[2].values()) > 1
+    # per-block mode fences every round the per-rep mode fences, and more
+    assert set(sigs[1]) <= set(sigs[2])
+
+
+def test_inflight_blocking_methods_post_nothing():
+    """Fully blocking methods hold zero nonblocking tokens — bound 0,
+    peak 0, and the signal channel stays separate."""
+    for mid in (6, 9, 10):
+        sched = compile_method(mid, _pattern())
+        ranks = inflight_audit(sched)
+        assert max(r["peak"] for r in ranks) == 0, mid
+
+
+# ------------------------------------------------- measured overlay (exact)
+
+def _traced_jax_sim_run(tmp_path):
+    import io
+
+    from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+    from tpu_aggcomm.obs import trace
+
+    cfg = ExperimentConfig(nprocs=8, cb_nodes=2, data_size=64, comm_size=2,
+                           method=1, ntimes=3, backend="jax_sim",
+                           verify=True,
+                           results_csv=str(tmp_path / "r.csv"))
+    trace.enable()
+    try:
+        run_experiment(cfg, out=io.StringIO())
+    finally:
+        paths = trace.flush(str(tmp_path / "ov"))
+        trace.disable()
+    return paths[0]
+
+
+def test_overlay_walls_match_trace_float_exactly(tmp_path):
+    """The overlay's round walls ARE obs.metrics.round_stats — not a
+    recomputation — and eff/frac columns are pure arithmetic on them."""
+    from tpu_aggcomm.harness.roofline import floor_seconds
+    from tpu_aggcomm.obs.metrics import round_stats
+    from tpu_aggcomm.obs.trace import load_events
+
+    jsonl = _traced_jax_sim_run(tmp_path)
+    events = load_events(jsonl)
+    sched = compile_method(1, _pattern())
+    audit = audit_schedule(sched)
+    overlay = measured_overlay(audit, events)
+    stats = {s["round"]: s for s in round_stats(events, overlay["run"])
+             if isinstance(s["round"], int) and s["round"] >= 0}
+    assert overlay["rounds"], "jax_sim trace must carry per-round slices"
+    byts = {r["round"]: r["bytes"] for r in audit["rounds"]}
+    for row in overlay["rounds"]:
+        wall = stats[row["round"]]["wall"]
+        assert row["wall_s"] == wall                       # float-exact
+        assert row["eff_bps"] == byts[row["round"]] / wall
+        assert row["frac_roofline"] == \
+            floor_seconds(byts[row["round"]]) / wall
+    isj = overlay["incast_straggler"]
+    assert "pearson_recv_bytes_vs_total_s" in isj
+    assert isj["critical_rank"] in range(8)
+
+
+def test_overlay_refuses_mismatched_trace(tmp_path):
+    jsonl = _traced_jax_sim_run(tmp_path)
+    from tpu_aggcomm.obs.trace import load_events
+    events = load_events(jsonl)
+    audit = audit_schedule(compile_method(3, _pattern(nprocs=16)))
+    with pytest.raises(TrafficError):
+        measured_overlay(audit, events)
+
+
+def test_pearson_basics():
+    assert pearson([1, 2, 3], [2, 4, 6]) == pytest.approx(1.0)
+    assert pearson([1, 2, 3], [6, 4, 2]) == pytest.approx(-1.0)
+    assert pearson([1, 1, 1], [1, 2, 3]) is None      # constant side
+    assert pearson([1], [2]) is None                  # too short
+
+
+# ------------------------------------------------------- artifact (schema)
+
+def test_cli_json_artifact_validates(tmp_path, capsys):
+    from tpu_aggcomm.cli import main
+    from tpu_aggcomm.obs.regress import validate_traffic
+
+    path = str(tmp_path / "TRAFFIC_t.json")
+    rc = main(["inspect", "traffic", "-m", "3", "-n", "32", "-a", "8",
+               "-c", "4", "--json", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "conformance: CONFORMS" in out
+    with open(path) as fh:
+        blob = json.load(fh)
+    assert validate_traffic(blob, "TRAFFIC_t.json") == []
+    assert blob["schema"] == "traffic-v1"
+    assert blob["config"]["method"] == 3
+
+
+def test_validate_traffic_rejects_contradiction(tmp_path, capsys):
+    """A verdict its own numbers contradict must fail validation — the
+    check_bench_schema.py gate for committed TRAFFIC_*.json."""
+    from tpu_aggcomm.cli import main
+    from tpu_aggcomm.obs.regress import validate_traffic
+
+    path = str(tmp_path / "TRAFFIC_bad.json")
+    main(["inspect", "traffic", "-m", "3", "-n", "8", "-a", "2",
+          "-c", "2", "--json", path])
+    capsys.readouterr()
+    with open(path) as fh:
+        blob = json.load(fh)
+    blob["conformance"]["verdict"] = "REFUTED"        # but no offenders
+    assert validate_traffic(blob, "bad") != []
+    blob["conformance"]["verdict"] = "CONFORMS"
+    blob["conformance"]["peak"] = blob["conformance"]["bound"] + 1
+    assert validate_traffic(blob, "bad") != []
+
+
+def test_committed_traffic_artifacts_validate():
+    """Every committed TRAFFIC_*.json passes the same validation the
+    schema checker script applies."""
+    import glob
+
+    from tpu_aggcomm.obs.regress import validate_traffic
+    paths = sorted(glob.glob(os.path.join(REPO, "TRAFFIC_*.json")))
+    assert paths, "expected at least one committed TRAFFIC_*.json"
+    for p in paths:
+        with open(p) as fh:
+            blob = json.load(fh)
+        assert validate_traffic(blob, os.path.basename(p)) == [], p
+
+
+# ------------------------------------------- CLI error handling (satellite 2)
+
+def _cli(args, cwd=REPO):
+    return subprocess.run([sys.executable, "-m", "tpu_aggcomm.cli"] + args,
+                          cwd=cwd, capture_output=True, text=True,
+                          timeout=120)
+
+
+@pytest.mark.parametrize("argv", [
+    ["inspect", "trace", "/nonexistent/x.trace.jsonl"],
+    ["inspect", "ledger", "/nonexistent/x.trace.jsonl"],
+    ["inspect", "traffic", "-m", "1", "--trace",
+     "/nonexistent/x.trace.jsonl"],
+])
+def test_cli_missing_artifact_one_line_error(argv):
+    r = _cli(argv)
+    assert r.returncode != 0
+    assert "Traceback" not in r.stderr, r.stderr
+    assert r.stderr.strip(), "expected a one-line stderr message"
+
+
+def test_cli_corrupt_artifact_one_line_error(tmp_path):
+    bad = tmp_path / "bad.trace.jsonl"
+    bad.write_text('{"ev": "run", truncated garbage\n')
+    bad2 = tmp_path / "bad2.trace.jsonl"
+    bad2.write_text("not json at all\n")
+    for argv in (["inspect", "trace", str(bad)],
+                 ["inspect", "compare", str(bad), str(bad2)],
+                 ["inspect", "ledger", str(bad)]):
+        r = _cli(argv)
+        assert r.returncode != 0, argv
+        assert "Traceback" not in r.stderr, (argv, r.stderr)
+        assert r.stderr.strip(), argv
+
+
+def test_cli_truncated_trace_one_line_error(tmp_path):
+    """A trace cut mid-write (last line sliced) must fail cleanly."""
+    jsonl = _traced_jax_sim_run(tmp_path)
+    with open(jsonl) as fh:
+        data = fh.read()
+    cut = tmp_path / "cut.trace.jsonl"
+    head = data[:len(data) // 2].rsplit("\n", 1)[0]
+    cut.write_text(head + '\n{"ev": "span", "trunc')
+    r = _cli(["inspect", "trace", str(cut)])
+    assert r.returncode != 0
+    assert "Traceback" not in r.stderr, r.stderr
+
+
+def test_cli_sweep_rejects_json_and_trace():
+    from tpu_aggcomm.cli import main
+    with pytest.raises(SystemExit):
+        main(["inspect", "traffic", "-m", "0", "--json", "/tmp/x.json"])
+    with pytest.raises(SystemExit):
+        main(["inspect", "traffic"])          # -m required

@@ -282,12 +282,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "traces and flags environment drift between "
                         "consecutive ones")
     ins.add_argument("what", nargs="?", choices=["trace", "compare",
-                                                 "report", "ledger"],
+                                                 "report", "ledger",
+                                                 "traffic"],
                      default=None,
                      help="'trace' to summarize *.trace.jsonl files, "
                           "'compare' to diff two of them, 'report' for "
                           "the HTML dashboard, 'ledger' for run-ledger "
-                          "manifests + environment drift — instead of a "
+                          "manifests + environment drift, 'traffic' for "
+                          "the static communication-matrix / incast / "
+                          "throttle-conformance audit (-m 0 sweeps every "
+                          "method as a pass/fail gate) — instead of a "
                           "compiled schedule")
     ins.add_argument("trace_file", nargs="*", default=[],
                      help="trace files: one or more to summarize "
@@ -325,6 +329,15 @@ def build_parser() -> argparse.ArgumentParser:
                      help="pallas_dma wave accounting, lockstep vs "
                           "concurrent: in-flight DMAs per wave — where "
                           "the -c throttle becomes physical concurrency")
+    ins.add_argument("--trace", metavar="FILE", default=None,
+                     help="'traffic' only: join the static matrix with "
+                          "this flight-recorder trace's round walls — "
+                          "per-round effective bytes/s, fraction of the "
+                          "HBM roofline, incast-vs-straggler correlation")
+    ins.add_argument("--json", metavar="PATH", default=None,
+                     help="'traffic' only: also write the audit as a "
+                          "traffic-v1 JSON artifact (TRAFFIC_*.json is "
+                          "schema-checked by scripts/check_bench_schema.py)")
 
     # analyze — summarize accumulated results.csv rows
     an = sub.add_parser(
@@ -845,6 +858,61 @@ def _resolve_auto(args, nprocs: int, *, sweep: bool = False) -> None:
               f"-c {args.comm_size} -t {args.agg_type}{tag} from {src}")
 
 
+def _run_inspect_traffic(args) -> int:
+    """Static traffic audit (obs/traffic.py, jax-free): the per-round
+    communication matrix, incast depths, and the -c throttle-conformance
+    verdict, derived ONLY from the compiled op programs. ``-m 0`` sweeps
+    every method in METHODS as a pass/fail gate (scripts/ci_tier1.sh
+    runs exactly that); ``--trace FILE`` joins the matrix with a
+    flight-recorder trace's round walls for the measured overlay."""
+    from tpu_aggcomm.obs import traffic as tr
+
+    if args.method is None:
+        raise SystemExit("inspect traffic: -m is required "
+                         "(-m 0 sweeps every method as a gate)")
+    if args.method == 0:
+        if args.json or args.trace:
+            raise SystemExit("inspect traffic: --json/--trace apply to a "
+                             "single-method audit, not the -m 0 sweep")
+        rows = tr.conformance_sweep(
+            args.nprocs, args.cb_nodes, args.comm_size,
+            data_size=args.data_size, proc_node=args.proc_node,
+            agg_type=args.agg_type)
+        print(tr.render_sweep(rows, args.nprocs, args.cb_nodes,
+                              args.comm_size), end="")
+        return 1 if any(r["verdict"] == "REFUTED" for r in rows) else 0
+
+    from tpu_aggcomm.core.methods import METHODS, compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+
+    if args.method not in METHODS:
+        raise SystemExit(f"inspect traffic: unknown method {args.method} "
+                         f"(known: {sorted(METHODS)})")
+    p = AggregatorPattern(
+        nprocs=args.nprocs, cb_nodes=args.cb_nodes,
+        data_size=args.data_size, placement=args.agg_type,
+        proc_node=args.proc_node, comm_size=args.comm_size)
+    sched = compile_method(args.method, p, barrier_type=args.barrier_type)
+    audit = tr.audit_schedule(sched)
+    overlay = None
+    if args.trace:
+        from tpu_aggcomm.obs.trace import load_events
+        try:
+            events = load_events(args.trace)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"inspect traffic: unreadable trace "
+                             f"{args.trace}: {e}")
+        try:
+            overlay = tr.measured_overlay(audit, events)
+        except (tr.TrafficError, KeyError) as e:
+            raise SystemExit(f"inspect traffic: {e}")
+    print(tr.render_audit(audit, overlay), end="")
+    if args.json:
+        path = tr.write_artifact(args.json, audit, overlay)
+        print(f"traffic artifact written: {path}")
+    return 1 if audit["conformance"]["verdict"] == "REFUTED" else 0
+
+
 def _run_inspect(args) -> int:
     """Schedule-shape report: what the -c/-m/-t choices actually compile
     to. This is the question the per-phase timers approximate at runtime,
@@ -854,7 +922,12 @@ def _run_inspect(args) -> int:
             raise SystemExit("inspect trace: missing trace file(s) "
                              "(*.trace.jsonl written by --trace)")
         from tpu_aggcomm.obs.metrics import summarize_traces
-        print(summarize_traces(args.trace_file), end="")
+        # a missing/corrupt/truncated artifact must exit with one line
+        # on stderr, not a traceback (json decode errors are ValueError)
+        try:
+            print(summarize_traces(args.trace_file), end="")
+        except (OSError, ValueError, KeyError) as e:
+            raise SystemExit(f"inspect trace: unreadable trace file: {e}")
         return 0
     if args.what == "compare":
         if len(args.trace_file) != 2:
@@ -867,8 +940,12 @@ def _run_inspect(args) -> int:
                                 by=args.by)
         except TraceCompareError as e:
             raise SystemExit(f"inspect compare: {e}")
+        except (OSError, ValueError, KeyError) as e:
+            raise SystemExit(f"inspect compare: unreadable trace file: {e}")
         print(render_compare(res), end="")
         return 0
+    if args.what == "traffic":
+        return _run_inspect_traffic(args)
     if args.what == "report":
         from tpu_aggcomm.obs.report_html import write_report
         path = write_report(args.out, history_root=args.history_root,
@@ -887,7 +964,10 @@ def _run_inspect(args) -> int:
                 "inspect ledger: no artifacts found (pass BENCH_r*.json / "
                 "*.trace.jsonl files, or point --history-root at a "
                 "directory holding BENCH_r*.json)")
-        print(ledger.render_ledgers(paths), end="")
+        try:
+            print(ledger.render_ledgers(paths), end="")
+        except (OSError, ValueError, KeyError) as e:
+            raise SystemExit(f"inspect ledger: unreadable artifact: {e}")
         return 0
     if args.method is None:
         raise SystemExit("inspect: -m is required "

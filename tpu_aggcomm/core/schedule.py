@@ -138,15 +138,21 @@ class Schedule:
     def data_edges(self) -> np.ndarray:
         """All payload-carrying (src, dst, slot_src, slot_dst, round) tuples.
 
-        Derived from the *send* side ops plus COPY self-edges. Shape (E, 5).
+        Derived from the *send* side ops plus COPY self-edges, with
+        ``slot_dst`` joined from :meth:`recv_slot_table` (directed pairs
+        are unique per rep in every reference method, so the join is
+        exact; -1 only when no matching receive exists). Shape (E, 5).
         """
         rows = []
+        rtable = self.recv_slot_table()
         for rank, prog in enumerate(self.programs):
             for op in prog:
                 if op.kind in (OpKind.ISEND, OpKind.ISSEND, OpKind.SEND) and op.nbytes > 0:
-                    rows.append((rank, op.peer, op.slot, -1, op.round))
+                    dslot = rtable.get((rank, op.peer), -1)
+                    rows.append((rank, op.peer, op.slot, dslot, op.round))
                 elif op.kind is OpKind.SENDRECV and op.nbytes > 0:
-                    rows.append((rank, op.peer, op.slot, -1, op.round))
+                    dslot = rtable.get((rank, op.peer), -1)
+                    rows.append((rank, op.peer, op.slot, dslot, op.round))
                 elif op.kind is OpKind.COPY:
                     rows.append((rank, rank, op.slot, op.slot2, op.round))
         return np.array(rows, dtype=np.int64).reshape(-1, 5)

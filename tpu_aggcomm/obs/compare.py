@@ -10,6 +10,10 @@ delta), and reports:
   headline the reference's MAX-reduce studies;
 - the dominant (rank, round) delta cell — WHERE the change happened,
   with the run's PHASE_SOURCES provenance carried through;
+- the bytes-weighted round delta: each round's wall delta weighted by
+  the payload bytes that round moves (the static ``round_bytes``
+  accounting the recorder stores per run, obs/traffic.py universe) —
+  rounds that move the traffic dominate the verdict;
 - a per-key table (key = rank, round, or phase) with per-cell deltas
   and a sign test over repeated trials: per-dispatch runs record one
   slice set per rep, so paired per-rep deltas exist and the sign test
@@ -155,6 +159,32 @@ def compare_traces(events_a: list[dict], events_b: list[dict],
                 "share_of_total_delta": (deltas[dkey] / wall_delta
                                          if wall_delta else None)}
 
+        # bytes-weighted round delta: weight each round's wall delta by
+        # the payload bytes that round moves (the run's static
+        # round_bytes accounting, obs/traffic.py universe) — the
+        # traffic-centric headline, computed on the full grid
+        # regardless of --by. None when the trace predates round_bytes
+        # or carries no per-round slices.
+        rbytes = ra.get("round_bytes") or {}
+        bytes_weighted = None
+        if rbytes:
+            wall_a_r: dict = {}
+            wall_b_r: dict = {}
+            for (_rank, rnd), secs in ga.items():
+                wall_a_r[rnd] = max(wall_a_r.get(rnd, 0.0), secs)
+            for (_rank, rnd), secs in gb.items():
+                wall_b_r[rnd] = max(wall_b_r.get(rnd, 0.0), secs)
+            num = den = 0.0
+            for rnd, a_v in wall_a_r.items():
+                byts = rbytes.get(str(rnd))
+                b_v = wall_b_r.get(rnd)
+                if not byts or not a_v or b_v is None:
+                    continue
+                num += byts * (b_v - a_v) / a_v
+                den += byts
+            if den:
+                bytes_weighted = num / den * 100.0
+
         # per-key table with sign tests over paired per-rep deltas
         ka = _mean_by_key(pa, lambda c: _one(c, by))
         kb = _mean_by_key(pb, lambda c: _one(c, by))
@@ -179,7 +209,8 @@ def compare_traces(events_a: list[dict], events_b: list[dict],
             "total_a_s": total_a, "total_b_s": total_b,
             "total_delta_pct": ((total_b - total_a) / total_a * 100.0
                                 if total_a else None),
-            "dominant": dominant, "table": table}
+            "dominant": dominant,
+            "bytes_weighted_delta_pct": bytes_weighted, "table": table}
         if (len(runs_a) == 1 and samples_a and samples_b):
             lo, hi = bootstrap_delta_ci(samples_a, samples_b)
             rec["total_ci_pct"] = [lo * 100.0, hi * 100.0]
@@ -250,6 +281,12 @@ def _render_one(res: dict, by: str, lines: list) -> None:
             f"  max-over-ranks total: A {rec['total_a_s']:.6f} s  "
             f"B {rec['total_b_s']:.6f} s"
             + (f"  delta {dp:+.1f}%" if dp is not None else ""))
+        bw = rec.get("bytes_weighted_delta_pct")
+        if bw is not None:
+            lines.append(
+                f"  bytes-weighted round delta: {bw:+.1f}% "
+                f"(each round's wall delta weighted by its payload "
+                f"bytes)")
         if "total_ci_pct" in rec:
             lo, hi = rec["total_ci_pct"]
             lines.append(

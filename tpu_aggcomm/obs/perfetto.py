@@ -15,7 +15,10 @@ form), loadable in ``ui.perfetto.dev`` or ``chrome://tracing``:
   (``phase_source``) — the UI can never present a reconstructed slice
   as a measurement.
 - pid 2, tid 0: the ``bytes_in_flight`` counter track (payload bytes
-  entering flight per throttle round).
+  entering flight per throttle round) plus the ``traffic_msgs`` /
+  ``traffic_max_incast`` tracks (per-round message count and incast
+  fan-in depth, static accounting from obs/traffic.py — args key
+  ``value``, since they count messages, not bytes).
 
 Multi-run legibility: the process names carry the backend(s) and the
 ``process_labels`` metadata lists every run (``m<id> <method name>
@@ -61,7 +64,8 @@ def to_chrome_trace(events: list[dict]) -> dict:
         _meta(HOST_PID, 0, "process_name", "host (measured)"),
         _meta(HOST_PID, 1, "thread_name", "host timeline"),
         _meta(RANKS_PID, 0, "process_name", ranks_name),
-        _meta(RANKS_PID, 0, "thread_name", "bytes_in_flight"),
+        _meta(RANKS_PID, 0, "thread_name",
+              "counters (bytes_in_flight, traffic_*)"),
     ]
     if run_labels:
         for pid in (HOST_PID, RANKS_PID):
@@ -121,10 +125,13 @@ def to_chrome_trace(events: list[dict]) -> dict:
                          "phase_source": e["src"],
                          "method": run.get("name")}})
         elif ev == "counter":
+            # bytes_in_flight samples bytes; the traffic_* tracks
+            # (msgs, incast depth) are counts, not bytes
+            key = "bytes" if e["name"] == "bytes_in_flight" else "value"
             slices.append({
                 "ph": "C", "pid": RANKS_PID, "tid": 0,
                 "name": e["name"], "ts": e["ts"],
-                "args": {"bytes": e["value"]}})
+                "args": {key: e["value"]}})
         # "run"/"timer"/"meta" events carry no timeline geometry
 
     if hbm_seen:

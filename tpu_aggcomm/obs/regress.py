@@ -48,9 +48,10 @@ import os
 import re
 
 __all__ = ["validate_bench", "validate_multichip", "validate_tune",
-           "load_history", "check_regression", "parsed_schema_version",
-           "DEFAULT_TOLERANCE", "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE",
-           "TUNE_SCHEMAS"]
+           "validate_traffic", "load_history", "check_regression",
+           "parsed_schema_version", "DEFAULT_TOLERANCE",
+           "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE", "TUNE_SCHEMAS",
+           "TRAFFIC_SCHEMAS"]
 
 #: Relative slowdown vs the best prior same-platform round that counts as
 #: a regression. Differenced-chain numbers jitter a few percent
@@ -291,6 +292,104 @@ def validate_tune(obj, where: str = "TUNE") -> list[str]:
                               f"with race.winner {race['winner']!r}")
     if "synthetic" in obj and not isinstance(obj["synthetic"], bool):
         errors.append(f"{where}: 'synthetic' must be a bool")
+    return errors
+
+
+#: Accepted TRAFFIC artifact schema tags (obs/traffic.py audits, the
+#: ``cli inspect traffic --json`` output) — versioned like TUNE_SCHEMAS.
+TRAFFIC_SCHEMAS = ("traffic-v1",)
+
+_TRAFFIC_VERDICTS = ("CONFORMS", "REFUTED", "EXEMPT")
+
+
+def validate_traffic(obj, where: str = "TRAFFIC") -> list[str]:
+    """Schema errors (empty list = valid) for one ``TRAFFIC_*.json``
+    static-audit artifact (obs/traffic.py, written by ``cli inspect
+    traffic --json``). The verdict must be internally consistent: a
+    REFUTED audit must name at least one offender, a CONFORMS audit's
+    peak must actually respect its bound — a committed artifact whose
+    verdict its own numbers contradict must fail here."""
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    schema = obj.get("schema")
+    if schema not in TRAFFIC_SCHEMAS:
+        errors.append(f"{where}: unknown schema tag {schema!r} "
+                      f"(expected one of {list(TRAFFIC_SCHEMAS)})")
+        return errors
+    cfg = obj.get("config")
+    if not isinstance(cfg, dict):
+        errors.append(f"{where}: missing/invalid 'config' object")
+    else:
+        for k in ("method", "nprocs", "cb_nodes", "data_size",
+                  "comm_size", "proc_node", "agg_type"):
+            _require(cfg, k, int, errors, f"{where}.config")
+        _require(cfg, "name", str, errors, f"{where}.config")
+        _require(cfg, "direction", str, errors, f"{where}.config")
+    rounds = obj.get("rounds")
+    if not isinstance(rounds, list):
+        errors.append(f"{where}: 'rounds' must be a list")
+        rounds = []
+    for i, r in enumerate(rounds):
+        w = f"{where}.rounds[{i}]"
+        if not isinstance(r, dict):
+            errors.append(f"{w}: must be an object")
+            continue
+        for k in ("round", "msgs", "bytes", "signals", "copies",
+                  "max_incast", "incast_rank"):
+            _require(r, k, int, errors, w)
+        if "edges" in r and (not isinstance(r["edges"], list) or not all(
+                isinstance(e, list) and len(e) == 3
+                and all(isinstance(x, int) for x in e)
+                for e in r["edges"])):
+            errors.append(f"{w}: 'edges' must be a list of "
+                          f"[src, dst, bytes] int triples")
+    if not isinstance(obj.get("edges_omitted"), bool):
+        errors.append(f"{where}: 'edges_omitted' must be a bool")
+    tot = obj.get("totals")
+    if not isinstance(tot, dict):
+        errors.append(f"{where}: missing/invalid 'totals' object")
+    else:
+        for k in ("msgs", "bytes", "signals", "copies"):
+            _require(tot, k, int, errors, f"{where}.totals")
+    br = obj.get("barrier_rounds")
+    if not isinstance(br, dict) or not all(
+            isinstance(v, int) for v in br.values()):
+        errors.append(f"{where}: 'barrier_rounds' must be an object of "
+                      f"round -> barrier count")
+    conf = obj.get("conformance")
+    if not isinstance(conf, dict):
+        errors.append(f"{where}: missing/invalid 'conformance' object")
+        return errors
+    w = f"{where}.conformance"
+    verdict = conf.get("verdict")
+    if verdict not in _TRAFFIC_VERDICTS:
+        errors.append(f"{w}: verdict must be one of "
+                      f"{list(_TRAFFIC_VERDICTS)}, got {verdict!r}")
+    _require(conf, "bound", int, errors, w, nullable=True)
+    _require(conf, "bound_formula", str, errors, w)
+    _require(conf, "peak", int, errors, w, nullable=True)
+    offenders = conf.get("offenders")
+    if not isinstance(offenders, list):
+        errors.append(f"{w}: 'offenders' must be a list")
+        offenders = []
+    for i, o in enumerate(offenders):
+        if not isinstance(o, dict) or not all(
+                isinstance(o.get(k), int)
+                for k in ("rank", "round", "count")):
+            errors.append(f"{w}.offenders[{i}]: must be an object with "
+                          f"int rank/round/count")
+    # verdict consistency — the artifact must not contradict itself
+    bound, peak = conf.get("bound"), conf.get("peak")
+    if verdict == "REFUTED" and not offenders:
+        errors.append(f"{w}: REFUTED verdict with no offenders")
+    if verdict == "CONFORMS" and isinstance(bound, int) \
+            and isinstance(peak, int) and peak > bound:
+        errors.append(f"{w}: CONFORMS verdict but peak {peak} exceeds "
+                      f"bound {bound}")
+    if verdict == "EXEMPT" and (bound is not None or offenders):
+        errors.append(f"{w}: EXEMPT verdict must carry a null bound "
+                      f"and no offenders")
     return errors
 
 

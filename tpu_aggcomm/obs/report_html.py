@@ -26,6 +26,10 @@ Four panels:
 - **straggler heatmap** — the (rank x round) mean-seconds grid per run,
   colored relative to the run's own hottest cell, so the straggler is
   visible at a glance.
+- **traffic audit** — per traced run, the static throttle-conformance
+  verdict (peak in-flight vs the -c bound, obs/traffic.py, recompiled
+  jax-free from the run's recorded config) and, at n <= 64, the
+  aggregate src→dst byte heatmap.
 
 Empty inputs degrade to an honest "no data" panel, never a broken page.
 """
@@ -98,6 +102,49 @@ def _round_label(rnd) -> str:
     return str(rnd)
 
 
+def _run_traffic(run: dict) -> dict | None:
+    """Static traffic audit of one traced run, recompiled jax-free from
+    the run's recorded config (obs/traffic.py — core.methods imports
+    only numpy). Returns the conformance row plus, at n <= 64, the
+    aggregate src→dst byte matrix for the heatmap. Runs recorded before
+    the config fields existed, or too large to audit in a report, get a
+    note instead of a crash."""
+    if run.get("cb_nodes") is None:
+        return {"verdict": None, "note":
+                "trace predates the traffic config fields (re-record)"}
+    try:
+        from tpu_aggcomm.core.methods import compile_method
+        from tpu_aggcomm.core.pattern import AggregatorPattern
+        from tpu_aggcomm.obs.traffic import audit_schedule
+
+        n = int(run["nprocs"])
+        p = AggregatorPattern(
+            nprocs=n, cb_nodes=run["cb_nodes"],
+            data_size=run["data_size"], placement=run.get("agg_type", 1),
+            proc_node=run.get("proc_node", 1),
+            comm_size=run["comm_size"])
+        sched = compile_method(run["method"], p)
+        if getattr(sched, "collective", False) and n > 256:
+            return {"verdict": "EXEMPT", "note":
+                    f"dense collective at n={n}: matrix omitted"}
+        audit = audit_schedule(sched)
+    except Exception as e:  # an unauditable run must not sink the page
+        return {"verdict": None, "note": f"not auditable: {e}"}
+    conf = audit["conformance"]
+    out = {"verdict": conf["verdict"], "peak": conf["peak"],
+           "bound": conf["bound"], "bound_formula": conf["bound_formula"],
+           "totals": audit["totals"], "note": None}
+    if n <= 64 and not audit["edges_omitted"]:
+        grid = [[0] * n for _ in range(n)]
+        for r in audit["rounds"]:
+            for s, d, b in r.get("edges", []):
+                grid[s][d] += b
+        out["matrix"] = grid
+    elif conf["verdict"] != "EXEMPT":
+        out["note"] = f"matrix omitted (n={n} > 64)"
+    return out
+
+
 def _trace_runs(paths: list[str]) -> list[dict]:
     """Per-run analytics bundles for the skew table and heatmap, JSON-
     ready (round keys stringified; grids as row-major lists)."""
@@ -135,7 +182,8 @@ def _trace_runs(paths: list[str]) -> list[dict]:
                              if cp and cp["dominant"] else None),
                 "heat": {"ranks": ranks,
                          "rounds": [_round_label(r) for r in rounds],
-                         "cells": cells}})
+                         "cells": cells},
+                "traffic": _run_traffic(run)})
     return out
 
 
@@ -228,6 +276,8 @@ time; lower is better everywhere (seconds per rep).</p>
 <div id="skew"></div>
 <h2>Straggler heatmaps (rank &times; round, mean seconds)</h2>
 <div id="heat"></div>
+<h2>Traffic audit (static conformance + src &rarr; dst bytes)</h2>
+<div id="traffic"></div>
 <script id="data" type="application/json">{payload}</script>
 <script>
 "use strict";
@@ -554,6 +604,82 @@ function fmtS(v) {{
   }});
   if (!any) host.appendChild(el("p", {{class: "note"}},
       "no per-cell slices in the traces passed (or none passed)"));
+}})();
+
+(function trafficPane() {{
+  var host = document.getElementById("traffic");
+  var runs = (DATA.runs || []).filter(function (r) {{
+    return r.traffic; }});
+  if (!runs.length) {{
+    host.appendChild(el("p", {{class: "note"}},
+        "no trace runs to audit (pass trace paths to populate)"));
+    return;
+  }}
+  var tbl = el("table");
+  var hr = el("tr");
+  ["trace", "m", "name", "verdict", "peak", "bound", "msgs", "bytes",
+   "signals"].forEach(function (h, i) {{
+    hr.appendChild(el("th", i < 4 ? {{class: "l"}} : {{}}, h)); }});
+  tbl.appendChild(hr);
+  runs.forEach(function (r) {{
+    var t = r.traffic;
+    var tr = el("tr");
+    tr.appendChild(el("td", {{class: "l"}}, r.file + " #" + r.run));
+    tr.appendChild(el("td", {{class: "l"}}, String(r.method)));
+    tr.appendChild(el("td", {{class: "l"}}, r.name));
+    var vd = el("td", {{class: "l"}}, t.verdict || (t.note || "-"));
+    if (t.verdict === "REFUTED") vd.className = "l err";
+    tr.appendChild(vd);
+    tr.appendChild(el("td", {{}},
+        t.peak === null || t.peak === undefined ? "-" : String(t.peak)));
+    tr.appendChild(el("td", {{}},
+        t.bound === null || t.bound === undefined ? "-" :
+        t.bound + " (" + t.bound_formula + ")"));
+    ["msgs", "bytes", "signals"].forEach(function (k) {{
+      tr.appendChild(el("td", {{}},
+          t.totals ? String(t.totals[k]) : "-")); }});
+    tbl.appendChild(tr);
+  }});
+  host.appendChild(tbl);
+  runs.forEach(function (r) {{
+    var t = r.traffic;
+    if (!t.matrix) {{
+      if (t.note) host.appendChild(el("p", {{class: "note"}},
+          r.file + " #" + r.run + ": " + t.note));
+      return;
+    }}
+    host.appendChild(el("p", {{}}, r.file + " #" + r.run +
+        " — src \\u2192 dst bytes, all rounds"));
+    var mx = 0;
+    t.matrix.forEach(function (row) {{
+      row.forEach(function (v) {{ if (v) mx = Math.max(mx, v); }});
+    }});
+    var mt = el("table", {{class: "heat"}});
+    var mh = el("tr");
+    mh.appendChild(el("th", {{class: "l"}}, "src\\\\dst"));
+    t.matrix.forEach(function (_row, d) {{
+      mh.appendChild(el("th", {{}}, String(d))); }});
+    mt.appendChild(mh);
+    t.matrix.forEach(function (row, s) {{
+      var mr = el("tr");
+      mr.appendChild(el("th", {{class: "l"}}, String(s)));
+      row.forEach(function (v) {{
+        var td = el("td");
+        if (!v) {{
+          td.style.background = "#f5f5f5";
+        }} else {{
+          var tt = mx > 0 ? v / mx : 0;
+          td.style.background =
+            "rgba(21, 101, 192," + (0.15 + 0.85 * tt).toFixed(3) + ")";
+          if (tt > 0.55) td.style.color = "#fff";
+          td.title = v + " B";
+        }}
+        mr.appendChild(td);
+      }});
+      mt.appendChild(mr);
+    }});
+    host.appendChild(mt);
+  }});
 }})();
 </script></body></html>
 """

@@ -200,13 +200,16 @@ class TraceRecorder:
         else:
             combine = "sum"
         round_bytes = _round_bytes(schedule)
+        round_traffic = _round_traffic(schedule)
         self._events.append({
             "ev": "run", "id": run_id, "method": method, "name": name,
             "iter": iter_, "ntimes": ntimes, "nprocs": p.nprocs,
             "data_size": p.data_size, "comm_size": p.comm_size,
+            "cb_nodes": p.cb_nodes, "proc_node": p.proc_node,
+            "agg_type": int(p.placement),
             "backend": requested, "executed": executed,
             "phase_source": phase_source, "combine": combine,
-            "round_bytes": round_bytes})
+            "round_bytes": round_bytes, "round_traffic": round_traffic})
 
         if calls:
             for rep in range(ntimes):
@@ -214,7 +217,7 @@ class TraceRecorder:
                 if combine == "mixed" and rep >= len(calls):
                     break
                 self._emit_rep(run_id, rep, call, phase_source, p.nprocs,
-                               round_bytes)
+                               round_bytes, round_traffic)
         else:
             self._emit_timer_reps(run_id, ntimes, timers, rep_timers,
                                   phase_source, p.nprocs)
@@ -229,7 +232,7 @@ class TraceRecorder:
         return run_id
 
     def _emit_rep(self, run_id: int, rep: int, call: dict, src: str,
-                  nprocs: int, round_bytes) -> None:
+                  nprocs: int, round_bytes, round_traffic=None) -> None:
         """One rep's slices from one attribution call's cells.
 
         Geometry: every rank shares the rep envelope (on a fused program
@@ -263,6 +266,14 @@ class TraceRecorder:
                     "ev": "counter", "run": run_id, "rep": rep,
                     "name": "bytes_in_flight", "ts": cursor,
                     "value": round_bytes.get(str(rnd), 0)})
+            if round_traffic is not None:
+                rt = round_traffic.get(str(rnd), {})
+                for cname, ckey in (("traffic_msgs", "msgs"),
+                                    ("traffic_max_incast", "max_incast")):
+                    self._events.append({
+                        "ev": "counter", "run": run_id, "rep": rep,
+                        "name": cname, "ts": cursor,
+                        "value": rt.get(ckey, 0)})
             cursor += max(by_round[rnd].values()) * 1e6
 
         rep_total = call["total"]
@@ -288,6 +299,11 @@ class TraceRecorder:
                 "ev": "counter", "run": run_id, "rep": rep,
                 "name": "bytes_in_flight", "ts": rep_start + rep_dur,
                 "value": 0})
+        if rounds and round_traffic is not None:
+            for cname in ("traffic_msgs", "traffic_max_incast"):
+                self._events.append({
+                    "ev": "counter", "run": run_id, "rep": rep,
+                    "name": cname, "ts": rep_start + rep_dur, "value": 0})
         self._cursor_us = rep_start + rep_dur
 
     def _emit_timer_reps(self, run_id: int, ntimes: int, timers,
@@ -380,6 +396,24 @@ def _round_bytes(schedule) -> dict | None:
         rnd = str(int(e[4]))
         out[rnd] = out.get(rnd, 0) + ds
     return out
+
+
+def _round_traffic(schedule) -> dict | None:
+    """Per-round msgs/bytes/max-incast summary for the ``traffic_*``
+    counter tracks (obs.traffic.round_traffic, static accounting from
+    the op programs — never from measured callbacks). None when the
+    schedule has no edge list to count (dense collectives — their
+    matrix is O(n^2) dense and belongs in `inspect traffic`, not in
+    every traced run — and the TAM relay), mirroring _round_bytes."""
+    if getattr(schedule, "assignment", None) is not None:
+        return None
+    if getattr(schedule, "collective", False):
+        return None
+    try:
+        from tpu_aggcomm.obs.traffic import round_traffic
+        return round_traffic(schedule)
+    except Exception:
+        return None
 
 
 # ---------------------------------------------------------------------------
