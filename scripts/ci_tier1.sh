@@ -36,6 +36,16 @@ python bench.py --check-regression || post_rc=1
 # whole benchmark studies, and this catches it with no backend at all
 python -m tpu_aggcomm.cli inspect traffic -m 0 -n 32 -a 8 -c 4 \
   > /dev/null || post_rc=1
+# fault-repair conformance gate (faults/repair.py + obs/traffic.py,
+# jax-free): dead-link/dead-aggregator repaired schedules must still
+# respect the documented -c bound — a detour that over-posts would
+# invalidate the throttle semantics exactly when the benchmark claims
+# to have survived the fault. Small grid: the round-structured methods
+# under a combined dead-link + dead-aggregator scenario.
+for m in 1 2 3; do
+  python -m tpu_aggcomm.cli inspect traffic -m "$m" -n 32 -a 8 -c 4 \
+    --fault "deadlink:17>2,deadagg:a3" > /dev/null || post_rc=1
+done
 # tuned-schedule cache replay (tune/race.py, jax-free): every committed
 # TUNE_*.json must re-derive its recorded elimination order and winner
 # byte-for-byte from its own samples — an artifact that cannot reproduce

@@ -107,9 +107,24 @@ def _schedule_edges(schedule: Schedule) -> np.ndarray:
     dict walk is O(E) Python either way, but the per-edge joins here are
     numpy). Collective schedules (m=5/8) synthesize the full pattern as a
     single round: the Alltoallw's whole exchange is one program step, as
-    in the reference (mpi_test.c:627-645)."""
+    in the reference (mpi_test.c:627-645).
+
+    Fault handling: dead-link-repaired schedules carry relay staging rows
+    and chan != 0 detour edges the compacted block layout cannot
+    represent — clean refusal (the detour route runs on local/jax_sim;
+    dead-AGGREGATOR repair regenerates a healthy program and runs here
+    fine). UNREPAIRED dead links are realized by dropping the dead
+    chan-0 edges from the block tables (faults/inject.dead_edge_mask
+    semantics) — the run then fails --verify, which is the injection
+    working."""
     p = schedule.pattern
     n = p.nprocs
+    if getattr(schedule, "n_staging", 0):
+        raise ValueError(
+            f"m={schedule.method_id} ({schedule.name}) is a dead-link-"
+            f"repaired schedule (fault={schedule.fault!r}): jax_shard's "
+            f"block lowering cannot represent relay staging rows; run the "
+            f"detour route on --backend local or jax_sim")
     if schedule.collective:
         agg_index = np.asarray(p.agg_index)
         if p.direction is Direction.ALL_TO_MANY:
@@ -123,8 +138,9 @@ def _schedule_edges(schedule: Schedule) -> np.ndarray:
             sslots = dsts
             dslots = agg_index[srcs]
         rounds = np.zeros(len(srcs), dtype=np.int64)
-        return np.stack([srcs, dsts, sslots, dslots, rounds],
-                        axis=1).astype(np.int64)
+        return _drop_dead_edges(
+            np.stack([srcs, dsts, sslots, dslots, rounds],
+                     axis=1).astype(np.int64), schedule)
 
     edges = schedule.data_edges()
     if len(edges) == 0:
@@ -141,7 +157,22 @@ def _schedule_edges(schedule: Schedule) -> np.ndarray:
     pos = np.searchsorted(keys, ekeys)
     out = edges.copy()
     out[:, 3] = vals[pos]
-    return out
+    return _drop_dead_edges(out, schedule)
+
+
+def _drop_dead_edges(edges: np.ndarray, schedule: Schedule) -> np.ndarray:
+    """UNREPAIRED fault realization: drop the chan-0 edges named dead (all
+    edges here are chan-0 — staged schedules were refused above)."""
+    fault = getattr(schedule, "fault", None)
+    if not fault or len(edges) == 0:
+        return edges
+    from tpu_aggcomm.faults.spec import parse_fault
+    dead = set(parse_fault(fault).deadlinks)
+    if not dead:
+        return edges
+    keep = np.array([(int(s), int(d)) not in dead
+                     for s, d in edges[:, :2]], dtype=bool)
+    return edges[keep]
 
 
 def recv_layout(counts: np.ndarray, ndev: int, bsz: int):
@@ -389,6 +420,36 @@ class JaxShardBackend:
         (counts, recv_base, F, send_base, Fs, tabs,
          barrier_rounds) = self._layout_and_tabs(schedule, ndev, bsz)
         round_ids = [r for (r, *_rest) in tabs]
+        # slow-rank fault injection: per-DEVICE delay-loop iterations
+        # (ranks sharing a device serialize on its core, so the device's
+        # busy work is the sum over its slow ranks), appended after the
+        # rounds INSIDE the rep — the chained differenced measurement
+        # serializes it, and round semantics are untouched
+        slow_dev = None
+        if getattr(schedule, "fault", None):
+            from tpu_aggcomm.faults.inject import slow_iter_table
+            from tpu_aggcomm.faults.spec import parse_fault
+            tbl = slow_iter_table(parse_fault(schedule.fault), n,
+                                  max(len(tabs), 1))
+            per_dev = tbl.reshape(ndev, bsz).sum(axis=1).astype(np.int32)
+            if per_dev.any():
+                slow_dev = jnp.asarray(per_dev)
+
+        def add_slow(flat_send, recv):
+            """Data-dependent busy loop XLA cannot fold away, closed by a
+            provably-zero (statically opaque) delta into a live cell —
+            bytes unchanged, the loop survives DCE (jax_sim precedent)."""
+            if slow_dev is None:
+                return recv
+            it = slow_dev[lax.axis_index(AXIS)]
+            row = flat_send[0].astype(jnp.uint32)
+
+            def body(i, a):
+                return a + jnp.sum((row + i.astype(jnp.uint32)) % 251)
+
+            acc = lax.fori_loop(0, it, body, jnp.uint32(0))
+            delta = ((acc & 1) * ((acc + 1) & 1)).astype(jdt)
+            return recv.at[0, 0].add(delta)
         # Many-round schedules compile O(rounds) unrolled; barrier-free
         # ones (the flagship sweep's m=1/m=2) scan instead: tables padded
         # to the max block width, rounds sequenced by the scan carry (the
@@ -432,7 +493,7 @@ class JaxShardBackend:
                 # constant initial carry must be cast to match
                 recv0 = _compat_pcast(recv0, (AXIS,), to="varying")
                 recv, _ = lax.scan(body, recv0, (pks, scs), unroll=1)
-                return recv
+                return add_slow(flat_send, recv)
         else:
             pack_dev = [jax.device_put(pk, sharding)
                         for (_r, pk, _sc, _m) in tabs]
@@ -452,7 +513,7 @@ class JaxShardBackend:
                     if k + 1 < kk:
                         flat_send, recv = lax.optimization_barrier(
                             (flat_send, recv))
-                return recv
+                return add_slow(flat_send, recv)
 
         def local_fn(send, packs, scats):
             return rep_body(send[0], packs, scats)[None]
@@ -547,6 +608,12 @@ class JaxShardBackend:
         whole-rep program is built from."""
         from tpu_aggcomm.tam.engine import TamMethod
         if isinstance(schedule, TamMethod) or schedule.collective:
+            return None
+        if getattr(schedule, "fault", None) or getattr(schedule,
+                                                       "n_staging", 0):
+            # per-round segments would omit the injected slow work (it
+            # lives outside the round structure) — the profiled
+            # decomposition would drift from the program it decomposes
             return None
         key = (self._key(schedule), "segments")
         if key in self._cache:
@@ -714,6 +781,12 @@ class JaxShardBackend:
                 "measured round times need a round-structured schedule "
                 "(TAM and the dense collectives have no gather/deliver "
                 "round decomposition to truncate)")
+        if getattr(schedule, "fault", None) or getattr(schedule,
+                                                       "n_staging", 0):
+            raise ValueError(
+                "measured round times are not supported on fault-injected "
+                "schedules (round-prefix truncation would replay the "
+                "injected delay once per prefix); use --chained timing")
         p = schedule.pattern
         fn, mesh, ndev, bsz, extra = self._compiled(schedule)
         (Fs, send_base, _recv_base, _counts, make_chain, round_ids) = extra

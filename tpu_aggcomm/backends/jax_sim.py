@@ -82,6 +82,11 @@ def _round_tables(schedule: Schedule):
     """
     from tpu_aggcomm.core.schedule import OpKind
 
+    if getattr(schedule, "n_staging", 0):
+        raise ValueError(
+            f"schedule {schedule.name!r} carries dead-link relay staging; "
+            f"the healthy round tables cannot represent it (the faulted "
+            f"lowering builds its own from data_edges_ext)")
     edges = schedule.data_edges()
     rtable = schedule.recv_slot_table()
     rounds = []
@@ -250,6 +255,18 @@ class JaxSimBackend:
                 "schedule (TAM prefixes are _tam_rep(upto_hop=...); the "
                 "dense collectives have no throttle rounds to truncate)")
 
+        if (getattr(schedule, "fault", None)
+                or getattr(schedule, "n_staging", 0)) \
+                and not (isinstance(schedule, TamMethod)
+                         or schedule.collective):
+            if upto is not None:
+                raise ValueError(
+                    "round-prefix truncation is not supported on "
+                    "fault-injected schedules (the injected delay work "
+                    "and relay rounds are not part of the healthy "
+                    "prefix family)")
+            return self._one_rep_faulted(schedule)
+
         if isinstance(schedule, TamMethod):
             return self._tam_rep(schedule)
 
@@ -347,6 +364,152 @@ class JaxSimBackend:
 
         return rep
 
+    def _one_rep_faulted(self, schedule):
+        """The faulted-schedule lowering (faults/): same round structure,
+        three additions over the healthy ``_one_rep``:
+
+        - **staging rows**: the recv arena grows to ``n_recv_slots + S + 1``
+          rows per rank (S relay staging rows from dead-link repair, then
+          the trash row); relay hops address them via the
+          ``data_edges_ext`` flags — a ``from_stage`` gather reads the
+          source rank's staging row of ``recv`` instead of ``send``
+          (the relay's forward hop, strictly a later round than the hop
+          that filled it, so the sequential-round lowering delivers it
+          correctly);
+        - **dead-edge masking**: chan-0 edges named dead by an UNREPAIRED
+          fault drop out of the tables — the payload is lost and
+          ``--verify`` fails, which is the injection demonstrating the
+          fault is real (a repaired schedule has no such edge left);
+        - **slow-rank work**: after the rounds, each slow rank r runs a
+          delay loop of ``faults/inject.delay_iters`` iterations whose
+          body reduces r's live send row (data-dependent: XLA cannot
+          hoist or fold it) and whose provably-zero parity product lands
+          in r's recv state — so chained measurement serializes the
+          delay into every rep while the received bytes stay exact.
+
+        Round semantics are untouched: rounds remain fenced sequential
+        steps, and ``run()``'s ``[:, :n_recv_slots, :]`` slice drops the
+        staging rows before verification."""
+        from tpu_aggcomm.core.schedule import barrier_rounds_of
+        from tpu_aggcomm.faults.inject import (dead_edge_mask,
+                                               slow_iter_table)
+        from tpu_aggcomm.faults.spec import parse_fault
+
+        p = schedule.pattern
+        n = p.nprocs
+        _, n_recv_slots = self._slots(p)
+        _, jdt, w = self._words(p)
+        S = int(getattr(schedule, "n_staging", 0))
+        F = n_recv_slots + S          # trash row; staging rows before it
+        spec = parse_fault(getattr(schedule, "fault", None))
+        ext = schedule.data_edges_ext()
+        ext = ext[dead_edge_mask(ext, spec)]
+        barrier_rounds = barrier_rounds_of(schedule)
+        rounds = []
+        n_rounds = int(ext[:, 4].max()) + 1 if len(ext) else 0
+        for r in range(n_rounds):
+            sel = ext[ext[:, 4] == r]
+            if len(sel) == 0:
+                continue
+            from_stage = (sel[:, 6] & 1) != 0
+            to_stage = (sel[:, 6] & 2) != 0
+            rounds.append((
+                r,
+                sel[:, 0].astype(np.int32),
+                np.where(from_stage, n_recv_slots + sel[:, 2],
+                         sel[:, 2]).astype(np.int32),
+                sel[:, 1].astype(np.int32),
+                np.where(to_stage, n_recv_slots + sel[:, 3],
+                         sel[:, 3]).astype(np.int32),
+                from_stage))
+        orphans = set(barrier_rounds) - {r for r, *_ in rounds}
+        if orphans:
+            raise ValueError(
+                f"schedule {schedule.name!r} has barrier-only rounds "
+                f"{sorted(orphans)} with no data edges; the jax_sim round "
+                f"lowering cannot represent a standalone fence")
+        slow = slow_iter_table(spec, n, max(n_rounds, 1))
+        slow_ranks = [(r, int(it)) for r, it in enumerate(slow) if it > 0]
+        round_ids = [r for (r, *_rest) in rounds]
+
+        def add_slow(send, recv):
+            for r, iters in slow_ranks:
+                row = send[r, 0].astype(jnp.int32)
+
+                def body(i, acc):
+                    return acc + jnp.sum((row + i) % 251)
+
+                tok = lax.fori_loop(0, iters, body, jnp.int32(0))
+                # parity(tok) * parity(tok+1) == 0 always, but XLA cannot
+                # prove it: the loop survives, the bytes do not change
+                delta = ((tok & 1) * ((tok + 1) & 1)).astype(jdt)
+                recv = recv.at[r, 0, 0].add(delta)
+            return recv
+
+        tabs = [(srcs, ss, dsts, ds_)
+                for (_r, srcs, ss, dsts, ds_, _fm) in rounds]
+        if _scan_lowered(tabs, barrier_rounds):
+            R = len(rounds)
+            E = max(len(srcs) for (srcs, _ss, _ds, _dl) in tabs)
+            srcs_t = np.zeros((R, E), dtype=np.int32)
+            ss_t = np.zeros((R, E), dtype=np.int32)
+            dsts_t = np.zeros((R, E), dtype=np.int32)
+            dslt_t = np.full((R, E), F, dtype=np.int32)  # pad -> trash
+            fm_t = np.zeros((R, E), dtype=bool)
+            nbar_t = np.zeros((R,), dtype=np.int32)
+            for k, (_r, srcs, ss, dsts, ds_, fm) in enumerate(rounds):
+                e = len(srcs)
+                srcs_t[k, :e] = srcs
+                ss_t[k, :e] = ss
+                dsts_t[k, :e] = dsts
+                dslt_t[k, :e] = ds_
+                fm_t[k, :e] = fm
+                nbar_t[k] = barrier_rounds.get(round_ids[k], 0)
+            xs = tuple(jnp.asarray(t) for t in
+                       (srcs_t, ss_t, dsts_t, dslt_t, fm_t, nbar_t))
+
+            def rep(send):
+                recv0 = jnp.zeros((n, F + 1, w), dtype=jdt)
+
+                def body(recv, x):
+                    srcs, ss, dsts, ds_, fm, nbar = x
+                    vals = jnp.where(fm[:, None], recv[srcs, ss],
+                                     send[srcs, ss])
+                    recv = recv.at[dsts, ds_].set(vals)
+                    tok = jnp.sum(recv[:, :n_recv_slots, 0]
+                                  .astype(jnp.int32)).astype(jdt)
+                    cur = recv[:, F, 0]
+                    recv = recv.at[:, F, 0].set(
+                        jnp.where(nbar > 0, tok, cur))
+                    return recv, ()
+
+                recv, _ = lax.scan(body, recv0, xs, unroll=1)
+                return add_slow(send, recv)
+
+            return rep
+
+        def rep(send):
+            recv = jnp.zeros((n, F + 1, w), dtype=jdt)
+            for k, (_r, srcs, ss, dsts, ds_, fm) in enumerate(rounds):
+                if fm.any():
+                    vals = jnp.where(jnp.asarray(fm)[:, None],
+                                     recv[jnp.asarray(srcs),
+                                          jnp.asarray(ss)],
+                                     send[jnp.asarray(srcs),
+                                          jnp.asarray(ss)])
+                else:
+                    vals = send[jnp.asarray(srcs), jnp.asarray(ss)]
+                recv = recv.at[jnp.asarray(dsts), jnp.asarray(ds_)].set(vals)
+                for _ in range(barrier_rounds.get(round_ids[k], 0)):
+                    tok = jnp.sum(recv[:, :n_recv_slots, 0]
+                                  .astype(jnp.int32))
+                    recv = recv.at[:, F, 0].set(tok.astype(jdt))
+                if k + 1 < len(rounds):
+                    send, recv = lax.optimization_barrier((send, recv))
+            return add_slow(send, recv)
+
+        return rep
+
     def _key(self, schedule):
         from tpu_aggcomm.core.schedule import schedule_shape_key
         return schedule_shape_key(schedule)
@@ -386,6 +549,13 @@ class JaxSimBackend:
             raise ValueError("measured_phases and profile_rounds are "
                              "exclusive (truncation-differenced split vs "
                              "per-round dispatch timing)")
+        if measured_phases and (getattr(schedule, "fault", None)
+                                or getattr(schedule, "n_staging", 0)):
+            raise ValueError(
+                "measured_phases is not supported on fault-injected "
+                "schedules (the prefix families decompose the healthy "
+                "program; injected delay loops and relay rounds are not "
+                "in it) — use --chained timing for faulted runs")
         p = schedule.pattern
         dev = self._dev()
         send_dev = jax.device_put(self._global_send(p, iter_), dev)
@@ -522,6 +692,9 @@ class JaxSimBackend:
         from tpu_aggcomm.tam.engine import TamMethod
         if isinstance(schedule, TamMethod) or schedule.collective:
             return None
+        if (getattr(schedule, "fault", None)
+                or getattr(schedule, "n_staging", 0)):
+            return None  # profile_rounds falls back to the monolithic rep
         key = (self._key(schedule), "segments")
         if key in self._cache:
             return self._cache[key]

@@ -67,7 +67,7 @@ class LocalBackend:
             run_rep = lambda bufs: tam_oracle(schedule, iter_)  # noqa: E731
             recv_bufs = None
         else:
-            recv_bufs = _alloc_recv(p)
+            recv_bufs = _alloc_recv(p, getattr(schedule, "n_staging", 0))
             send_slabs = make_send_slabs(p, iter_)  # same every rep
 
             def run_rep(bufs):
@@ -82,6 +82,12 @@ class LocalBackend:
                 dt = time.perf_counter() - t0
             self.last_rep_timers.append(
                 [Timer(total_time=dt) for _ in range(p.nprocs)])
+        if getattr(schedule, "n_staging", 0) and recv_bufs is not None:
+            # relay staging rows are repair plumbing, not pattern data —
+            # strip them so verify and callers see the healthy layout
+            from tpu_aggcomm.harness.verify import recv_slot_counts
+            recv_bufs = [b[:c] if c else None
+                         for b, c in zip(recv_bufs, recv_slot_counts(p))]
         if verify:
             from tpu_aggcomm.harness.verify import verify_recv
             verify_recv(p, recv_bufs, iter_)
@@ -92,9 +98,13 @@ class LocalBackend:
         return recv_bufs, timers
 
 
-def _alloc_recv(p: AggregatorPattern) -> list[np.ndarray | None]:
+def _alloc_recv(p: AggregatorPattern,
+                n_staging: int = 0) -> list[np.ndarray | None]:
     from tpu_aggcomm.harness.verify import recv_slot_counts
-    return [np.zeros((c, p.data_size), dtype=np.uint8) if c else None
+    # with staging (dead-link repair), EVERY rank gets the extra rows past
+    # its pattern slots — any live rank can be elected relay intermediate
+    return [np.zeros((c + n_staging, p.data_size), dtype=np.uint8)
+            if c + n_staging else None
             for c in recv_slot_counts(p)]
 
 
@@ -111,9 +121,27 @@ def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:
     # its throttle round — the oracle's real per-round boundary events
     # (the compiled backends reconstruct theirs from attribution instead)
     rec = trace.current()
-    # message plumbing, keyed by (src, dst):
-    #  sends_posted[(s,d)] = (slot, token|None, rendezvous)
-    #  recvs_posted[(s,d)] = (slot, token|None)
+    # fault plumbing (faults/): staging row base per rank for relay hops,
+    # and the dead chan-0 edges whose payload the link drops. A REPAIRED
+    # schedule has no chan-0 op left on a dead edge (the detour replaced
+    # it); an UNREPAIRED faulted schedule loses the message here — eager
+    # sends complete but bytes never land (verify fails), rendezvous
+    # sends never match (DeadlockError) — which is the injection working.
+    n_staging = getattr(schedule, "n_staging", 0)
+    stage_base = None
+    if n_staging:
+        from tpu_aggcomm.harness.verify import recv_slot_counts
+        stage_base = recv_slot_counts(p)
+    dead_edges: set = set()
+    fault = getattr(schedule, "fault", None)
+    if fault:
+        from tpu_aggcomm.faults.spec import parse_fault
+        dead_edges = set(parse_fault(fault).deadlinks)
+    # message plumbing, keyed by (src, dst, chan) — chan 0 is the pattern
+    # data channel; nonzero channels carry repair relay hops:
+    #  sends_posted[key] = (slot, token|None, rendezvous, nbytes, round,
+    #                       from_stage)
+    #  recvs_posted[key] = (row, token|None)  [row = resolved buffer row]
     sends_posted: dict = {}
     recvs_posted: dict = {}
     delivered: set = set()
@@ -129,11 +157,21 @@ def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:
         if key in delivered:
             return
         if key in sends_posted and key in recvs_posted:
-            src, dst = key
-            sslot, stok, rendezvous, nbytes, rnd = sends_posted[key]
+            src, dst, chan = key
+            if chan == 0 and (src, dst) in dead_edges:
+                return  # the link drops it: no delivery, no completion
+            sslot, stok, rendezvous, nbytes, rnd, from_stage = \
+                sends_posted[key]
             rslot, rtok = recvs_posted[key]
             if nbytes > 0:
-                recv_bufs[dst][rslot] = send_slabs[src][sslot]
+                if from_stage:
+                    # relay forward hop: source bytes come from the relay
+                    # rank's staging row (.copy(): both live in recv_bufs)
+                    src_bytes = recv_bufs[src][
+                        stage_base[src] + sslot].copy()
+                else:
+                    src_bytes = send_slabs[src][sslot]
+                recv_bufs[dst][rslot] = src_bytes
             delivered.add(key)
             if rec is not None:
                 rec.instant("local.deliver", src=src, dst=dst,
@@ -159,9 +197,9 @@ def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:
         op = st.prog[st.pc]
         k = op.kind
         if k is OpKind.ISSEND or k is OpKind.ISEND:
-            key = (rank, op.peer)
+            key = (rank, op.peer, op.chan)
             sends_posted[key] = (op.slot, op.token, k is OpKind.ISSEND,
-                                 op.nbytes, op.round)
+                                 op.nbytes, op.round, op.from_stage)
             if k is OpKind.ISEND:
                 # eager: complete at post time; delivery happens at match
                 states[rank].done.add(op.token)
@@ -169,8 +207,9 @@ def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:
             st.pc += 1
             return True
         if k is OpKind.IRECV:
-            key = (op.peer, rank)
-            recvs_posted[key] = (op.slot, op.token)
+            key = (op.peer, rank, op.chan)
+            row = (stage_base[rank] + op.slot if op.to_stage else op.slot)
+            recvs_posted[key] = (row, op.token)
             try_deliver(key)
             st.pc += 1
             return True
@@ -180,15 +219,15 @@ def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:
             # sync methods (m=6/7) NEED that: under strict rendezvous their
             # send→recv chains deadlock (verified by this oracle). Model SEND
             # as eager; only Issend keeps rendezvous semantics.
-            key = (rank, op.peer)
+            key = (rank, op.peer, op.chan)
             if key not in sends_posted:
                 sends_posted[key] = (op.slot, None, False, op.nbytes,
-                                     op.round)
+                                     op.round, op.from_stage)
                 try_deliver(key)
             st.pc += 1
             return True
         if k is OpKind.RECV:
-            key = (op.peer, rank)
+            key = (op.peer, rank, op.chan)
             if key not in recvs_posted:
                 recvs_posted[key] = (op.slot, None)
                 try_deliver(key)
@@ -199,11 +238,11 @@ def _run_one_rep(schedule: Schedule, recv_bufs, send_slabs) -> None:
         if k is OpKind.SENDRECV:
             # The send half is a standard-mode send (eager, like SEND above);
             # the call blocks only until the receive half completes.
-            skey = (rank, op.peer)
-            rkey = (op.peer2, rank)
+            skey = (rank, op.peer, 0)
+            rkey = (op.peer2, rank, 0)
             if skey not in sends_posted:
                 sends_posted[skey] = (op.slot, None, False, op.nbytes,
-                                      op.round)
+                                      op.round, False)
                 try_deliver(skey)
             if rkey not in recvs_posted:
                 recvs_posted[rkey] = (op.slot2, None)

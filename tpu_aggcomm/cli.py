@@ -102,6 +102,17 @@ def build_parser() -> argparse.ArgumentParser:
                             "attribution rep — a cross-check only; the "
                             "timed path and the reconstructed cells are "
                             "untouched")
+    bench.add_argument("--fault", metavar="SPEC", default=None,
+                       help="fault-injection scenario "
+                            "'slow:rR*F,deadlink:S>D,deadagg:aI' "
+                            "(comma-separated clauses, any mix): schedules "
+                            "are repaired around dead links/aggregators "
+                            "(relay detour / fallback election, "
+                            "faults/repair.py) before dispatch, and slow "
+                            "ranks get injected busy work; --verify still "
+                            "checks byte-exact delivery and 'inspect "
+                            "traffic --fault' re-proves the -c bound "
+                            "statically")
 
     pt = sub.add_parser("pt2pt", help="2-rank latency microbenchmark "
                                       "(mpi_sendrecv_test.c)")
@@ -204,6 +215,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "warning + fallback to -m on a miss or drift")
     sw.add_argument("--tune-root", default=".",
                     help="directory holding TUNE_*.json (default: .)")
+    sw.add_argument("--fault", action="append", default=None,
+                    metavar="SPEC",
+                    help="fault scenario as an extra sweep axis "
+                         "(repeatable): each occurrence reruns the whole "
+                         "throttle grid under that scenario; the literal "
+                         "'none' is the healthy baseline cell; recorded "
+                         "in the resume sidecar")
 
     # tune — statistical racing search + persistent tuned-schedule cache
     tn = sub.add_parser(
@@ -304,6 +322,16 @@ def build_parser() -> argparse.ArgumentParser:
     ins.add_argument("--by", choices=["rank", "round", "phase"],
                      default="rank",
                      help="compare grouping key (default: rank)")
+    ins.add_argument("--across-faults", action="store_true",
+                     help="'compare' only: allow diffing traces whose "
+                          "fault specs differ (healthy vs "
+                          "faulted+repaired); the delta is reported as a "
+                          "RECOVERY delta naming both specs")
+    ins.add_argument("--fault", metavar="SPEC", default=None,
+                     help="'traffic' only: audit the FAULT-REPAIRED "
+                          "schedule (faults/repair.py) instead of the "
+                          "healthy one — the static re-proof that the "
+                          "relay detour still honors the -c bound")
     ins.add_argument("--out", default="report.html",
                      help="output path for 'inspect report' "
                           "(default: report.html)")
@@ -494,7 +522,8 @@ def _sweep_sidecar(csv_path: str) -> str:
 
 
 def _sweep_key(nprocs, cb_nodes, data_size, method, iters, ntimes, agg_type,
-               proc_node, backend, chained, measured_phases=False) -> dict:
+               proc_node, backend, chained, measured_phases=False,
+               fault=None) -> dict:
     key = {"nprocs": nprocs, "cb_nodes": cb_nodes, "data_size": data_size,
            "method": method, "iters": iters, "ntimes": ntimes,
            "agg_type": agg_type, "proc_node": proc_node,
@@ -503,6 +532,9 @@ def _sweep_key(nprocs, cb_nodes, data_size, method, iters, ntimes, agg_type,
         # only stamped when set: older sidecar records (no key) keep
         # matching their non-measured sweeps exactly
         key["measured_phases"] = True
+    if fault:
+        # same back-compat rule: healthy cells never stamp the key
+        key["fault"] = fault
     return key
 
 
@@ -511,7 +543,8 @@ def _completed_throttles(csv_path: str, nprocs: int, cb_nodes: int,
                          ntimes: int, agg_type: int, proc_node: int = 1,
                          backend: str = "local",
                          chained: bool = False,
-                         measured_phases: bool = False) -> set:
+                         measured_phases: bool = False,
+                         fault: str | None = None) -> set:
     """Throttle values already fully recorded for this sweep config.
 
     Primary source: the sweep sidecar (``<results_csv>.sweep.jsonl``, one
@@ -542,7 +575,7 @@ def _completed_throttles(csv_path: str, nprocs: int, cb_nodes: int,
     if os.path.exists(sidecar):
         key = _sweep_key(nprocs, cb_nodes, data_size, method, iters, ntimes,
                          agg_type, proc_node, backend, chained,
-                         measured_phases)
+                         measured_phases, fault)
         family = (nprocs, cb_nodes, data_size, ntimes, agg_type)
         family_seen = False
         done = set()
@@ -571,6 +604,10 @@ def _completed_throttles(csv_path: str, nprocs: int, cb_nodes: int,
         if family_seen:
             return done
 
+    if fault:
+        # the reference CSV format cannot record a fault spec — healthy
+        # rows must never be credited to a faulted sweep
+        return set()
     names = {METHODS[m].name for m in ids}
     try:
         with open(csv_path, newline="") as f:
@@ -609,16 +646,24 @@ def _run_sweep(args) -> int:
             raise SystemExit("--comm-sizes: no valid throttle values")
     else:
         grid = list(THETA_COMM_SIZES)
-    if args.resume:
-        done = _completed_throttles(args.results_csv, nprocs, args.cb_nodes,
-                                    args.data_size, args.method, args.iters,
-                                    args.ntimes, args.agg_type,
-                                    args.proc_node, args.backend,
-                                    args.chained, args.measured_phases)
-        skipped = [c for c in grid if c in done]
-        grid = [c for c in grid if c not in done]
-        if skipped:
-            print(f"resume: skipping already-recorded comm sizes {skipped}")
+    faults: list = [None]
+    if getattr(args, "fault", None):
+        from tpu_aggcomm.faults import FaultSpecError, parse_fault
+        faults = []
+        for fs in args.fault:
+            if fs.strip().lower() in ("", "none", "healthy"):
+                faults.append(None)
+                continue
+            try:
+                spec = parse_fault(fs)
+            except FaultSpecError as e:
+                raise SystemExit(f"sweep --fault: {e}")
+            faults.append(None if spec.empty else spec.canonical())
+    if args.measured_phases and any(faults):
+        raise SystemExit("sweep: --measured-phases is not supported with "
+                         "--fault (round-prefix truncation would replay "
+                         "the injected delay once per prefix); use "
+                         "--chained for faulted cells")
     if args.measured_phases:
         # validate the WHOLE grid's round depth before any cell runs — a
         # mid-grid ValueError after earlier cells recorded rows is the
@@ -647,29 +692,50 @@ def _run_sweep(args) -> int:
                         f"{MAX_MEASURED_ROUNDS}); trim --comm-sizes or "
                         f"use --chained for the deep cells")
     import json
+
+    from tpu_aggcomm.faults import FaultSpecError, RepairError
     with _tracing(getattr(args, "trace", None)):
-        for c in grid:
-            print(f"RUN_OPTS: -a {args.cb_nodes} -d {args.data_size} -c {c} "
-                  f"-m {args.method} -i {args.iters}")
-            cfg = ExperimentConfig(
-                nprocs=nprocs, cb_nodes=args.cb_nodes, method=args.method,
-                data_size=args.data_size, comm_size=c, iters=args.iters,
-                ntimes=args.ntimes, proc_node=args.proc_node,
-                agg_type=args.agg_type, backend=args.backend,
-                verify=args.verify, results_csv=args.results_csv,
-                chained=args.chained,
-                measured_phases=args.measured_phases)
-            run_experiment(cfg)
-            if args.results_csv:
-                # checkpoint: record the completed throttle with its FULL
-                # config
-                rec = _sweep_key(nprocs, args.cb_nodes, args.data_size,
-                                 args.method, args.iters, args.ntimes,
-                                 args.agg_type, args.proc_node, args.backend,
-                                 args.chained, args.measured_phases)
-                rec["comm"] = c
-                with open(_sweep_sidecar(args.results_csv), "a") as f:
-                    f.write(json.dumps(rec) + "\n")
+        for fs in faults:
+            cells = grid
+            if args.resume:
+                done = _completed_throttles(
+                    args.results_csv, nprocs, args.cb_nodes,
+                    args.data_size, args.method, args.iters, args.ntimes,
+                    args.agg_type, args.proc_node, args.backend,
+                    args.chained, args.measured_phases, fs)
+                skipped = [c for c in cells if c in done]
+                cells = [c for c in cells if c not in done]
+                if skipped:
+                    tag = f" [fault {fs}]" if fs else ""
+                    print(f"resume: skipping already-recorded comm sizes "
+                          f"{skipped}{tag}")
+            for c in cells:
+                ftag = f" --fault {fs}" if fs else ""
+                print(f"RUN_OPTS: -a {args.cb_nodes} -d {args.data_size} "
+                      f"-c {c} -m {args.method} -i {args.iters}{ftag}")
+                cfg = ExperimentConfig(
+                    nprocs=nprocs, cb_nodes=args.cb_nodes,
+                    method=args.method, data_size=args.data_size,
+                    comm_size=c, iters=args.iters, ntimes=args.ntimes,
+                    proc_node=args.proc_node, agg_type=args.agg_type,
+                    backend=args.backend, verify=args.verify,
+                    results_csv=args.results_csv, chained=args.chained,
+                    measured_phases=args.measured_phases, fault=fs)
+                try:
+                    run_experiment(cfg)
+                except (FaultSpecError, RepairError) as e:
+                    raise SystemExit(f"sweep --fault: {e}")
+                if args.results_csv:
+                    # checkpoint: record the completed throttle with its
+                    # FULL config
+                    rec = _sweep_key(nprocs, args.cb_nodes, args.data_size,
+                                     args.method, args.iters, args.ntimes,
+                                     args.agg_type, args.proc_node,
+                                     args.backend, args.chained,
+                                     args.measured_phases, fs)
+                    rec["comm"] = c
+                    with open(_sweep_sidecar(args.results_csv), "a") as f:
+                        f.write(json.dumps(rec) + "\n")
     return 0
 
 
@@ -871,9 +937,10 @@ def _run_inspect_traffic(args) -> int:
         raise SystemExit("inspect traffic: -m is required "
                          "(-m 0 sweeps every method as a gate)")
     if args.method == 0:
-        if args.json or args.trace:
-            raise SystemExit("inspect traffic: --json/--trace apply to a "
-                             "single-method audit, not the -m 0 sweep")
+        if args.json or args.trace or args.fault:
+            raise SystemExit("inspect traffic: --json/--trace/--fault "
+                             "apply to a single-method audit, not the "
+                             "-m 0 sweep")
         rows = tr.conformance_sweep(
             args.nprocs, args.cb_nodes, args.comm_size,
             data_size=args.data_size, proc_node=args.proc_node,
@@ -893,6 +960,14 @@ def _run_inspect_traffic(args) -> int:
         data_size=args.data_size, placement=args.agg_type,
         proc_node=args.proc_node, comm_size=args.comm_size)
     sched = compile_method(args.method, p, barrier_type=args.barrier_type)
+    if args.fault:
+        from tpu_aggcomm.faults import (FaultSpecError, RepairError,
+                                        repair_schedule)
+        try:
+            sched = repair_schedule(sched, args.fault,
+                                    barrier_type=args.barrier_type)
+        except (FaultSpecError, RepairError) as e:
+            raise SystemExit(f"inspect traffic --fault: {e}")
     audit = tr.audit_schedule(sched)
     overlay = None
     if args.trace:
@@ -937,7 +1012,8 @@ def _run_inspect(args) -> int:
                                              compare_paths, render_compare)
         try:
             res = compare_paths(args.trace_file[0], args.trace_file[1],
-                                by=args.by)
+                                by=args.by,
+                                across_faults=args.across_faults)
         except TraceCompareError as e:
             raise SystemExit(f"inspect compare: {e}")
         except (OSError, ValueError, KeyError) as e:
@@ -1216,9 +1292,15 @@ def main(argv=None) -> int:
         backend=args.backend, verify=args.verify,
         results_csv=args.results_csv, profile_rounds=args.profile_rounds,
         chained=args.chained, measured_phases=args.measured_phases,
-        xprof=args.xprof)
-    with _tracing(args.trace):
-        run_experiment(cfg)
+        xprof=args.xprof, fault=args.fault)
+    from tpu_aggcomm.faults import FaultSpecError, RepairError
+    try:
+        with _tracing(args.trace):
+            run_experiment(cfg)
+    except (FaultSpecError, RepairError) as e:
+        # a malformed spec or an unrepairable fault is a usage error:
+        # one line naming the offending token/edge, never a traceback
+        raise SystemExit(f"--fault: {e}")
     return 0
 
 

@@ -177,6 +177,13 @@ class AggregatorPattern:
     placement: Placement = Placement.SPREAD
     proc_node: int = 1
     comm_size: int = 200_000_000  # reference default: effectively unthrottled
+    #: Explicit aggregator ranks overriding the placement policy — the
+    #: fault-repair path's fallback-aggregator election (faults/repair.py)
+    #: re-homes a dead aggregator's role here. COMPARED (unlike the derived
+    #: ``rank_list``): two patterns with different elected aggregators must
+    #: hash/compare distinct or every schedule cache keyed by the pattern
+    #: (jax_sim._cache, tune/cache.py) would alias them.
+    rank_list_override: tuple[int, ...] | None = None
     rank_list: np.ndarray = field(init=False, repr=False, compare=False)
 
     def __post_init__(self):
@@ -188,6 +195,21 @@ class AggregatorPattern:
             raise ValueError("data_size must be >= 1")
         if self.comm_size < 1:
             raise ValueError("comm_size must be >= 1")
+        if self.rank_list_override is not None:
+            ov = tuple(int(r) for r in self.rank_list_override)
+            if len(ov) != self.cb_nodes:
+                raise ValueError(
+                    f"rank_list_override has {len(ov)} ranks; "
+                    f"cb_nodes={self.cb_nodes}")
+            if len(set(ov)) != len(ov):
+                raise ValueError(f"rank_list_override has duplicates: {ov}")
+            if any(not 0 <= r < self.nprocs for r in ov):
+                raise ValueError(
+                    f"rank_list_override out of range [0, {self.nprocs}): {ov}")
+            object.__setattr__(self, "rank_list_override", ov)
+            object.__setattr__(self, "rank_list",
+                               np.asarray(ov, dtype=np.int64))
+            return
         object.__setattr__(
             self, "rank_list",
             create_aggregator_list(self.nprocs, self.cb_nodes,

@@ -58,13 +58,18 @@ def schedule_shape_key(schedule) -> tuple:
     methods can lower to identical comm shapes while charging different
     timer buckets (m=4 vs m=11); the barrier signature is the one
     schedule-shape input not captured by (pattern, method_id): m=13's
-    ``-b`` modes compile different programs from the same pattern."""
+    ``-b`` modes compile different programs from the same pattern.
+    ``variant`` (the canonical fault spec stamped by faults/repair.py)
+    keeps repaired/fault-injected programs from aliasing the healthy
+    compiled cache entries — same pattern, different program."""
     progs = getattr(schedule, "programs", None)
     barrier_sig = tuple(
         op.round for op in (progs[0] if progs else ())
         if op.kind is OpKind.BARRIER)
     return (schedule.pattern, schedule.method_id,
-            getattr(schedule, "collective", False), barrier_sig)
+            getattr(schedule, "collective", False), barrier_sig,
+            getattr(schedule, "variant", ""),
+            getattr(schedule, "fault", None))
 
 
 class OpKind(enum.IntEnum):
@@ -117,6 +122,19 @@ class Op:
     tokens: tuple[int, ...] = ()
     bucket: TimerBucket = TimerBucket.NONE
     nbytes: int = 0
+    #: Matching channel. 0 = the pattern's data channel (message matching
+    #: by directed (src, dst) pair, unique per rep — mpi_test.c:1776).
+    #: Nonzero channels carry relay hops added by the dead-link repair
+    #: pass (faults/repair.py): each rerouted edge gets its own channel so
+    #: a detour sharing a directed pair with a pattern edge (or another
+    #: detour) still matches uniquely.
+    chan: int = 0
+    #: Send side reads from the rank's RECEIVE staging row ``slot`` (set on
+    #: the relay intermediate's forward hop) instead of its send slabs.
+    from_stage: bool = False
+    #: Receive side lands in the staging row ``slot`` (past the pattern's
+    #: recv slots) instead of a pattern recv slot.
+    to_stage: bool = False
 
 
 @dataclass
@@ -130,6 +148,20 @@ class Schedule:
     collective: bool = False  # True for alltoallw-style dense methods
     uses_rendezvous: bool = False
     per_rep: bool = True   # program covers ONE rep; harness loops ntimes
+    #: Canonical fault spec (faults/spec.py) realized in this schedule's
+    #: programs, or None for a healthy schedule. Backends read it to apply
+    #: the injection layer (slow-rank work, dead-edge masking).
+    fault: str | None = None
+    #: Program-variant tag folded into :func:`schedule_shape_key`. The
+    #: repair pass stamps the canonical fault spec here so compiled caches
+    #: never alias a repaired program with the healthy one.
+    variant: str = ""
+    #: Number of relay staging rows appended past every rank's pattern
+    #: recv slots (dead-link repair). 0 for healthy schedules.
+    n_staging: int = 0
+    #: Directed (src, dst) pattern edges that the fault killed and the
+    #: repair rerouted — validate() exempts these from chan-0 coverage.
+    dead_edges: tuple[tuple[int, int], ...] = ()
 
     @property
     def nprocs(self) -> int:
@@ -142,20 +174,40 @@ class Schedule:
         ``slot_dst`` joined from :meth:`recv_slot_table` (directed pairs
         are unique per rep in every reference method, so the join is
         exact; -1 only when no matching receive exists). Shape (E, 5).
+        Relay hops (chan != 0) are included — they are real traffic; their
+        ``slot_dst`` is the logical landing index (staging rows count past
+        the pattern recv slots). Consumers that must distinguish staging
+        use :meth:`data_edges_ext`.
         """
+        return self.data_edges_ext()[:, :5]
+
+    def data_edges_ext(self) -> np.ndarray:
+        """Extended edge view: (src, dst, slot_src, slot_dst, round, chan,
+        flags), shape (E, 7). ``flags`` bit 0 = the send side reads from
+        the source rank's staging row ``slot_src``; bit 1 = the receive
+        lands in the destination's staging row ``slot_dst``. chan-0 rows
+        reproduce :meth:`data_edges` exactly on healthy schedules."""
         rows = []
         rtable = self.recv_slot_table()
+        relay = self.relay_recv_table()
         for rank, prog in enumerate(self.programs):
             for op in prog:
-                if op.kind in (OpKind.ISEND, OpKind.ISSEND, OpKind.SEND) and op.nbytes > 0:
-                    dslot = rtable.get((rank, op.peer), -1)
-                    rows.append((rank, op.peer, op.slot, dslot, op.round))
+                if (op.kind in (OpKind.ISEND, OpKind.ISSEND, OpKind.SEND)
+                        and op.nbytes > 0):
+                    if op.chan:
+                        dslot, to_stage = relay.get(
+                            (rank, op.peer, op.chan), (-1, False))
+                    else:
+                        dslot, to_stage = rtable.get((rank, op.peer), -1), False
+                    flags = (1 if op.from_stage else 0) | (2 if to_stage else 0)
+                    rows.append((rank, op.peer, op.slot, dslot, op.round,
+                                 op.chan, flags))
                 elif op.kind is OpKind.SENDRECV and op.nbytes > 0:
                     dslot = rtable.get((rank, op.peer), -1)
-                    rows.append((rank, op.peer, op.slot, dslot, op.round))
+                    rows.append((rank, op.peer, op.slot, dslot, op.round, 0, 0))
                 elif op.kind is OpKind.COPY:
-                    rows.append((rank, rank, op.slot, op.slot2, op.round))
-        return np.array(rows, dtype=np.int64).reshape(-1, 5)
+                    rows.append((rank, rank, op.slot, op.slot2, op.round, 0, 0))
+        return np.array(rows, dtype=np.int64).reshape(-1, 7)
 
     def rounds(self) -> list[np.ndarray]:
         """Edges grouped by completion round: list of (E_k, 2) arrays of
@@ -174,11 +226,14 @@ class Schedule:
 
         Message matching is by directed pair, which is unique per rep in
         every reference method (tags are ``src + dst`` per edge,
-        mpi_test.c:1776 — unique per direction within a rep).
+        mpi_test.c:1776 — unique per direction within a rep). Relay-hop
+        receives (chan != 0) live in :meth:`relay_recv_table` instead.
         """
         table: dict[tuple[int, int], int] = {}
         for rank, prog in enumerate(self.programs):
             for op in prog:
+                if op.chan:
+                    continue
                 if op.kind in (OpKind.IRECV, OpKind.RECV):
                     table[(op.peer, rank)] = op.slot
                 elif op.kind is OpKind.SENDRECV:
@@ -187,24 +242,51 @@ class Schedule:
                     table[(rank, rank)] = op.slot2
         return table
 
+    def relay_recv_table(self) -> dict[tuple[int, int, int],
+                                       tuple[int, bool]]:
+        """(src, dst, chan) → (receiver slot, lands_in_staging) for the
+        relay-channel receives (chan != 0) the repair pass appends."""
+        table: dict[tuple[int, int, int], tuple[int, bool]] = {}
+        for rank, prog in enumerate(self.programs):
+            for op in prog:
+                if op.chan and op.kind in (OpKind.IRECV, OpKind.RECV):
+                    table[(op.peer, rank, op.chan)] = (op.slot, op.to_stage)
+        return table
+
     def validate(self) -> None:
-        """Sanity-check the schedule: every data send has a matching receive
-        and every expected pattern edge is covered exactly once."""
+        """Sanity-check the schedule: every data send has a matching
+        receive, duplicates are checked per matching key (src, dst, chan),
+        and chan-0 coverage equals the pattern's expected edges minus any
+        ``dead_edges`` the repair rerouted (whose payloads arrive via the
+        relay channels instead)."""
         table = self.recv_slot_table()
-        edges = self.data_edges()
+        relay = self.relay_recv_table()
+        edges = self.data_edges_ext()
         seen = set()
-        for src, dst, _sslot, _dslot, _r in edges:
-            key = (int(src), int(dst))
+        chan0 = set()
+        for src, dst, _sslot, _dslot, _r, chan, _flags in edges:
+            key = (int(src), int(dst), int(chan))
             if key in seen:
                 raise AssertionError(f"{self.name}: duplicate edge {key}")
             seen.add(key)
-            if key not in table and not self.collective:
-                raise AssertionError(f"{self.name}: send {key} has no matching recv")
-        # expected coverage: every (sender, receiver) pair of the pattern
+            if self.collective:
+                continue
+            if chan:
+                if key not in relay:
+                    raise AssertionError(
+                        f"{self.name}: relay send {key} has no matching recv")
+            else:
+                chan0.add(key[:2])
+                if key[:2] not in table:
+                    raise AssertionError(
+                        f"{self.name}: send {key[:2]} has no matching recv")
+        # expected coverage: every (sender, receiver) pair of the pattern,
+        # less the dead edges whose chan-0 message the repair removed
         p = self.pattern
         expected = {(int(s), int(d)) for s in p.senders for d in p.receivers}
-        if not self.collective and seen != expected:
-            missing = sorted(expected - seen)[:5]
-            extra = sorted(seen - expected)[:5]
+        expected -= {(int(s), int(d)) for s, d in self.dead_edges}
+        if not self.collective and chan0 != expected:
+            missing = sorted(expected - chan0)[:5]
+            extra = sorted(chan0 - expected)[:5]
             raise AssertionError(
                 f"{self.name}: edge coverage mismatch; missing={missing} extra={extra}")

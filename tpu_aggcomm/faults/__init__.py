@@ -1,0 +1,28 @@
+"""Fault-injection subsystem: declarative degradation scenarios.
+
+Three layers, kept deliberately separate:
+
+- **spec** (`faults/spec.py`, jax-free): the declarative fault model —
+  ``FaultSpec`` parsed from ``--fault "slow:r3*4.0,deadlink:5>2,deadagg:a1"``
+  — recorded verbatim (canonical form) in trace/ledger/bench metadata. The
+  tuner's ``--synthetic`` skew grammar lives here too (one parser, one
+  error style).
+- **repair** (`faults/repair.py`, jax-free): a schedule-repair pass over
+  ``Schedule.programs`` that reroutes traffic around dead links (detour via
+  a live relay intermediate on a fresh matching channel) and dead
+  aggregators (fallback-aggregator election via
+  ``AggregatorPattern.rank_list_override``). Repaired schedules stay data:
+  they must pass byte-exact ``--verify`` against the local oracle and the
+  traffic auditor's static ``-c`` conformance proof.
+- **inject** (`faults/inject.py`, numpy-only): how backends *realize* a
+  spec at execution time — per-rank work-multiplier delay loops for slow
+  ranks, masked edges for unrepaired dead links — without touching round
+  semantics.
+"""
+
+from tpu_aggcomm.faults.spec import (FaultSpec, FaultSpecError, parse_fault,
+                                     parse_synthetic)
+from tpu_aggcomm.faults.repair import RepairError, repair_schedule
+
+__all__ = ["FaultSpec", "FaultSpecError", "parse_fault", "parse_synthetic",
+           "RepairError", "repair_schedule"]

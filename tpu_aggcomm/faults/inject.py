@@ -1,0 +1,68 @@
+"""Injection layer: how backends realize a FaultSpec at execution time.
+
+numpy-only (no jax import — backends translate the numbers returned here
+into their own lowerings):
+
+- **slow ranks**: a per-rank delay-loop iteration count derived from the
+  work-multiplier factor and the schedule depth. The model is deliberately
+  simple and documented rather than calibrated: a rank with factor F does
+  roughly (F-1) x (its healthy per-round work) extra busy work per rep,
+  approximated as ``SLOW_UNITS_PER_ROUND`` loop iterations per round per
+  unit of (F-1). The loop bodies the backends build from this count are
+  data-dependent on live buffers so XLA cannot fold them away.
+- **dead edges**: a keep-mask over a schedule's extended edge table for
+  UNREPAIRED runs — the chan-0 pattern edges named by ``deadlink`` clauses
+  drop their payload (relay hops, chan != 0, always survive: a repaired
+  schedule's detours are what make the fault survivable). Running an
+  unrepaired faulted schedule is supposed to fail verification — that
+  failure is the injection working.
+
+Round semantics are never touched: slow work is appended outside the round
+structure, and masking removes deliveries without reordering any round.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_aggcomm.faults.spec import FaultSpec
+
+__all__ = ["SLOW_UNITS_PER_ROUND", "delay_iters", "slow_iter_table",
+           "dead_edge_mask"]
+
+#: Delay-loop iterations per round per unit of (factor - 1). One iteration
+#: is one masked-mod reduction over a slab row (the backends' loop body) —
+#: comparable in cost to touching one slab, i.e. one round's per-edge work.
+SLOW_UNITS_PER_ROUND = 32
+
+
+def delay_iters(factor: float, n_rounds: int) -> int:
+    """Loop iterations realizing work multiplier ``factor`` over a
+    schedule ``n_rounds`` deep. factor 1.0 -> 0 (no loop at all)."""
+    if factor <= 1.0:
+        return 0
+    return max(1, round((factor - 1.0) * SLOW_UNITS_PER_ROUND
+                        * max(int(n_rounds), 1)))
+
+
+def slow_iter_table(spec: FaultSpec, nprocs: int,
+                    n_rounds: int) -> np.ndarray:
+    """(nprocs,) int32 delay-loop iteration counts, 0 for healthy ranks."""
+    out = np.zeros(nprocs, dtype=np.int32)
+    for r, f in spec.slow:
+        if 0 <= r < nprocs:
+            out[r] = delay_iters(f, n_rounds)
+    return out
+
+
+def dead_edge_mask(ext_edges: np.ndarray, spec: FaultSpec) -> np.ndarray:
+    """(E,) bool keep-mask over ``Schedule.data_edges_ext()`` rows for an
+    UNREPAIRED run: False exactly on chan-0 edges named dead."""
+    keep = np.ones(len(ext_edges), dtype=bool)
+    if not spec.deadlinks:
+        return keep
+    dead = set(spec.deadlinks)
+    for i, row in enumerate(ext_edges):
+        if int(row[5]) == 0 and (int(row[0]), int(row[1])) in dead:
+            keep[i] = False
+    return keep

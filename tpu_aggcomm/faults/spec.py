@@ -1,0 +1,161 @@
+"""Declarative fault model — the ``--fault`` grammar.
+
+A fault spec is a comma-separated list of clauses:
+
+- ``slow:rR*F``    — rank R runs with work multiplier F (F >= 1.0); the
+  backends realize it as an extra delay loop proportional to (F-1)
+  (faults/inject.py), never as a change to the message program.
+- ``deadlink:S>D`` — the directed link S->D drops payloads. Unrepaired, a
+  schedule run under this fault loses the message (local oracle: deadlock
+  or verify failure — the *point* of injection). Repaired
+  (faults/repair.py), the payload detours via a live relay.
+- ``deadagg:aI``   — the I-th aggregator (index into the pattern's
+  rank_list) has failed in its aggregator role; repair elects a fallback
+  rank and regenerates the schedule on the re-homed pattern.
+
+Specs are VALUES: :meth:`FaultSpec.canonical` is sorted and format-stable,
+``parse_fault(s.canonical()) == s``, and the canonical string is what
+lands in trace/ledger/bench metadata and in ``Schedule.variant`` (the
+compiled-cache key component).
+
+This module is jax-free and numpy-free — ``tune/`` re-exports
+:func:`parse_synthetic` from here for the ``--synthetic`` skew sampler, and
+both run on replay paths where jax may not even import.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["FaultSpec", "FaultSpecError", "parse_fault", "parse_synthetic"]
+
+
+class FaultSpecError(ValueError):
+    """Malformed or out-of-range fault/synthetic spec (CLI maps this to a
+    clean error naming the offending token — no traceback)."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """Parsed fault scenario. Empty tuples = healthy."""
+
+    slow: tuple[tuple[int, float], ...] = ()      # (rank, factor >= 1.0)
+    deadlinks: tuple[tuple[int, int], ...] = ()   # directed (src, dst)
+    deadaggs: tuple[int, ...] = ()                # indices into rank_list
+
+    @property
+    def empty(self) -> bool:
+        return not (self.slow or self.deadlinks or self.deadaggs)
+
+    def canonical(self) -> str:
+        """Sorted, format-stable text form; ``parse_fault`` round-trips it."""
+        parts = [f"slow:r{r}*{f:g}" for r, f in sorted(self.slow)]
+        parts += [f"deadlink:{s}>{d}" for s, d in sorted(self.deadlinks)]
+        parts += [f"deadagg:a{i}" for i in sorted(self.deadaggs)]
+        return ",".join(parts)
+
+    def slow_factors(self) -> dict[int, float]:
+        return {r: f for r, f in self.slow}
+
+    def validate_against(self, nprocs: int, cb_nodes: int) -> None:
+        """Range-check every clause against a pattern's shape."""
+        for r, f in self.slow:
+            if not 0 <= r < nprocs:
+                raise FaultSpecError(
+                    f"slow rank r{r} out of range [0, {nprocs})")
+            if f < 1.0:
+                raise FaultSpecError(
+                    f"slow factor {f:g} for r{r} must be >= 1.0 "
+                    f"(a work multiplier)")
+        for s, d in self.deadlinks:
+            if not (0 <= s < nprocs and 0 <= d < nprocs):
+                raise FaultSpecError(
+                    f"deadlink {s}>{d} out of range [0, {nprocs})")
+        for i in self.deadaggs:
+            if not 0 <= i < cb_nodes:
+                raise FaultSpecError(
+                    f"deadagg a{i} out of range [0, cb_nodes={cb_nodes})")
+
+
+def parse_fault(text: str | None) -> FaultSpec:
+    """Parse ``"slow:r3*4.0,deadlink:5>2,deadagg:a1"`` into a FaultSpec.
+
+    Every malformed token raises :class:`FaultSpecError` naming the token;
+    structural errors (duplicates, self-links) are caught here, shape
+    errors (rank out of range) in :meth:`FaultSpec.validate_against`.
+    """
+    if text is None:
+        return FaultSpec()
+    slow: list[tuple[int, float]] = []
+    deadlinks: list[tuple[int, int]] = []
+    deadaggs: list[int] = []
+    for tok in (t.strip() for t in str(text).split(",")):
+        if not tok:
+            continue
+        try:
+            kind, _, rest = tok.partition(":")
+            if kind == "slow":
+                rank_s, _, fac_s = rest.partition("*")
+                if not rank_s.startswith("r") or not fac_s:
+                    raise ValueError
+                slow.append((int(rank_s[1:]), float(fac_s)))
+            elif kind == "deadlink":
+                s_s, _, d_s = rest.partition(">")
+                if not d_s:
+                    raise ValueError
+                deadlinks.append((int(s_s), int(d_s)))
+            elif kind == "deadagg":
+                if not rest.startswith("a"):
+                    raise ValueError
+                deadaggs.append(int(rest[1:]))
+            else:
+                raise ValueError
+        except ValueError:
+            raise FaultSpecError(
+                f"bad fault token {tok!r} (expected 'slow:rR*F', "
+                f"'deadlink:S>D', or 'deadagg:aI')") from None
+    if len({r for r, _ in slow}) != len(slow):
+        raise FaultSpecError(f"duplicate slow rank in {text!r}")
+    if len(set(deadlinks)) != len(deadlinks):
+        raise FaultSpecError(f"duplicate deadlink in {text!r}")
+    for s, d in deadlinks:
+        if s == d:
+            raise FaultSpecError(
+                f"deadlink {s}>{d} is a self-link (COPY edges cannot die)")
+    if len(set(deadaggs)) != len(deadaggs):
+        raise FaultSpecError(f"duplicate deadagg in {text!r}")
+    return FaultSpec(slow=tuple(sorted(slow)),
+                     deadlinks=tuple(sorted(deadlinks)),
+                     deadaggs=tuple(sorted(deadaggs)))
+
+
+def parse_synthetic(spec) -> tuple[float, dict[int, float]]:
+    """Parse the tuner's ``--synthetic "BASE_US[,mID*FACTOR]..."`` grammar.
+
+    Returns ``(base_seconds, {method_id: factor})``. Historically lived in
+    ``tune/race.py``; moved here so the fault grammar and the skew grammar
+    share one parser home and one error style (FaultSpecError; the tuner
+    re-wraps it as RaceError). Existing strings parse identically.
+    """
+    parts = [p.strip() for p in str(spec).split(",") if p.strip()]
+    if not parts:
+        raise FaultSpecError(
+            "synthetic spec is empty (expected 'BASE_US[,mID*FACTOR]...')")
+    try:
+        base_s = float(parts[0]) * 1e-6
+    except ValueError:
+        raise FaultSpecError(
+            f"malformed synthetic spec {spec!r}: bad base {parts[0]!r} "
+            f"(expected 'BASE_US[,mID*FACTOR]...', e.g. '100,m3*0.5')"
+        ) from None
+    factors: dict[int, float] = {}
+    for p in parts[1:]:
+        try:
+            mid, fac = p.split("*")
+            factors[int(mid.lstrip("m"))] = float(fac)
+        except (ValueError, IndexError):
+            raise FaultSpecError(
+                f"malformed synthetic spec {spec!r}: bad token {p!r} "
+                f"(expected 'BASE_US[,mID*FACTOR]...', e.g. '100,m3*0.5')"
+            ) from None
+    return base_s, factors

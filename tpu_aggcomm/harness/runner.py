@@ -53,6 +53,11 @@ class ExperimentConfig:
     #                              report its divergence vs the
     #                              reconstructed attribution (cross-check
     #                              only — the timed path is untouched)
+    fault: str | None = None     # --fault SPEC: fault-injection scenario
+    #                              ("slow:rR*F,deadlink:S>D,deadagg:aI");
+    #                              schedules are repaired (faults/repair.py)
+    #                              before dispatch and backends realize the
+    #                              injected degradation (faults/inject.py)
 
 
 def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
@@ -86,6 +91,17 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
         if cfg.profile_rounds:
             raise ValueError("--measured-phases and --profile-rounds are "
                              "exclusive")
+    fspec = None
+    if cfg.fault:
+        from tpu_aggcomm.faults import parse_fault
+        fspec = parse_fault(cfg.fault)
+        if fspec.empty:
+            fspec = None
+    if fspec is not None and cfg.measured_phases:
+        raise ValueError(
+            "--measured-phases is not supported with --fault (round-prefix "
+            "truncation would replay the injected delay once per prefix); "
+            "use --chained timing for faulted runs")
     backend = get_backend(cfg.backend)
     pattern = AggregatorPattern(
         nprocs=cfg.nprocs, cb_nodes=cfg.cb_nodes,
@@ -126,6 +142,27 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             f"m{m}:{METHODS[m].name}",
             seconds=time.perf_counter() - t0, kind="schedule-build",
             backend=cfg.backend)
+    if fspec is not None:
+        # repair BEFORE any method runs: an unrepairable method in a
+        # run-all sweep must fail upfront, not mid-run with a partial CSV
+        from tpu_aggcomm.faults import repair_schedule
+        bad = [m for m in methods
+               if METHODS[m].tam or compiled[m].collective]
+        if bad:
+            raise ValueError(
+                f"--fault does not support methods {bad} (TAM's staged "
+                f"engine and the dense collectives have no round-"
+                f"structured op programs to repair); pick round-structured "
+                f"methods with -m")
+        canon = fspec.canonical()
+        for m in methods:
+            t0 = time.perf_counter()
+            compiled[m] = repair_schedule(compiled[m], fspec,
+                                          barrier_type=cfg.barrier_type)
+            ledger.record_compile(
+                f"m{m}:{METHODS[m].name}[{canon}]",
+                seconds=time.perf_counter() - t0, kind="schedule-repair",
+                backend=cfg.backend)
     if cfg.measured_phases:
         # fail upfront, like the chained TAM guard: the truncation
         # measurement exists for round-structured schedules everywhere
@@ -212,7 +249,8 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
                     ntimes=cfg.ntimes, requested=cfg.backend,
                     executed=executed, phase_source=phases,
                     timers=timers, calls=calls,
-                    rep_timers=getattr(backend, "last_rep_timers", None))
+                    rep_timers=getattr(backend, "last_rep_timers", None),
+                    fault=getattr(sched, "fault", None))
             if cfg.results_csv:
                 append_provenance(cfg.results_csv, spec.name, cfg.backend,
                                   executed, phases)

@@ -52,9 +52,11 @@ def _runs(events):
     return [e for e in events if e["ev"] == "run"]
 
 
-def _check_pairable(ra: dict, rb: dict, k: int) -> None:
+def _check_pairable(ra: dict, rb: dict, k: int,
+                    across_faults: bool = False) -> None:
     """Refuse clearly when run k of the two traces ran different
-    programs — method first (the acceptance case), then shape."""
+    programs — method first (the acceptance case), then shape, then
+    fault spec (unless ``across_faults`` deliberately crosses them)."""
     if (ra["method"], ra["name"]) != (rb["method"], rb["name"]):
         raise TraceCompareError(
             f"cannot compare traces of different methods: run {k} is "
@@ -68,6 +70,15 @@ def _check_pairable(ra: dict, rb: dict, k: int) -> None:
                 f"cannot compare run {k} (m={ra['method']} "
                 f"\"{ra['name']}\"): {field} differs "
                 f"({ra[field]} in A vs {rb[field]} in B)")
+    fa, fb = ra.get("fault") or None, rb.get("fault") or None
+    if fa != fb and not across_faults:
+        raise TraceCompareError(
+            f"cannot compare run {k} (m={ra['method']} "
+            f"\"{ra['name']}\"): fault specs differ "
+            f"(A {fa or 'healthy'} vs B {fb or 'healthy'}) — a delta "
+            f"across fault scenarios is a RECOVERY delta, not a "
+            f"regression; pass --across-faults to compare them "
+            f"deliberately")
 
 
 def _chained_samples(events) -> list[float] | None:
@@ -112,9 +123,12 @@ def _key_sort(by: str):
 
 
 def compare_traces(events_a: list[dict], events_b: list[dict],
-                   by: str = "rank") -> dict:
+                   by: str = "rank", across_faults: bool = False) -> dict:
     """Diff two event logs run-by-run. Raises :class:`TraceCompareError`
-    on mismatched runs; see module docstring for the result layout."""
+    on mismatched runs; see module docstring for the result layout.
+    ``across_faults`` allows pairing runs whose fault specs differ — the
+    delta is then a RECOVERY delta (faulted+repaired vs healthy) and the
+    result names both specs."""
     if by not in BY_CHOICES:
         raise ValueError(f"by must be one of {BY_CHOICES}")
     runs_a, runs_b = _runs(events_a), _runs(events_b)
@@ -128,7 +142,7 @@ def compare_traces(events_a: list[dict], events_b: list[dict],
     samples_b = _chained_samples(events_b)
     out = {"by": by, "runs": []}
     for k, (ra, rb) in enumerate(zip(runs_a, runs_b)):
-        _check_pairable(ra, rb, k)
+        _check_pairable(ra, rb, k, across_faults)
         pa = bucket_cells(events_a, ra["id"])
         pb = bucket_cells(events_b, rb["id"])
         agg_a = aggregate_run(events_a, ra["id"])
@@ -206,6 +220,8 @@ def compare_traces(events_a: list[dict], events_b: list[dict],
             "nprocs": ra["nprocs"], "data_size": ra["data_size"],
             "phase_source_a": ra["phase_source"],
             "phase_source_b": rb["phase_source"],
+            "fault_a": ra.get("fault") or None,
+            "fault_b": rb.get("fault") or None,
             "total_a_s": total_a, "total_b_s": total_b,
             "total_delta_pct": ((total_b - total_a) / total_a * 100.0
                                 if total_a else None),
@@ -230,7 +246,8 @@ def _wall(grid: dict) -> float:
     return max(per_rank.values(), default=0.0)
 
 
-def compare_paths(path_a: str, path_b: str, by: str = "rank") -> dict:
+def compare_paths(path_a: str, path_b: str, by: str = "rank",
+                  across_faults: bool = False) -> dict:
     """Diff two trace files, or two directories of per-cell traces
     (matched by basename). Returns the compare result with source
     labels attached; directory mode returns
@@ -251,7 +268,8 @@ def compare_paths(path_a: str, path_b: str, by: str = "rank") -> dict:
         for name in common:
             res = compare_traces(
                 load_events(os.path.join(path_a, name)),
-                load_events(os.path.join(path_b, name)), by=by)
+                load_events(os.path.join(path_b, name)), by=by,
+                across_faults=across_faults)
             res["a"], res["b"] = (os.path.join(path_a, name),
                                   os.path.join(path_b, name))
             res["cell"] = name
@@ -259,7 +277,8 @@ def compare_paths(path_a: str, path_b: str, by: str = "rank") -> dict:
         return {"by": by, "grid": grid,
                 "only_a": sorted(names_a - names_b),
                 "only_b": sorted(names_b - names_a)}
-    res = compare_traces(load_events(path_a), load_events(path_b), by=by)
+    res = compare_traces(load_events(path_a), load_events(path_b), by=by,
+                         across_faults=across_faults)
     res["a"], res["b"] = path_a, path_b
     return res
 
@@ -276,6 +295,14 @@ def _render_one(res: dict, by: str, lines: list) -> None:
         lines.append(
             f"run: m={rec['method']} \"{rec['name']}\" "
             f"n={rec['nprocs']} d={rec['data_size']}")
+        fa, fb = rec.get("fault_a"), rec.get("fault_b")
+        if fa != fb:
+            lines.append(
+                f"  RECOVERY delta: A fault={fa or 'healthy'} vs "
+                f"B fault={fb or 'healthy'} — the total delta below is "
+                f"the cost of surviving the fault, not a regression")
+        elif fa:
+            lines.append(f"  fault: {fa} (both sides)")
         dp = rec["total_delta_pct"]
         lines.append(
             f"  max-over-ranks total: A {rec['total_a_s']:.6f} s  "

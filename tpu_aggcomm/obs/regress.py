@@ -116,7 +116,7 @@ def validate_bench(obj, where: str = "BENCH") -> list[str]:
     _require(parsed, "unit", str, errors, w)
     for opt, types in (("vs_baseline", (int, float)), ("platform", str),
                        ("tpu_error", str), ("tpu_attempts", int),
-                       ("error", str)):
+                       ("error", str), ("fault", str)):
         if opt in parsed and parsed[opt] is not None \
                 and not isinstance(parsed[opt], types):
             errors.append(f"{w}: optional key {opt!r} has wrong type "
@@ -326,6 +326,11 @@ def validate_traffic(obj, where: str = "TRAFFIC") -> list[str]:
             _require(cfg, k, int, errors, f"{where}.config")
         _require(cfg, "name", str, errors, f"{where}.config")
         _require(cfg, "direction", str, errors, f"{where}.config")
+        # optional fault-repaired provenance (audits of detoured schedules)
+        if "fault" in cfg and cfg["fault"] is not None \
+                and not isinstance(cfg["fault"], str):
+            errors.append(f"{where}.config: optional key 'fault' must be "
+                          f"a string")
     rounds = obj.get("rounds")
     if not isinstance(rounds, list):
         errors.append(f"{where}: 'rounds' must be a list")

@@ -28,8 +28,14 @@ Four panels:
   visible at a glance.
 - **traffic audit** — per traced run, the static throttle-conformance
   verdict (peak in-flight vs the -c bound, obs/traffic.py, recompiled
-  jax-free from the run's recorded config) and, at n <= 64, the
-  aggregate src→dst byte heatmap.
+  jax-free from the run's recorded config — fault-repaired first when
+  the run recorded a fault spec, so the audited program is the detoured
+  one that actually ran) and, at n <= 64, the aggregate src→dst byte
+  heatmap.
+- **fault degradation** — every faulted trace run paired with a healthy
+  run of the same (method, n, data size) across the traces passed in:
+  the recovery delta (faulted minus healthy critical-path seconds) and
+  its percentage, i.e. the measured cost of surviving the fault.
 
 Empty inputs degrade to an honest "no data" panel, never a broken page.
 """
@@ -124,6 +130,10 @@ def _run_traffic(run: dict) -> dict | None:
             proc_node=run.get("proc_node", 1),
             comm_size=run["comm_size"])
         sched = compile_method(run["method"], p)
+        if run.get("fault"):
+            # audit the program that actually ran: the detoured one
+            from tpu_aggcomm.faults import repair_schedule
+            sched = repair_schedule(sched, run["fault"])
         if getattr(sched, "collective", False) and n > 256:
             return {"verdict": "EXEMPT", "note":
                     f"dense collective at n={n}: matrix omitted"}
@@ -167,6 +177,7 @@ def _trace_runs(paths: list[str]) -> list[dict]:
                 "file": path, "run": rid,
                 "method": run["method"], "name": run["name"],
                 "nprocs": run["nprocs"], "data_size": run["data_size"],
+                "fault": run.get("fault") or None,
                 "phase_source": run["phase_source"],
                 "worst_skew": worst["skew"] if worst else None,
                 "worst_skew_round": (_round_label(worst["round"])
@@ -185,6 +196,40 @@ def _trace_runs(paths: list[str]) -> list[dict]:
                          "cells": cells},
                 "traffic": _run_traffic(run)})
     return out
+
+
+def _degradation_rows(runs: list[dict]) -> list[dict]:
+    """Fault-degradation pane data: every faulted trace run paired with
+    the first healthy run of the same (method, nprocs, data_size) among
+    the traces passed in. The delta is faulted-minus-healthy critical-
+    path seconds — the measured cost of surviving the fault. Unpaired
+    faulted runs still get a row (null delta) so the scenario stays
+    visible."""
+    healthy: dict[tuple, dict] = {}
+    for r in runs:
+        if not r.get("fault") and r.get("total_s") is not None:
+            healthy.setdefault(
+                (r["method"], r["nprocs"], r["data_size"]), r)
+    rows = []
+    for r in runs:
+        if not r.get("fault"):
+            continue
+        base = healthy.get((r["method"], r["nprocs"], r["data_size"]))
+        delta = (r["total_s"] - base["total_s"]
+                 if base is not None and r.get("total_s") is not None
+                 else None)
+        rows.append({
+            "file": r["file"], "run": r["run"], "method": r["method"],
+            "name": r["name"], "nprocs": r["nprocs"],
+            "fault": r["fault"],
+            "faulted_s": r.get("total_s"),
+            "healthy_s": base["total_s"] if base is not None else None,
+            "healthy_ref": (base["file"] + " #" + str(base["run"])
+                            if base is not None else None),
+            "delta_s": delta,
+            "pct": (delta / base["total_s"] * 100.0
+                    if delta is not None and base["total_s"] else None)})
+    return rows
 
 
 def _tune_rows(root: str) -> list[dict]:
@@ -236,9 +281,11 @@ def build_payload(history_root: str = ".",
     not swallowed)."""
     bench, errors = _history_rows(history_root)
     multichip = _multichip_rows(history_root, errors)
+    runs = _trace_runs(list(trace_paths or []))
     return {"bench": bench, "multichip": multichip,
             "tune": _tune_rows(history_root),
-            "runs": _trace_runs(list(trace_paths or [])),
+            "runs": runs,
+            "degradation": _degradation_rows(runs),
             "errors": errors}
 
 
@@ -278,6 +325,8 @@ time; lower is better everywhere (seconds per rep).</p>
 <div id="heat"></div>
 <h2>Traffic audit (static conformance + src &rarr; dst bytes)</h2>
 <div id="traffic"></div>
+<h2>Fault degradation (recovery deltas)</h2>
+<div id="degradation"></div>
 <script id="data" type="application/json">{payload}</script>
 <script>
 "use strict";
@@ -533,16 +582,17 @@ function fmtS(v) {{
   }}
   var tbl = el("table");
   var hr = el("tr");
-  ["trace", "m", "name", "n", "total", "worst skew (round)",
+  ["trace", "m", "name", "fault", "n", "total", "worst skew (round)",
    "imbalance", "critical rank", "dominant cell", "provenance"]
     .forEach(function (h, i) {{
-      hr.appendChild(el("th", i < 3 ? {{class: "l"}} : {{}}, h)); }});
+      hr.appendChild(el("th", i < 4 ? {{class: "l"}} : {{}}, h)); }});
   tbl.appendChild(hr);
   DATA.runs.forEach(function (r) {{
     var tr = el("tr");
     tr.appendChild(el("td", {{class: "l"}}, r.file + " #" + r.run));
     tr.appendChild(el("td", {{class: "l"}}, String(r.method)));
     tr.appendChild(el("td", {{class: "l"}}, r.name));
+    tr.appendChild(el("td", {{class: "l"}}, r.fault || "healthy"));
     tr.appendChild(el("td", {{}}, String(r.nprocs)));
     tr.appendChild(el("td", {{}}, fmtS(r.total_s)));
     tr.appendChild(el("td", {{}}, r.worst_skew === null ? "-" :
@@ -680,6 +730,49 @@ function fmtS(v) {{
     }});
     host.appendChild(mt);
   }});
+}})();
+
+(function degradationPane() {{
+  var host = document.getElementById("degradation");
+  var rows = DATA.degradation || [];
+  if (!rows.length) {{
+    host.appendChild(el("p", {{class: "note"}},
+        "no faulted trace runs passed — record with --fault and pass " +
+        "both the healthy and the faulted trace to populate"));
+    return;
+  }}
+  var tbl = el("table");
+  var hr = el("tr");
+  ["faulted trace", "m", "name", "fault", "n", "healthy", "faulted",
+   "recovery delta", "%"].forEach(function (h, i) {{
+    hr.appendChild(el("th", i < 4 ? {{class: "l"}} : {{}}, h)); }});
+  tbl.appendChild(hr);
+  rows.forEach(function (r) {{
+    var tr = el("tr");
+    tr.appendChild(el("td", {{class: "l"}}, r.file + " #" + r.run));
+    tr.appendChild(el("td", {{class: "l"}}, String(r.method)));
+    tr.appendChild(el("td", {{class: "l"}}, r.name));
+    tr.appendChild(el("td", {{class: "l"}}, r.fault));
+    tr.appendChild(el("td", {{}}, String(r.nprocs)));
+    tr.appendChild(el("td", {{}},
+        r.healthy_s === null || r.healthy_s === undefined ?
+        "- (no healthy pair)" : fmtS(r.healthy_s)));
+    tr.appendChild(el("td", {{}}, fmtS(r.faulted_s)));
+    var dd = el("td", {{}}, r.delta_s === null || r.delta_s === undefined
+        ? "-" : (r.delta_s >= 0 ? "+" : "") + fmtS(Math.abs(r.delta_s)));
+    if (r.delta_s !== null && r.delta_s !== undefined && r.delta_s > 0)
+      dd.className = "err";
+    tr.appendChild(dd);
+    tr.appendChild(el("td", {{}},
+        r.pct === null || r.pct === undefined ? "-" :
+        (r.pct >= 0 ? "+" : "") + r.pct.toFixed(1) + "%"));
+    tbl.appendChild(tr);
+  }});
+  host.appendChild(tbl);
+  host.appendChild(el("p", {{class: "note"}},
+      "recovery delta = faulted critical-path seconds minus the first " +
+      "healthy run of the same (method, n, data size) — the measured " +
+      "cost of surviving the fault, not a regression"));
 }})();
 </script></body></html>
 """

@@ -181,7 +181,7 @@ class TraceRecorder:
     def record_method_run(self, schedule, *, method: int, name: str,
                           iter_: int, ntimes: int, requested: str,
                           executed: str, phase_source: str, timers,
-                          calls, rep_timers=None) -> int:
+                          calls, rep_timers=None, fault=None) -> int:
         """Append the run/span/counter/timer events for one backend run.
 
         ``calls`` is the attribution cell stream captured around
@@ -209,7 +209,8 @@ class TraceRecorder:
             "agg_type": int(p.placement),
             "backend": requested, "executed": executed,
             "phase_source": phase_source, "combine": combine,
-            "round_bytes": round_bytes, "round_traffic": round_traffic})
+            "round_bytes": round_bytes, "round_traffic": round_traffic,
+            "fault": fault})
 
         if calls:
             for rep in range(ntimes):

@@ -283,6 +283,10 @@ def audit_schedule(schedule, max_edges: int = MAX_ARTIFACT_EDGES) -> dict:
            "data_size": p.data_size, "comm_size": p.comm_size,
            "proc_node": p.proc_node, "agg_type": int(p.placement),
            "direction": p.direction.value}
+    if getattr(schedule, "fault", None):
+        # fault-repaired schedule: the audit covers the DETOURED program
+        # (relay hops included) — the artifact must say so
+        cfg["fault"] = schedule.fault
     base = {"schema": TRAFFIC_SCHEMA, "config": cfg}
 
     if getattr(schedule, "assignment", None) is not None:
@@ -512,9 +516,12 @@ def render_audit(audit: dict, overlay: dict | None = None,
     incast view), totals, barrier signature, conformance verdict, and
     the measured columns when an overlay is given."""
     cfg = audit["config"]
-    lines = [f"traffic audit: m={cfg['method']} \"{cfg['name']}\" "
+    head0 = (f"traffic audit: m={cfg['method']} \"{cfg['name']}\" "
              f"({cfg['direction']}) n={cfg['nprocs']} a={cfg['cb_nodes']} "
-             f"c={cfg['comm_size']} d={cfg['data_size']} B"]
+             f"c={cfg['comm_size']} d={cfg['data_size']} B")
+    if cfg.get("fault"):
+        head0 += f" [fault-repaired: {cfg['fault']}]"
+    lines = [head0]
     ov_rounds = ({r["round"]: r for r in overlay["rounds"]}
                  if overlay else {})
     for r in audit["rounds"]:

@@ -154,19 +154,15 @@ def make_synthetic_sampler(spec: str, *, batch_trials: int = 3,
     spec always yields the same race."""
     import random
 
-    parts = [p.strip() for p in str(spec).split(",") if p.strip()]
-    if not parts:
-        raise RaceError("synthetic spec is empty (expected "
-                        "'BASE_US[,mID*FACTOR]...')")
+    from tpu_aggcomm.faults.spec import FaultSpecError, parse_synthetic
+
+    # the grammar parser lives with the fault grammar (faults/spec.py) so
+    # both injected-skew surfaces share one parser; re-wrap its error in
+    # the tuner's exception type
     try:
-        base_s = float(parts[0]) * 1e-6
-        factors = {}
-        for p in parts[1:]:
-            mid, fac = p.split("*")
-            factors[int(mid.lstrip("m"))] = float(fac)
-    except (ValueError, IndexError):
-        raise RaceError(f"malformed synthetic spec {spec!r} (expected "
-                        f"'BASE_US[,mID*FACTOR]...', e.g. '100,m3*0.5')")
+        base_s, factors = parse_synthetic(spec)
+    except FaultSpecError as e:
+        raise RaceError(str(e)) from None
 
     from tpu_aggcomm.tune.space import parse_cid
 
