@@ -223,6 +223,10 @@ def _measure_inner() -> int:
         "manifest": ledger.manifest(),
         "compile_seconds": ledger.total_compile_seconds(),
         "hbm_peak_bytes": int(hbm_peak) if hbm_peak is not None else None,
+        # resilience records (tpu_aggcomm/resilience/): every retry
+        # attempt with its policy fields, so the backoff timeline
+        # replays jax-free from this artifact alone
+        "resilience": ledger.resilience_records(),
     }))
     print(f"# effective bandwidth: {gbps:.2f} GB/s pattern-bytes "
           f"on {dev.device_kind}; path={'pallas' if on_tpu else 'xla'}; "

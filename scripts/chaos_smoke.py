@@ -1,0 +1,147 @@
+"""Chaos smoke gate (ci_tier1.sh): injected transient faults must be
+survived BY POLICY, and the survival must be auditable from artifacts.
+
+Three checks, CPU-only (the CLAUDE.md recipe — this never touches the
+tunnel), each a subprocess so the gate exercises the real entry points:
+
+1. **Retried-to-success run**: a jax_sim ``--verify`` run whose dispatch
+   site fails its first N attempts with a synthetic transient
+   (``TPU_AGGCOMM_CHAOS="dispatch:N"``) must exit 0 — the seeded retry
+   policy converged and the delivered bytes still matched the oracle
+   byte-exactly.
+2. **Jax-free replay from artifacts alone**: the run's trace
+   (``ledger.resilience`` instants) is replayed in a subprocess where
+   ``import jax`` raises — the attempt timeline must be REPRODUCED from
+   the recorded policy fields (``resilience/policy.replay_attempts``),
+   the tune ``--replay`` discipline applied to retries.
+3. **bench.py contract under chaos**: with the warmup site failing once,
+   bench.py must still print exactly ONE JSON line, carrying the
+   retry's resilience records, and the wrapped artifact must pass
+   ``obs/regress.validate_bench`` (what check_bench_schema.py enforces
+   on committed history).
+
+Exit 0 only when all three hold.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def cpu_env(**extra) -> dict:
+    """The CLAUDE.md CPU recipe: disarm the tunnel, force cpu."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def fail(msg: str) -> int:
+    print(f"chaos-smoke: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="chaos_smoke_")
+    trace_prefix = os.path.join(tmp, "chaos")
+
+    # -- 1: transiently-failing dispatch converges via retry + verify ------
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "-n", "8", "-a", "2",
+         "-d", "256", "-c", "4", "-m", "1", "--backend", "jax_sim",
+         "--verify", "--results-csv", os.path.join(tmp, "results.csv"),
+         "--trace", trace_prefix],
+        cwd=REPO, capture_output=True, text=True,
+        env=cpu_env(TPU_AGGCOMM_CHAOS="dispatch:2",
+                    TPU_AGGCOMM_RETRY_MAX="4",
+                    TPU_AGGCOMM_RETRY_BASE="0.01"))
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-2000:])
+        return fail(f"chaos run did not converge (rc={r.returncode}); "
+                    f"2 injected transients should retry to success")
+
+    # -- 2: jax-free replay of the attempt timeline from the trace ---------
+    poison = os.path.join(tmp, "poison", "jax")
+    os.makedirs(poison)
+    with open(os.path.join(poison, "__init__.py"), "w") as fh:
+        fh.write("raise ImportError('poisoned jax: resilience replay "
+                 "must be jax-free')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.join(tmp, "poison") + os.pathsep + REPO
+    code = (
+        "import json\n"
+        "from tpu_aggcomm.resilience import replay_attempts\n"
+        f"recs = []\n"
+        f"for line in open({trace_prefix + '.trace.jsonl'!r}):\n"
+        "    ev = json.loads(line)\n"
+        "    if ev.get('ev') == 'instant' "
+        "and ev.get('name') == 'ledger.resilience':\n"
+        "        recs.append(ev['args'])\n"
+        "disp = [x for x in recs if x.get('kind') == 'attempt' "
+        "and str(x.get('site', '')).startswith('dispatch:')]\n"
+        "assert len(disp) >= 3, f'want >=3 dispatch attempts, got {disp}'\n"
+        "retried = [x for x in disp if x.get('outcome') == 'retry']\n"
+        "assert len(retried) == 2, retried\n"
+        "assert all(x.get('error_class') == 'transient-tunnel' "
+        "for x in retried), retried\n"
+        "assert any(x.get('outcome') == 'ok' for x in disp), disp\n"
+        "verdict, problems = replay_attempts(recs)\n"
+        "assert verdict == 'REPRODUCED', problems\n"
+        "print('REPLAY', verdict, len(recs), 'records')\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                       capture_output=True, text=True)
+    if r.returncode != 0 or "REPLAY REPRODUCED" not in r.stdout:
+        sys.stderr.write(r.stderr[-2000:])
+        return fail("jax-free attempt replay from the trace artifact "
+                    "did not REPRODUCE")
+
+    # -- 3: bench.py one-JSON-line contract under warmup chaos -------------
+    r = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, capture_output=True,
+        text=True, env=cpu_env(TPU_AGGCOMM_CHAOS="chained.warmup:1",
+                               TPU_AGGCOMM_RETRY_BASE="0.01"))
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-2000:])
+        return fail(f"bench.py under chaos exited rc={r.returncode}")
+    if len(lines) != 1:
+        return fail(f"bench.py printed {len(lines)} stdout lines under "
+                    f"chaos; the contract is exactly ONE JSON line")
+    try:
+        parsed = json.loads(lines[0])
+    except ValueError:
+        return fail("bench.py stdout line is not JSON")
+    res = parsed.get("resilience") or []
+    warm = [x for x in res if x.get("site") == "chained.warmup"
+            and x.get("kind") == "attempt"]
+    if not any(x.get("outcome") == "retry"
+               and x.get("error_class") == "transient-tunnel"
+               for x in warm):
+        return fail(f"bench line carries no retried warmup attempt: {warm}")
+    from tpu_aggcomm.resilience import replay_attempts
+    verdict, problems = replay_attempts(res)
+    if verdict != "REPRODUCED":
+        return fail(f"bench resilience records do not replay: {problems}")
+    from tpu_aggcomm.obs.regress import validate_bench
+    wrapped = {"n": 1, "cmd": "python bench.py", "rc": 0, "tail": "",
+               "parsed": parsed}
+    errors = validate_bench(wrapped, "chaos_smoke")
+    if errors:
+        return fail(f"chaos bench artifact fails schema: {errors[0]}")
+
+    print("chaos-smoke: PASS — retried-to-success with byte-exact verify; "
+          "attempt timeline REPRODUCED jax-free from artifacts; bench.py "
+          "one-JSON-line contract held under chaos")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

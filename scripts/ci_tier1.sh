@@ -55,5 +55,11 @@ for f in TUNE_*.json; do
   [ -e "$f" ] || continue
   python -m tpu_aggcomm.cli tune --replay "$f" || post_rc=1
 done
+# chaos smoke (tpu_aggcomm/resilience/): a jax_sim run whose dispatch
+# fails transiently N times (TPU_AGGCOMM_CHAOS) must converge via the
+# seeded retry policy, pass --verify byte-exact, keep bench.py's
+# one-JSON-line contract, and leave artifacts whose attempt timeline
+# replays REPRODUCED jax-free (scripts/chaos_smoke.py).
+python scripts/chaos_smoke.py || post_rc=1
 if [ "$rc" -eq 0 ]; then rc=$post_rc; fi
 exit $rc

@@ -3,7 +3,11 @@ follow-ups") — run the moment the tunnel returns:
 
     nohup python scripts/tpu_capture_all.py > capture.log 2>&1 &
 
-Then poll capture.log. ONE serial client throughout (concurrent clients
+Then poll capture.log. A killed/OOM'd session resumes with ``--resume``:
+every stage's ok/fail + artifact paths land in ``capture.journal.jsonl``
+(tpu_aggcomm/resilience/journal.py), and --resume skips stages recorded
+done under the CURRENT manifest fingerprint — environment drift re-runs
+them, with the drifted keys named in the log. ONE serial client throughout (concurrent clients
 skew differenced numbers 2-7x); nothing here runs under a kill-prone
 wrapper (a SIGTERM mid-kernel wedges the tunnel — CLAUDE.md). Stages,
 each logged with a PASS/FAIL marker so a partial run is still evidence:
@@ -35,6 +39,15 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: Crash-safe per-stage journal (tpu_aggcomm/resilience/journal.py): an
+#: OOM-killed or wedged capture session resumes with --resume, skipping
+#: every stage already recorded as done under the CURRENT manifest
+#: fingerprint — environment drift (new jax/libtpu) re-runs everything,
+#: with the drifted keys named in the log.
+JOURNAL_PATH = os.path.join(REPO, "capture.journal.jsonl")
+RESUME = "--resume" in sys.argv
 
 
 def stage(name: str, argv: list, env: dict | None = None) -> bool:
@@ -84,43 +97,59 @@ def main() -> int:
               f"not starting any capture stage", flush=True)
         return 1
 
+    from tpu_aggcomm.obs import ledger
+    from tpu_aggcomm.resilience import RunJournal
+    journal = RunJournal(JOURNAL_PATH)
+    man = ledger.manifest()
+    fp = journal.begin_session(man)
+
     results: dict[str, str] = {}
 
-    def record(name: str, ok: bool) -> bool:
+    def run_stage(name: str, argv: list, env: dict | None = None,
+                  artifacts: list | None = None) -> bool:
+        if RESUME:
+            done, reason = journal.completed({"stage": name},
+                                             fingerprint=fp, manifest=man)
+            if done:
+                print(f"resume: stage {name} already done under this "
+                      f"manifest — skipping", flush=True)
+                results[name] = "PASS"
+                return True
+            if reason:
+                print(f"resume: stage {name}: {reason}", flush=True)
+        t0 = time.time()
+        ok = stage(name, argv, env)
         results[name] = "PASS" if ok else "FAIL"
+        # persist ok/fail + artifact paths: only status="done" (PASS)
+        # satisfies a future --resume; failed stages always re-run
+        journal.record({"stage": name}, fingerprint=fp,
+                       status="done" if ok else "fail",
+                       artifacts=artifacts, wall_s=time.time() - t0)
         return ok
 
     # compile-only probe FIRST — no kernel may launch through the tunnel
     # until Mosaic has accepted the kernels on whatever toolchain the
     # recovered tunnel presents
-    if record("mosaic-compile",
-              stage("mosaic-compile",
-                    [sys.executable, "scripts/tpu_pallas_probe.py"])):
-        record("bench", stage("bench", [sys.executable, "bench.py"]))
-        record("mosaic-execute",
-               stage("mosaic-execute",
-                     [sys.executable, "scripts/tpu_pallas_probe.py",
-                      "--execute"]))
+    if run_stage("mosaic-compile",
+                 [sys.executable, "scripts/tpu_pallas_probe.py"]):
+        run_stage("bench", [sys.executable, "bench.py"])
+        run_stage("mosaic-execute",
+                  [sys.executable, "scripts/tpu_pallas_probe.py",
+                   "--execute"])
         env = dict(os.environ)
         env["TPU_AGGCOMM_TEST_TPU"] = "1"
-        record("gated-tests",
-               stage("gated-tests",
-                     [sys.executable, "-m", "pytest", "tests/", "-q"],
-                     env=env))
-        record("followup",
-               stage("followup",
-                     [sys.executable, "scripts/tpu_followup.py"]))
-        record("flagship",
-               stage("flagship",
-                     [sys.executable, "scripts/tpu_flagship.py"]))
+        run_stage("gated-tests",
+                  [sys.executable, "-m", "pytest", "tests/", "-q"],
+                  env=env)
+        run_stage("followup", [sys.executable, "scripts/tpu_followup.py"])
+        run_stage("flagship", [sys.executable, "scripts/tpu_flagship.py"])
         # run ledger over everything the session just wrote (plus the
         # committed history): environment manifests, compile seconds,
         # HBM peaks, and drift between consecutive rounds — jax-free,
         # no kernels, safe even if an earlier stage half-failed
-        record("ledger",
-               stage("ledger",
-                     [sys.executable, "-m", "tpu_aggcomm.cli",
-                      "inspect", "ledger"]))
+        run_stage("ledger",
+                  [sys.executable, "-m", "tpu_aggcomm.cli",
+                   "inspect", "ledger"])
         if os.environ.get("TPU_AGGCOMM_TUNE"):
             # opt-in autotuner stage (TPU_AGGCOMM_TUNE=1): one real
             # tuned cell on the live chip — racing chained differenced
@@ -129,40 +158,39 @@ def main() -> int:
             # this session's manifest fingerprint. Runs AFTER the
             # mosaic/bench stages proved the tunnel healthy; small
             # chain lengths keep each batch's tunnel dwell short.
-            record("tune",
-                   stage("tune",
-                         [sys.executable, "-m", "tpu_aggcomm.cli",
-                          "tune", "-n", "32", "-d", "2048",
-                          "--methods", "1,3", "--cb-nodes", "14",
-                          "--comm-sizes", "8", "--backend", "jax_sim",
-                          "--batch-trials", "3", "--max-batches", "4",
-                          "--iters-small", "50", "--iters-big", "550"]))
+            run_stage("tune",
+                      [sys.executable, "-m", "tpu_aggcomm.cli",
+                       "tune", "-n", "32", "-d", "2048",
+                       "--methods", "1,3", "--cb-nodes", "14",
+                       "--comm-sizes", "8", "--backend", "jax_sim",
+                       "--batch-trials", "3", "--max-batches", "4",
+                       "--iters-small", "50", "--iters-big", "550"])
             # jax-free re-derivation of what was just written — the
             # same check ci_tier1.sh runs over committed artifacts
             tunes = sorted(f for f in os.listdir(REPO)
                            if f.startswith("TUNE_")
                            and f.endswith(".json"))
             for f in tunes:
-                record(f"tune-replay:{f}",
-                       stage(f"tune-replay:{f}",
-                             [sys.executable, "-m", "tpu_aggcomm.cli",
-                              "tune", "--replay", f]))
+                run_stage(f"tune-replay:{f}",
+                          [sys.executable, "-m", "tpu_aggcomm.cli",
+                           "tune", "--replay", f],
+                          artifacts=[f])
         if os.environ.get("TPU_AGGCOMM_TRACE"):
             # opt-in flight-recorder stage (TPU_AGGCOMM_TRACE=1): one
             # traced chained jax_sim run + a traced sweep pass, leaving
             # traces/*.trace.{jsonl,json} artifacts. Default capture
             # behavior is unchanged — this stage simply does not run.
             os.makedirs(os.path.join(REPO, "traces"), exist_ok=True)
-            record("traced-run",
-                   stage("traced-run",
-                         [sys.executable, "-m", "tpu_aggcomm.cli",
-                          "-n", "32", "-a", "14", "-d", "2048", "-c", "8",
-                          "-m", "1", "-k", "4", "--backend", "jax_sim",
-                          "--chained",
-                          "--trace", "traces/capture_n32_m1_c8"]))
-            record("traced-sweeps",
-                   stage("traced-sweeps",
-                         [sys.executable, "scripts/tpu_sweeps.py"]))
+            run_stage("traced-run",
+                      [sys.executable, "-m", "tpu_aggcomm.cli",
+                       "-n", "32", "-a", "14", "-d", "2048", "-c", "8",
+                       "-m", "1", "-k", "4", "--backend", "jax_sim",
+                       "--chained",
+                       "--trace", "traces/capture_n32_m1_c8"],
+                      artifacts=["traces/capture_n32_m1_c8.trace.jsonl",
+                                 "traces/capture_n32_m1_c8.trace.json"])
+            run_stage("traced-sweeps",
+                      [sys.executable, "scripts/tpu_sweeps.py"])
             # jax-free analytics over what the traced stages just wrote:
             # the merged straggler summary plus the self-contained HTML
             # dashboard (obs/metrics.py, obs/report_html.py) — cheap,
@@ -172,17 +200,16 @@ def main() -> int:
                 for f in os.listdir(os.path.join(REPO, "traces"))
                 if f.endswith(".trace.jsonl"))
             if trace_files:
-                record("trace-summary",
-                       stage("trace-summary",
-                             [sys.executable, "-m", "tpu_aggcomm.cli",
-                              "inspect", "trace"] + trace_files))
+                run_stage("trace-summary",
+                          [sys.executable, "-m", "tpu_aggcomm.cli",
+                           "inspect", "trace"] + trace_files)
                 # trace files must precede --out: argparse cannot match a
                 # nargs="*" positional split across an optional
-                record("trace-report",
-                       stage("trace-report",
-                             [sys.executable, "-m", "tpu_aggcomm.cli",
-                              "inspect", "report"] + trace_files
-                             + ["--out", "traces/report.html"]))
+                run_stage("trace-report",
+                          [sys.executable, "-m", "tpu_aggcomm.cli",
+                           "inspect", "report"] + trace_files
+                          + ["--out", "traces/report.html"],
+                          artifacts=["traces/report.html"])
     else:
         # gated tests and the followup batch ALSO launch kernels — the
         # compile-before-any-kernel invariant gates everything

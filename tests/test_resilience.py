@@ -1,0 +1,510 @@
+"""Resilience subsystem (ISSUE 7): error taxonomy, seeded retry with
+deterministic replay, crash-safe journal + sweep --resume, watchdog
+deadlines + round-boundary cancellation, advisory fault detection, and
+atomic artifact writes.
+
+The pins that matter:
+
+- same seed + same error sequence ⟹ same attempt timeline, and
+  ``replay_attempts`` re-derives it jax-free from records alone (the
+  tune --replay discipline applied to retries);
+- the policy/journal/watchdog/detect core imports (and works) where
+  ``import jax`` raises — poisoned-jax subprocess, the obs discipline;
+- a verify-class error is NEVER retried;
+- ``sweep --resume`` skips journal-done cells and re-runs (naming the
+  drifted keys) when the manifest fingerprint changed;
+- a writer SIGKILLed mid-``atomic_write`` leaves the target intact.
+"""
+
+import contextlib
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_aggcomm.obs import ledger
+from tpu_aggcomm.resilience import (RETRYABLE, RetryPolicy, classify_error,
+                                    replay_attempts, retry_call, RunJournal,
+                                    CancelledAtBoundary, check_boundary,
+                                    derive_deadline, safe_cancellation)
+from tpu_aggcomm.resilience import policy as rpolicy
+from tpu_aggcomm.resilience.detect import (propose_fault_specs,
+                                           render_proposals)
+from tpu_aggcomm.resilience.watchdog import (cancellation_pending,
+                                             soft_deadline_check)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(argv):
+    from tpu_aggcomm.cli import main
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(argv)
+    return rc, buf.getvalue()
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    ledger.reset()
+    yield
+    ledger.reset()
+
+
+# ------------------------------------------------------------- taxonomy
+
+class VerificationError(AssertionError):
+    """Name-matched stand-in (classification is by type NAME so the
+    policy core never imports backend modules)."""
+
+
+class DeadlockError(RuntimeError):
+    pass
+
+
+def test_classify_taxonomy():
+    assert classify_error(VerificationError("rank 3 byte 7")) == "verify"
+    # a verify error mentioning tunnel words STAYS verify (precedence)
+    assert classify_error(
+        VerificationError("connection reset in diff")) == "verify"
+    assert classify_error(DeadlockError("cycle")) == "program"
+    assert classify_error(ConnectionResetError()) == "transient-tunnel"
+    assert classify_error(TimeoutError()) == "transient-tunnel"
+    assert classify_error(
+        RuntimeError("UNAVAILABLE: socket closed")) == "transient-tunnel"
+    assert classify_error(
+        RuntimeError("Mosaic lowering failed: bad layout")) == "compile"
+    assert classify_error(ValueError("boom")) == "program"
+    # OSError is deliberately NOT transient: FileNotFoundError must
+    # never be retried as if it were a tunnel blip
+    assert classify_error(FileNotFoundError("gone")) == "program"
+    assert RETRYABLE == {"transient-tunnel"}
+
+
+# ----------------------------------------------------- seeded retry core
+
+def _flaky(n_failures: int, exc=None):
+    state = {"left": n_failures}
+
+    def fn():
+        if state["left"] > 0:
+            state["left"] -= 1
+            raise exc if exc is not None \
+                else ConnectionError("UNAVAILABLE: blip")
+        return "converged"
+    return fn
+
+
+def _run_retry(seed: int, n_failures: int = 2):
+    ledger.reset()
+    sleeps = []
+    pol = RetryPolicy(max_attempts=4, backoff_base_s=0.01, seed=seed)
+    out = retry_call(_flaky(n_failures), site="t", policy=pol,
+                     sleep=sleeps.append)
+    return out, sleeps, ledger.resilience_records()
+
+
+def test_retry_timeline_is_deterministic_from_seed():
+    out_a, sleeps_a, recs_a = _run_retry(seed=0)
+    out_b, sleeps_b, recs_b = _run_retry(seed=0)
+    assert out_a == out_b == "converged"
+    assert sleeps_a == sleeps_b               # exact same backoffs slept
+    assert recs_a == recs_b                   # exact same attempt records
+    assert [r["outcome"] for r in recs_a] == ["retry", "retry", "ok"]
+    assert all(r["error_class"] == "transient-tunnel"
+               for r in recs_a if r["outcome"] == "retry")
+    # recorded backoffs are the slept backoffs, verbatim
+    assert [r["backoff_s"] for r in recs_a
+            if r["outcome"] == "retry"] == sleeps_a
+    # a different seed jitters differently
+    _, sleeps_c, _ = _run_retry(seed=1)
+    assert sleeps_c != sleeps_a
+
+
+def test_non_retryable_raises_immediately():
+    sleeps = []
+    with pytest.raises(ValueError):
+        retry_call(_flaky(1, ValueError("bad arg")), site="t",
+                   policy=RetryPolicy(max_attempts=5, seed=0),
+                   sleep=sleeps.append)
+    assert sleeps == []                       # no backoff, no retry
+    recs = ledger.resilience_records()
+    assert len(recs) == 1 and recs[0]["outcome"] == "raise"
+    assert recs[0]["error_class"] == "program"
+
+
+def test_verify_error_never_retried():
+    with pytest.raises(VerificationError):
+        retry_call(_flaky(1, VerificationError("wrong bytes")), site="v",
+                   policy=RetryPolicy(max_attempts=5, seed=0),
+                   sleep=lambda s: None)
+    assert ledger.resilience_records()[0]["error_class"] == "verify"
+
+
+def test_retry_exhaustion_reraises_original():
+    with pytest.raises(ConnectionError):
+        retry_call(_flaky(99), site="t",
+                   policy=RetryPolicy(max_attempts=2, backoff_base_s=0.001,
+                                      seed=0),
+                   sleep=lambda s: None)
+    recs = ledger.resilience_records()
+    assert [r["outcome"] for r in recs] == ["retry", "raise"]
+
+
+def test_replay_attempts_reproduced_then_mismatch_on_tamper():
+    _, _, recs = _run_retry(seed=7)
+    verdict, problems = replay_attempts(recs)
+    assert verdict == "REPRODUCED" and problems == []
+    tampered = [dict(r) for r in recs]
+    for r in tampered:
+        if r["outcome"] == "retry":
+            r["backoff_s"] = r["backoff_s"] + 1e-3
+    verdict, problems = replay_attempts(tampered)
+    assert verdict == "MISMATCH"
+    assert any("seeded schedule says" in p for p in problems)
+
+
+def test_chaos_injection_consumes_budget(monkeypatch):
+    monkeypatch.setenv("TPU_AGGCOMM_CHAOS", "unit.site:2")
+    rpolicy._reset_chaos()
+    ledger.reset()
+    out = retry_call(lambda: "ok", site="unit.site:x",
+                     policy=RetryPolicy(max_attempts=4,
+                                        backoff_base_s=0.001, seed=0),
+                     sleep=lambda s: None)
+    assert out == "ok"
+    recs = ledger.resilience_records()
+    assert [r["outcome"] for r in recs] == ["retry", "retry", "ok"]
+    assert replay_attempts(recs)[0] == "REPRODUCED"
+    # budget spent: the next call at the same site passes untouched
+    ledger.reset()
+    retry_call(lambda: "ok", site="unit.site:x", sleep=lambda s: None)
+    assert [r["outcome"] for r in ledger.resilience_records()] == ["ok"]
+    monkeypatch.delenv("TPU_AGGCOMM_CHAOS")
+    rpolicy._reset_chaos()
+
+
+# ------------------------------------------------------------- journal
+
+def test_journal_completed_drift_and_torn_tail(tmp_path):
+    man_a = {"schema": 3, "versions": {"jax": "0.4.1"}, "python": "3.11"}
+    man_b = {"schema": 3, "versions": {"jax": "0.9.9"}, "python": "3.11"}
+    j = RunJournal(str(tmp_path / "j.jsonl"))
+    fp_a = j.begin_session(man_a)
+    key = {"stage": "bench"}
+    j.record(key, fingerprint=fp_a, status="done",
+             shape_keys=["('a2m', 1)"], artifacts=["BENCH.json"])
+    assert j.completed(key, fingerprint=fp_a, manifest=man_a) == (True, None)
+    assert j.seen(key)
+    assert not j.seen({"stage": "other"})
+    # a failed entry never satisfies resume
+    j.record({"stage": "flaky"}, fingerprint=fp_a, status="fail")
+    done, reason = j.completed({"stage": "flaky"}, fingerprint=fp_a,
+                               manifest=man_a)
+    assert done is False and reason is None
+    # drift: same key, new environment — the drifted key is NAMED
+    fp_b = j.begin_session(man_b)
+    assert fp_b != fp_a
+    done, reason = j.completed(key, fingerprint=fp_b, manifest=man_b)
+    assert done is False
+    assert "versions.jax" in reason and "re-running" in reason
+    # torn final line (killed mid-append): reader skips it
+    with open(j.path, "a") as fh:
+        fh.write('{"key": {"stage": "torn"')
+    assert j.completed(key, fingerprint=fp_a, manifest=man_a) == (True, None)
+
+
+# --------------------------------------------- watchdog + cancellation
+
+def test_derive_deadline_floors_and_walls():
+    assert derive_deadline() == 30.0                       # absolute floor
+    d = derive_deadline(floor_s=0.01, ntimes=100, rpc_probe_s=0.08)
+    assert d == pytest.approx(max(30.0, 50.0 * 0.01 * 100 + 0.8))
+    # a slow prior wall dominates everything
+    assert derive_deadline(floor_s=0.01, prior_walls=[2.0, 40.0]) == 200.0
+
+
+def test_soft_deadline_check_records_but_never_raises():
+    out = io.StringIO()
+    assert soft_deadline_check("dispatch:m1:i0", wall_s=5.0,
+                               deadline_s=10.0, out=out) is False
+    assert out.getvalue() == ""
+    assert soft_deadline_check("dispatch:m1:i0", wall_s=50.0,
+                               deadline_s=10.0, out=out) is True
+    assert "advisory only" in out.getvalue()
+    recs = ledger.resilience_records()
+    assert recs and recs[-1]["kind"] == "deadline"
+
+
+def test_safe_cancellation_defers_sigint_to_boundary():
+    assert cancellation_pending() is None     # inert outside the scope
+    with safe_cancellation():
+        check_boundary("m1:i0")               # nothing pending: no-op
+        os.kill(os.getpid(), signal.SIGINT)
+        for _ in range(10_000):               # let the signal deliver
+            if cancellation_pending():
+                break
+            time.sleep(0.001)
+        assert cancellation_pending() == "SIGINT"
+        with pytest.raises(CancelledAtBoundary, match="--resume"):
+            check_boundary("m1:i1")
+        assert cancellation_pending() is None  # honored exactly once
+    assert cancellation_pending() is None
+    recs = ledger.resilience_records()
+    assert any(r["kind"] == "cancel" and r["signal"] == "SIGINT"
+               for r in recs)
+
+
+# -------------------------------------------------------- fault detect
+
+def _synthetic_events(slow_rank=None, factor=4.0, ranks=4, rounds=4):
+    events = [{"ev": "run", "id": 0, "method": 1, "name": "All to many"}]
+    for rnd in range(rounds):
+        for rank in range(ranks):
+            dur = 0.004 if rank == slow_rank else 0.001
+            events.append({"ev": "span", "run": 0, "rep": 0, "rank": rank,
+                           "round": rnd, "bucket": "send_wait_all",
+                           "dur_s": dur})
+    return events
+
+
+def test_detect_proposes_slow_rank_spec():
+    props = propose_fault_specs(_synthetic_events(slow_rank=3))
+    assert len(props) == 1
+    p = props[0]
+    assert p["rank"] == 3 and p["crit_rounds"] == 4 and p["rounds"] == 4
+    assert p["spec"].startswith("slow:r3*")
+    # the proposal round-trips through the PR 6 parser by construction
+    from tpu_aggcomm.faults import parse_fault
+    assert parse_fault(p["spec"]).canonical() == p["spec"]
+    text = render_proposals(props)
+    assert "rank 3" in text and "--fault" in text and p["spec"] in text
+
+
+def test_detect_stays_silent_on_healthy_and_thin_traces():
+    assert propose_fault_specs(_synthetic_events(slow_rank=None)) == []
+    # below MIN_FACTOR: scheduling jitter, not a degraded rank
+    events = _synthetic_events(slow_rank=2)
+    for e in events:
+        if e.get("rank") == 2:
+            e["dur_s"] = 0.0012
+    assert propose_fault_specs(events) == []
+    # single-rank rounds carry no skew information
+    assert propose_fault_specs(_synthetic_events(ranks=1)) == []
+    # two rounds cannot show persistence (MIN_ROUNDS), and critical in
+    # exactly half the rounds is a coin flip, not a strict majority
+    assert propose_fault_specs(
+        _synthetic_events(slow_rank=3, rounds=2)) == []
+    events = _synthetic_events(slow_rank=0, rounds=4)
+    for e in events:
+        if e.get("ev") == "span" and e["round"] >= 2:
+            e["dur_s"] = 0.004 if e["rank"] == 1 else 0.001
+    assert propose_fault_specs(events) == []  # 2/4 each: no majority
+    assert render_proposals([]) == ""
+    # the COMMITTED healthy trace must stay silent — this exact artifact
+    # once tripped the detector on 1-of-2-rounds host jitter
+    healthy = os.path.join(REPO, "FAULT_healthy.trace.jsonl")
+    if os.path.exists(healthy):
+        from tpu_aggcomm.obs.trace import load_events
+        assert propose_fault_specs(load_events(healthy)) == []
+
+
+# ------------------------------------------------- ledger + bench schema
+
+def test_ledger_render_and_load(tmp_path):
+    ledger.record_resilience("dispatch:m1:i0", kind="attempt", attempt=1,
+                             outcome="retry", error_class="transient-tunnel",
+                             error="ConnectionError: blip", backoff_s=0.01,
+                             max_attempts=3, backoff_base_s=0.01,
+                             backoff_mult=2.0, jitter_frac=0.25, seed=0)
+    ledger.record_resilience("dispatch:m1:i0", kind="attempt", attempt=2,
+                             outcome="ok", max_attempts=3,
+                             backoff_base_s=0.01, backoff_mult=2.0,
+                             jitter_frac=0.25, seed=0)
+    ledger.record_resilience("xprof", kind="suppressed",
+                             error_class="program", error="boom")
+    text = ledger.render_resilience(ledger.resilience_records())
+    assert "dispatch:m1:i0" in text and "converged" in text
+    assert "suppressed" in text
+    # a BENCH-style artifact round-trips its resilience list
+    blob = {"n": 9, "cmd": "python bench.py", "rc": 0, "tail": "",
+            "parsed": {"metric": "m", "value": 1.0, "unit": "s",
+                       "resilience": ledger.resilience_records()}}
+    p = tmp_path / "BENCH_r09.json"
+    p.write_text(json.dumps(blob))
+    loaded = ledger.load_ledger(str(p))
+    assert len(loaded["resilience"]) == 3
+    ledger.reset()
+    assert ledger.resilience_records() == []
+
+
+def test_validate_bench_types_resilience():
+    from tpu_aggcomm.obs.regress import validate_bench
+    good = {"n": 1, "cmd": "c", "rc": 0, "tail": "",
+            "parsed": {"metric": "m", "value": 1.0, "unit": "s",
+                       "resilience": [{"site": "t", "kind": "attempt"}]}}
+    assert validate_bench(good) == []
+    bad = json.loads(json.dumps(good))
+    bad["parsed"]["resilience"] = ["not-a-dict"]
+    assert any("resilience" in e for e in validate_bench(bad))
+    bad["parsed"]["resilience"] = [{"kind": "attempt"}]   # site missing
+    assert any("resilience" in e for e in validate_bench(bad))
+
+
+# ------------------------------------------------------- jax-free pins
+
+def _poisoned_env(tmp_path):
+    poison = tmp_path / "jax"
+    poison.mkdir()
+    (poison / "__init__.py").write_text(
+        "raise ImportError('poisoned jax: resilience core must not "
+        "import jax')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
+    return env
+
+
+def test_resilience_core_survives_poisoned_jax(tmp_path):
+    """policy + journal + watchdog + detect, end to end, where ``import
+    jax`` raises — the resume/replay paths run on hosts where a dead
+    tunnel hangs any jax init."""
+    code = (
+        "from tpu_aggcomm.resilience import (RetryPolicy, classify_error,"
+        " replay_attempts, retry_call, RunJournal, derive_deadline,"
+        " propose_fault_specs)\n"
+        "from tpu_aggcomm.obs import ledger\n"
+        "assert classify_error(ConnectionError('x')) == 'transient-tunnel'\n"
+        "pol = RetryPolicy(max_attempts=3, backoff_base_s=0.001, seed=5)\n"
+        "state = {'left': 1}\n"
+        "def fn():\n"
+        "    if state['left']:\n"
+        "        state['left'] -= 1\n"
+        "        raise TimeoutError('tunnel')\n"
+        "    return 1\n"
+        "assert retry_call(fn, site='s', policy=pol,"
+        " sleep=lambda s: None) == 1\n"
+        "v, p = replay_attempts(ledger.resilience_records())\n"
+        "assert v == 'REPRODUCED', p\n"
+        "j = RunJournal('j.jsonl')\n"
+        "fp = j.begin_session({'versions': {'jax': 'none'}})\n"
+        "j.record({'cell': 1}, fingerprint=fp)\n"
+        "assert j.completed({'cell': 1}, fingerprint=fp)[0]\n"
+        "assert derive_deadline(floor_s=0.001) >= 30.0\n"
+        "assert propose_fault_specs([]) == []\n"
+        "print('JAXFREE OK')\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=str(tmp_path),
+                       env=_poisoned_env(tmp_path), capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "JAXFREE OK" in r.stdout
+
+
+# ------------------------------------------------------- atomic writes
+
+def test_atomic_write_survives_sigkill_mid_write(tmp_path):
+    target = tmp_path / "artifact.json"
+    target.write_text('{"round": "prior", "intact": true}\n')
+    code = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from tpu_aggcomm.obs.atomic import atomic_write\n"
+        f"with atomic_write({str(target)!r}) as fh:\n"
+        "    fh.write('{\"torn\": ')\n"
+        "    fh.flush()\n"
+        "    print('WRITING', flush=True)\n"
+        "    time.sleep(60)\n"
+        "    fh.write('true}')\n")
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "WRITING"
+        proc.kill()                           # SIGKILL: no cleanup runs
+    finally:
+        proc.wait(timeout=30)
+    # the target is byte-identical to the prior round's artifact
+    assert json.loads(target.read_text()) == {"round": "prior",
+                                              "intact": True}
+
+
+def test_atomic_write_lands_complete_content(tmp_path):
+    from tpu_aggcomm.obs import atomic_write
+    target = tmp_path / "out.json"
+    with atomic_write(str(target)) as fh:
+        json.dump({"ok": 1}, fh)
+    assert json.loads(target.read_text()) == {"ok": 1}
+    # no temp litter after a clean write
+    assert os.listdir(tmp_path) == ["out.json"]
+    # an exception inside the block leaves no target and no litter
+    with pytest.raises(RuntimeError):
+        with atomic_write(str(tmp_path / "never.json")) as fh:
+            fh.write("partial")
+            raise RuntimeError("writer died")
+    assert os.listdir(tmp_path) == ["out.json"]
+
+
+# ------------------------------------------------------ sweep --resume
+
+def test_sweep_resume_journal_skips_then_drift_reruns(tmp_path):
+    csv = tmp_path / "results.csv"
+    base = ["sweep", "-n", "8", "-m", "1", "-a", "2", "-d", "32", "-i", "1",
+            "--backend", "local", "--results-csv", str(csv),
+            "--comm-sizes", "2,4"]
+    rc, out = run_cli(base)
+    assert rc == 0
+    jpath = str(csv) + ".journal.jsonl"
+    assert os.path.exists(jpath)
+    entries = [json.loads(ln) for ln in open(jpath)]
+    cells = [e for e in entries if "key" in e]
+    assert len(cells) == 2
+    assert all(e["status"] == "done" and e["shape_keys"] for e in cells)
+    # resume under the same manifest: every cell skipped. (reset the
+    # process-global ledger between calls: each real sweep is its own
+    # process with a fresh manifest — without this, device facts
+    # recorded mid-first-run would read as in-process "drift")
+    ledger.reset()
+    rc, out = run_cli(base + ["--resume"])
+    assert rc == 0
+    assert "skipping already-recorded comm sizes [2, 4]" in out
+    assert "RUN_OPTS" not in out
+    # tamper the journal into a drifted environment: the resume must
+    # re-run and NAME the drifted manifest key
+    from tpu_aggcomm.tune.cache import manifest_fingerprint
+    tampered = []
+    stale_man = None
+    for e in entries:
+        if e.get("journal"):
+            stale_man = dict(e["manifest"])
+            stale_man["python"] = "0.0.0-tampered"
+            e = dict(e, manifest=stale_man,
+                     fingerprint=manifest_fingerprint(stale_man))
+        else:
+            e = dict(e, fingerprint=manifest_fingerprint(stale_man))
+        tampered.append(e)
+    with open(jpath, "w") as fh:
+        for e in tampered:
+            fh.write(json.dumps(e) + "\n")
+    ledger.reset()
+    rc, out = run_cli(base + ["--resume"])
+    assert rc == 0
+    assert "manifest drift" in out and "python" in out
+    assert out.count("RUN_OPTS:") == 2        # both cells re-ran
+    # ... and having re-run under THIS manifest, resume skips again
+    ledger.reset()
+    rc, out = run_cli(base + ["--resume"])
+    assert "skipping already-recorded comm sizes [2, 4]" in out
+
+
+def test_run_records_carry_shape_key():
+    from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+    cfg = ExperimentConfig(nprocs=8, cb_nodes=2, data_size=32, comm_size=4,
+                           method=1, backend="local", verify=True,
+                           results_csv=None)
+    recs = run_experiment(cfg, out=io.StringIO())
+    assert recs and all("shape_key" in r for r in recs)
+    assert "method_id=1" in recs[0]["shape_key"] \
+        or "1" in recs[0]["shape_key"]

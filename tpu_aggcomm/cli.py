@@ -521,6 +521,11 @@ def _sweep_sidecar(csv_path: str) -> str:
     return csv_path + ".sweep.jsonl"
 
 
+def _sweep_journal(csv_path: str) -> str:
+    """The resilience run journal riding next to the legacy sidecar."""
+    return csv_path + ".journal.jsonl"
+
+
 def _sweep_key(nprocs, cb_nodes, data_size, method, iters, ntimes, agg_type,
                proc_node, backend, chained, measured_phases=False,
                fault=None) -> dict:
@@ -692,50 +697,102 @@ def _run_sweep(args) -> int:
                         f"{MAX_MEASURED_ROUNDS}); trim --comm-sizes or "
                         f"use --chained for the deep cells")
     import json
+    import sys
+    import time
 
     from tpu_aggcomm.faults import FaultSpecError, RepairError
-    with _tracing(getattr(args, "trace", None)):
-        for fs in faults:
-            cells = grid
-            if args.resume:
-                done = _completed_throttles(
-                    args.results_csv, nprocs, args.cb_nodes,
-                    args.data_size, args.method, args.iters, args.ntimes,
-                    args.agg_type, args.proc_node, args.backend,
-                    args.chained, args.measured_phases, fs)
-                skipped = [c for c in cells if c in done]
-                cells = [c for c in cells if c not in done]
-                if skipped:
-                    tag = f" [fault {fs}]" if fs else ""
-                    print(f"resume: skipping already-recorded comm sizes "
-                          f"{skipped}{tag}")
-            for c in cells:
-                ftag = f" --fault {fs}" if fs else ""
-                print(f"RUN_OPTS: -a {args.cb_nodes} -d {args.data_size} "
-                      f"-c {c} -m {args.method} -i {args.iters}{ftag}")
-                cfg = ExperimentConfig(
-                    nprocs=nprocs, cb_nodes=args.cb_nodes,
-                    method=args.method, data_size=args.data_size,
-                    comm_size=c, iters=args.iters, ntimes=args.ntimes,
-                    proc_node=args.proc_node, agg_type=args.agg_type,
-                    backend=args.backend, verify=args.verify,
-                    results_csv=args.results_csv, chained=args.chained,
-                    measured_phases=args.measured_phases, fault=fs)
-                try:
-                    run_experiment(cfg)
-                except (FaultSpecError, RepairError) as e:
-                    raise SystemExit(f"sweep --fault: {e}")
-                if args.results_csv:
-                    # checkpoint: record the completed throttle with its
-                    # FULL config
-                    rec = _sweep_key(nprocs, args.cb_nodes, args.data_size,
-                                     args.method, args.iters, args.ntimes,
-                                     args.agg_type, args.proc_node,
-                                     args.backend, args.chained,
-                                     args.measured_phases, fs)
-                    rec["comm"] = c
-                    with open(_sweep_sidecar(args.results_csv), "a") as f:
-                        f.write(json.dumps(rec) + "\n")
+    from tpu_aggcomm.obs import ledger
+    from tpu_aggcomm.resilience import (CancelledAtBoundary, RunJournal,
+                                        safe_cancellation)
+
+    def cell_key(fs, c) -> dict:
+        key = _sweep_key(nprocs, args.cb_nodes, args.data_size,
+                         args.method, args.iters, args.ntimes,
+                         args.agg_type, args.proc_node, args.backend,
+                         args.chained, args.measured_phases, fs)
+        key["comm"] = c
+        return key
+
+    # crash-safe run journal (resilience/journal.py) next to the legacy
+    # sweep sidecar: entries carry the manifest fingerprint, so --resume
+    # re-runs (and NAMES the drift) after an environment change — the
+    # tune-cache semantics applied to sweep cells
+    journal = fp = man = None
+    if args.results_csv:
+        journal = RunJournal(_sweep_journal(args.results_csv))
+        man = ledger.manifest()
+        fp = journal.begin_session(man)
+    try:
+        with _tracing(getattr(args, "trace", None)), safe_cancellation():
+            for fs in faults:
+                cells = grid
+                if args.resume:
+                    done = _completed_throttles(
+                        args.results_csv, nprocs, args.cb_nodes,
+                        args.data_size, args.method, args.iters,
+                        args.ntimes, args.agg_type, args.proc_node,
+                        args.backend, args.chained, args.measured_phases,
+                        fs)
+                    skipped, todo, drift_msgs = [], [], []
+                    for c in cells:
+                        # the journal is authoritative for cells it has
+                        # seen (fingerprint-checked); legacy sidecar/CSV
+                        # completion covers pre-journal sweeps unchanged
+                        if journal is not None \
+                                and journal.seen(cell_key(fs, c)):
+                            ok, reason = journal.completed(
+                                cell_key(fs, c), fingerprint=fp,
+                                manifest=man)
+                            (skipped if ok else todo).append(c)
+                            if reason:
+                                drift_msgs.append(
+                                    f"resume: comm size {c}: {reason}")
+                        elif c in done:
+                            skipped.append(c)
+                        else:
+                            todo.append(c)
+                    cells = todo
+                    if skipped:
+                        tag = f" [fault {fs}]" if fs else ""
+                        print(f"resume: skipping already-recorded comm "
+                              f"sizes {skipped}{tag}")
+                    for msg in drift_msgs:
+                        print(msg)
+                for c in cells:
+                    ftag = f" --fault {fs}" if fs else ""
+                    print(f"RUN_OPTS: -a {args.cb_nodes} "
+                          f"-d {args.data_size} -c {c} -m {args.method} "
+                          f"-i {args.iters}{ftag}")
+                    cfg = ExperimentConfig(
+                        nprocs=nprocs, cb_nodes=args.cb_nodes,
+                        method=args.method, data_size=args.data_size,
+                        comm_size=c, iters=args.iters, ntimes=args.ntimes,
+                        proc_node=args.proc_node, agg_type=args.agg_type,
+                        backend=args.backend, verify=args.verify,
+                        results_csv=args.results_csv, chained=args.chained,
+                        measured_phases=args.measured_phases, fault=fs)
+                    t_cell = time.perf_counter()
+                    try:
+                        records = run_experiment(cfg)
+                    except (FaultSpecError, RepairError) as e:
+                        raise SystemExit(f"sweep --fault: {e}")
+                    if args.results_csv:
+                        # checkpoint: record the completed throttle with
+                        # its FULL config
+                        rec = cell_key(fs, c)
+                        with open(_sweep_sidecar(args.results_csv),
+                                  "a") as f:
+                            f.write(json.dumps(rec) + "\n")
+                        journal.record(
+                            cell_key(fs, c), fingerprint=fp,
+                            status="done",
+                            shape_keys=sorted({r["shape_key"]
+                                               for r in records}),
+                            artifacts=[args.results_csv],
+                            wall_s=time.perf_counter() - t_cell)
+    except CancelledAtBoundary as e:
+        print(f"sweep: {e}", file=sys.stderr)
+        return 130
     return 0
 
 
@@ -997,10 +1054,19 @@ def _run_inspect(args) -> int:
             raise SystemExit("inspect trace: missing trace file(s) "
                              "(*.trace.jsonl written by --trace)")
         from tpu_aggcomm.obs.metrics import summarize_traces
+        from tpu_aggcomm.obs.trace import load_events
+        from tpu_aggcomm.resilience import propose_fault_specs
+        from tpu_aggcomm.resilience.detect import render_proposals
         # a missing/corrupt/truncated artifact must exit with one line
         # on stderr, not a traceback (json decode errors are ValueError)
         try:
             print(summarize_traces(args.trace_file), end="")
+            # advisory fault detection (resilience/detect.py): the same
+            # round_stats, matched against the PR 6 slow-rank signature;
+            # an extra output line only — never a behavior change
+            for path in args.trace_file:
+                print(render_proposals(
+                    propose_fault_specs(load_events(path))), end="")
         except (OSError, ValueError, KeyError) as e:
             raise SystemExit(f"inspect trace: unreadable trace file: {e}")
         return 0

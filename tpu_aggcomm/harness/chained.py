@@ -97,27 +97,49 @@ def differenced_trials(chain_factory, send0, *, iters_small: int,
     # Never lower().compile() here: the AOT path does not share the jit
     # dispatch cache, so it would compile the chain a SECOND time through
     # the tunnel just to time the first.
+    from tpu_aggcomm.obs import ledger
+    from tpu_aggcomm.resilience import classify_error, retry_call
     lower_s = cost = None
     if hasattr(f_big, "lower"):
+        # telemetry is best-effort, but a swallowed failure must still be
+        # classified and land in the ledger as a suppressed record — a
+        # compile-class error here foreshadows the warmup failing too
         try:
             t0 = time.perf_counter()
             lowered = f_big.lower(send0)
             lower_s = time.perf_counter() - t0
             try:
                 cost = _slim_cost(lowered.cost_analysis())
-            except Exception:
+            except Exception as e:
                 cost = None
-        except Exception:
+                rec = ledger.record_resilience(
+                    "chained.cost_analysis", kind="suppressed",
+                    error_class=classify_error(e),
+                    error=f"{type(e).__name__}: {e}"[:500])
+                trace.instant("ledger.resilience", **rec)
+        except Exception as e:
             lower_s = None
-    with trace.span("chained.warmup", iters_small=iters_small,
-                    iters_big=iters_big):
-        t0 = time.perf_counter()
-        int(jax.device_get(checksum(f_small(send0))))    # compile + warm
-        warm_small = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        int(jax.device_get(checksum(f_big(send0))))
-        warm_big = time.perf_counter() - t0
-    from tpu_aggcomm.obs import ledger
+            rec = ledger.record_resilience(
+                "chained.lower", kind="suppressed",
+                error_class=classify_error(e),
+                error=f"{type(e).__name__}: {e}"[:500])
+            trace.instant("ledger.resilience", **rec)
+
+    def warmup() -> tuple[float, float]:
+        with trace.span("chained.warmup", iters_small=iters_small,
+                        iters_big=iters_big):
+            t0 = time.perf_counter()
+            int(jax.device_get(checksum(f_small(send0))))  # compile + warm
+            w_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            int(jax.device_get(checksum(f_big(send0))))
+            w_b = time.perf_counter() - t0
+        return w_s, w_b
+
+    # the warmup is the FIRST dispatch through the tunnel, so a flaky
+    # link surfaces here; transients get bounded seeded retries (a retry
+    # recompiles nothing — the jit cache survives the failed dispatch)
+    warm_small, warm_big = retry_call(warmup, site="chained.warmup")
     rec = ledger.record_compile(
         f"chain(iters={iters_small}/{iters_big})",
         seconds=warm_small + warm_big, kind="compile+warmup",

@@ -5,6 +5,7 @@ console/CSV reporting, optional verification."""
 from __future__ import annotations
 
 import os
+import statistics
 import sys
 import time
 from dataclasses import dataclass
@@ -12,11 +13,16 @@ from dataclasses import dataclass
 from tpu_aggcomm.backends import get_backend
 from tpu_aggcomm.core.methods import METHODS, compile_method, method_ids
 from tpu_aggcomm.core.pattern import AggregatorPattern
+from tpu_aggcomm.core.schedule import schedule_shape_key
 from tpu_aggcomm.harness.attribution import cell_recording
 from tpu_aggcomm.harness.report import (append_provenance, config_banner,
                                         save_all_timing, summarize_results)
 from tpu_aggcomm.harness.timer import max_reduce
 from tpu_aggcomm.obs import ledger, trace
+from tpu_aggcomm.resilience import (check_boundary, classify_error,
+                                    derive_deadline, retry_call)
+from tpu_aggcomm.resilience.watchdog import (schedule_floor_s,
+                                             soft_deadline_check)
 
 __all__ = ["ExperimentConfig", "run_experiment"]
 
@@ -192,9 +198,18 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
                 f"more than {MAX_MEASURED_ROUNDS} throttle rounds (one "
                 f"prefix chain is compiled per round); use "
                 f"--profile-rounds for very deep schedules")
+    _preflight_probe(cfg.backend)
+    # watchdog inputs: roofline floors (once per method — the schedule
+    # does not change across iters) and observed walls per method
+    floors: dict[int, float | None] = {}
+    prior_walls: dict[int, list[float]] = {}
+    rpc_probe = ledger.manifest().get("rpc_probe_s")
     records = []
     for i in range(cfg.iters):
         for m in methods:
+            # a deferred SIGINT/SIGTERM (resilience/watchdog) lands HERE,
+            # between dispatches — never mid-kernel
+            check_boundary(f"m{m}:i{i}")
             spec = METHODS[m]
             sched = compiled[m]
             kwargs = {}
@@ -206,22 +221,39 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             if cfg.measured_phases:
                 kwargs["measured_phases"] = True
             rec = trace.current()
+            if m not in floors:
+                floors[m] = schedule_floor_s(sched, cfg.backend)
+            deadline = derive_deadline(
+                floor_s=floors[m], ntimes=cfg.ntimes,
+                rpc_probe_s=rpc_probe,
+                prior_walls=prior_walls.get(m, ()))
             t_dispatch = time.perf_counter()
-            if rec is not None:
-                # flight recorder: capture the attribution cell stream of
-                # this backend.run (delegations included) plus a measured
-                # host span around the whole dispatch
-                with cell_recording() as calls, \
-                        rec.span("backend.run", method=m,
-                                 method_name=spec.name, iter=i,
-                                 backend=cfg.backend):
-                    recv, timers = backend.run(sched, ntimes=cfg.ntimes,
-                                               iter_=i, verify=cfg.verify,
-                                               **kwargs)
-            else:
-                recv, timers = backend.run(sched, ntimes=cfg.ntimes,
-                                           iter_=i, verify=cfg.verify,
-                                           **kwargs)
+
+            def dispatch():
+                # one ATTEMPT = the whole backend.run with a fresh cell
+                # sink and its own span — a failed attempt's partial cell
+                # stream must not pollute the accepted attribution
+                if rec is not None:
+                    with cell_recording() as c, \
+                            rec.span("backend.run", method=m,
+                                     method_name=spec.name, iter=i,
+                                     backend=cfg.backend):
+                        rv, tm = backend.run(sched, ntimes=cfg.ntimes,
+                                             iter_=i, verify=cfg.verify,
+                                             **kwargs)
+                    return rv, tm, c
+                rv, tm = backend.run(sched, ntimes=cfg.ntimes, iter_=i,
+                                     verify=cfg.verify, **kwargs)
+                return rv, tm, None
+
+            # transient tunnel errors get bounded seeded retries; verify/
+            # program/compile-class errors raise on the first attempt
+            recv, timers, calls = retry_call(dispatch,
+                                             site=f"dispatch:m{m}:i{i}")
+            wall = time.perf_counter() - t_dispatch
+            soft_deadline_check(f"dispatch:m{m}:i{i}", wall_s=wall,
+                                deadline_s=deadline, out=out)
+            prior_walls.setdefault(m, []).append(wall)
             if i == 0:
                 # first dispatch of this method = XLA compile (for the
                 # compiled backends) + the run itself; an honest wall
@@ -263,12 +295,50 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
                 "iter": i, "method": m, "name": spec.name,
                 "timer0": timers[0], "max_timer": max_timer,
                 "backend_executed": executed, "phase_source": phases,
+                # the journal identity of what actually ran (fault variant
+                # included) — sweep --resume records these per cell
+                "shape_key": str(schedule_shape_key(sched)),
             })
             if cfg.xprof and i == 0:
                 _xprof_crosscheck(backend, sched, cfg, m, spec.name,
                                   max_timer, out=out)
         print("| --------------------------------------", file=out)
     return records
+
+
+def _preflight_probe(backend_name: str) -> None:
+    """Pre-flight tunnel health check (resilience/watchdog, ISSUE 7):
+    one trivial jitted dispatch retried under the transient policy, then
+    the median of 3 timed round trips lands as the manifest's
+    ``rpc_probe_s`` (the same field bench.py's measure child records) —
+    so a dead tunnel fails HERE, classified, before any schedule
+    dispatch compiles through it.
+
+    Same jax discipline as :func:`_sample_device`: only runs when a
+    backend already imported jax — local/native oracle runs stay
+    jax-free and probe nothing."""
+    if backend_name in ("local", "native"):
+        return
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+
+    def probe() -> float:
+        import jax.numpy as jnp
+        f = jax.jit(lambda x: x + jnp.uint32(1))
+        int(jax.device_get(f(jnp.uint32(0))))   # compile + warm
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            int(jax.device_get(f(jnp.uint32(1))))
+            samples.append(time.perf_counter() - t0)
+        return statistics.median(samples)
+
+    rpc = retry_call(probe, site="preflight.rpc_probe")
+    ledger.record_device(rpc_probe_s=rpc)
+    rec = ledger.record_resilience("preflight.rpc_probe",
+                                   kind="preflight", rpc_probe_s=rpc)
+    trace.instant("ledger.resilience", **rec)
 
 
 def _sample_device(rec) -> None:
@@ -289,7 +359,14 @@ def _sample_device(rec) -> None:
         ledger.record_device(platform=d.platform,
                              device_kind=getattr(d, "device_kind", None))
         stats = getattr(d, "memory_stats", lambda: None)() or {}
-    except Exception:
+    except Exception as e:
+        # telemetry stays best-effort, but the swallow is classified and
+        # visible in the ledger instead of silent (ISSUE 7)
+        srec = ledger.record_resilience(
+            "runner.sample_device", kind="suppressed",
+            error_class=classify_error(e),
+            error=f"{type(e).__name__}: {e}"[:500])
+        trace.instant("ledger.resilience", **srec)
         return
     peak = stats.get("peak_bytes_in_use")
     ledger.record_hbm_peak(peak)
@@ -308,7 +385,7 @@ def _xprof_crosscheck(backend, sched, cfg, method: int, name: str,
     truth (obs/ledger.py docstring)."""
     logdir = os.path.join(cfg.xprof, f"m{method}_{name}")
     profiled = None
-    err = None
+    err = err_class = None
     try:
         import jax
         t0 = time.perf_counter()
@@ -317,10 +394,16 @@ def _xprof_crosscheck(backend, sched, cfg, method: int, name: str,
         profiled = time.perf_counter() - t0
     except Exception as e:  # profiler or backend trouble: report, not raise
         err = f"{type(e).__name__}: {e}"
+        err_class = classify_error(e)
+        srec = ledger.record_resilience(
+            "xprof", kind="suppressed", error_class=err_class,
+            error=err[:500])
+        trace.instant("ledger.resilience", **srec)
     recon = max_timer.total_time / max(cfg.ntimes, 1)
     report = ledger.xprof_report(
         label=f"m{method} {name} [{cfg.backend}]", logdir=logdir,
-        profiled_wall_s=profiled, reconstructed_s=recon, error=err)
+        profiled_wall_s=profiled, reconstructed_s=recon, error=err,
+        error_class=err_class)
     trace.instant("ledger.xprof",
                   **{k: v for k, v in report.items() if k != "logdir"})
     print(f"| {ledger.render_xprof(report)}", file=out)

@@ -34,8 +34,9 @@ returns a shared no-op context manager, and nothing here imports jax, so
 importing the package never changes bench.py's output.
 """
 
+from tpu_aggcomm.obs.atomic import atomic_write
 from tpu_aggcomm.obs.trace import (TraceRecorder, current, disable, enable,
                                    enabled, flush, instant, span)
 
-__all__ = ["TraceRecorder", "current", "disable", "enable", "enabled",
-           "flush", "instant", "span"]
+__all__ = ["TraceRecorder", "atomic_write", "current", "disable", "enable",
+           "enabled", "flush", "instant", "span"]

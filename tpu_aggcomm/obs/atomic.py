@@ -1,0 +1,51 @@
+"""Atomic artifact writes: tmp file + ``os.replace``, same directory.
+
+Every committed artifact in this repo (TUNE_*.json, TRAFFIC_*.json,
+``*.trace.{jsonl,json}``, report.html) is evidence that later rounds
+replay verdicts from — a half-written file is worse than a missing one,
+because the schema checkers and replay paths would fail on it long after
+the writer died. The tunnel host kills jobs routinely (OOM, timeouts),
+so every whole-file artifact writer goes through :func:`atomic_write`:
+the content lands in a same-directory temp file (``os.replace`` is only
+atomic within a filesystem), is flushed AND fsynced, and only then
+renamed over the target. A writer killed at ANY instant leaves the
+target either absent or fully intact, never truncated.
+
+Append-mode logs (the sweep sidecar, the resilience run journal) are a
+different contract — they stay append+fsync and their READERS skip a
+torn final line (resilience/journal.py) — so this helper is deliberately
+whole-file only.
+
+jax-free, stdlib only (obs discipline): bench.py's supervisor and the
+replay CLIs import through here where ``import jax`` may hang.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+
+__all__ = ["atomic_write"]
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "w", **open_kwargs):
+    """Yield a handle onto ``<dir(path)>/<tmp>``; on clean exit the temp
+    file is fsynced and ``os.replace``d over ``path``; on any error (or
+    a kill before the rename) ``path`` is untouched and the temp file is
+    unlinked where possible."""
+    target = os.path.abspath(path)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(target) or ".",
+                               prefix=os.path.basename(target) + ".",
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, mode, **open_kwargs) as fh:
+            yield fh
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, target)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise

@@ -48,9 +48,11 @@ from tpu_aggcomm.harness.hostenv import env_summary
 __all__ = ["SCHEMA_VERSION", "collect_manifest", "manifest",
            "record_device", "record_compile", "compile_records",
            "total_compile_seconds", "record_hbm_peak", "hbm_peak",
-           "reset", "diff_manifests", "DRIFT_IGNORE", "load_ledger",
-           "render_manifest", "render_ledgers", "xprof_report",
-           "xprof_reports", "render_xprof", "xplane_device_seconds"]
+           "record_resilience", "resilience_records",
+           "render_resilience", "reset", "diff_manifests", "DRIFT_IGNORE",
+           "load_ledger", "render_manifest", "render_ledgers",
+           "xprof_report", "xprof_reports", "render_xprof",
+           "xplane_device_seconds"]
 
 #: The bench parsed-schema version this ledger feeds: v3 = v2 (samples)
 #: + ``manifest`` + ``compile_seconds`` + ``hbm_peak_bytes``
@@ -60,6 +62,7 @@ SCHEMA_VERSION = 3
 _MANIFEST: dict | None = None
 _COMPILES: list[dict] = []
 _XPROF: list[dict] = []
+_RESILIENCE: list[dict] = []
 _HBM_PEAK: int | None = None
 
 
@@ -174,6 +177,25 @@ def hbm_peak() -> int | None:
     return _HBM_PEAK
 
 
+def record_resilience(site: str, *, kind: str, **extra) -> dict:
+    """Append one resilience record (tpu_aggcomm/resilience/):
+    ``kind`` in {"attempt", "suppressed", "deadline", "preflight",
+    "cancel"}. Attempt records carry the full retry-policy fields so
+    the backoff timeline replays deterministically from the artifact
+    alone (resilience/policy.replay_attempts). None extras are
+    dropped, record_compile discipline."""
+    rec = {"site": str(site), "kind": str(kind)}
+    for k, v in extra.items():
+        if v is not None:
+            rec[k] = v
+    _RESILIENCE.append(rec)
+    return rec
+
+
+def resilience_records() -> list[dict]:
+    return list(_RESILIENCE)
+
+
 def xprof_reports() -> list[dict]:
     return list(_XPROF)
 
@@ -186,6 +208,7 @@ def reset() -> None:
     _HBM_PEAK = None
     _COMPILES.clear()
     _XPROF.clear()
+    _RESILIENCE.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -235,20 +258,25 @@ def load_ledger(path: str) -> dict:
     "compile_seconds", "hbm_peak_bytes", "platform", "value"}`` (missing
     fields None). Accepts a driver-wrapped ``BENCH_rNN.json``
     (``{"parsed": {...}}``), a bare bench JSON line, or a
-    ``*.trace.jsonl`` event log (the ledger preamble event)."""
+    ``*.trace.jsonl`` event log (the ledger preamble event; resilience
+    records come back out of the ``ledger.resilience`` instants)."""
     out = {"file": path, "manifest": None, "compile_seconds": None,
-           "hbm_peak_bytes": None, "platform": None, "value": None}
+           "hbm_peak_bytes": None, "platform": None, "value": None,
+           "resilience": []}
     if path.endswith(".jsonl"):
         with open(path) as fh:
             for line in fh:
                 if not line.strip():
                     continue
                 e = json.loads(line)
-                if e.get("ev") == "ledger":
+                if e.get("ev") == "ledger" and out["manifest"] is None:
                     out["manifest"] = e.get("manifest")
                     m = out["manifest"] or {}
                     out["platform"] = m.get("platform")
-                    break
+                elif e.get("ev") == "instant" \
+                        and e.get("name") == "ledger.resilience" \
+                        and isinstance(e.get("args"), dict):
+                    out["resilience"].append(e["args"])
         return out
     with open(path) as fh:
         blob = json.load(fh)
@@ -259,6 +287,9 @@ def load_ledger(path: str) -> dict:
             if isinstance(parsed.get("manifest"), dict) else None
         for k in ("compile_seconds", "hbm_peak_bytes", "platform", "value"):
             out[k] = parsed.get(k, out[k])
+        if isinstance(parsed.get("resilience"), list):
+            out["resilience"] = [r for r in parsed["resilience"]
+                                 if isinstance(r, dict)]
     return out
 
 
@@ -290,6 +321,57 @@ def render_manifest(m: dict | None, indent: str = "  ") -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_resilience(records: list[dict], indent: str = "  ") -> str:
+    """Human lines for one artifact's resilience records (``inspect
+    ledger``): attempt timelines grouped per retry site, the other
+    record kinds one line each. Empty string when there are none — a
+    pre-resilience artifact renders exactly as before."""
+    if not records:
+        return ""
+    lines: list[str] = []
+    sites: dict[str, list[dict]] = {}
+    for r in records:
+        if r.get("kind") == "attempt":
+            sites.setdefault(str(r.get("site")), []).append(r)
+    for site, recs in sites.items():
+        retried = [r for r in recs if r.get("outcome") == "retry"]
+        last = max(recs, key=lambda r: r.get("attempt", 0))
+        classes = sorted({r.get("error_class") for r in recs
+                          if r.get("error_class")})
+        status = ("converged" if last.get("outcome") == "ok"
+                  else f"gave up ({last.get('error_class', '?')})")
+        lines.append(
+            f"{indent}resilience {site}: {len(recs)} attempt"
+            f"{'s' if len(recs) != 1 else ''}"
+            + (f", {len(retried)} retried"
+               f" [{', '.join(classes)}]" if retried else "")
+            + f" -> {status}")
+    for r in records:
+        kind = r.get("kind")
+        if kind == "attempt":
+            continue
+        if kind == "deadline":
+            lines.append(f"{indent}resilience {r.get('site')}: soft "
+                         f"deadline overrun — wall "
+                         f"{_fmt(r.get('wall_s'), ' s')} > "
+                         f"{_fmt(r.get('deadline_s'), ' s')} (advisory)")
+        elif kind == "suppressed":
+            lines.append(f"{indent}resilience {r.get('site')}: "
+                         f"suppressed {r.get('error_class', '?')} error "
+                         f"({str(r.get('error', ''))[:80]})")
+        elif kind == "preflight":
+            lines.append(f"{indent}resilience {r.get('site')}: preflight "
+                         f"rpc probe "
+                         f"{_fmt(r.get('rpc_probe_s'), ' s')}")
+        elif kind == "cancel":
+            lines.append(f"{indent}resilience {r.get('site')}: cancelled "
+                         f"at round boundary (deferred "
+                         f"{r.get('signal', '?')})")
+        else:
+            lines.append(f"{indent}resilience {r.get('site')}: {kind}")
+    return "\n".join(lines) + "\n"
+
+
 def render_ledgers(paths: list[str]) -> str:
     """``inspect ledger [FILE...]``: per-artifact manifest blocks plus
     DRIFT lines between each consecutive pair that both carry a
@@ -305,6 +387,9 @@ def render_ledgers(paths: list[str]) -> str:
             lines.append(
                 f"  compile {_fmt(ent['compile_seconds'], ' s')}  "
                 f"hbm peak {_fmt(ent['hbm_peak_bytes'], ' B')}")
+        res = render_resilience(ent.get("resilience") or [])
+        if res:
+            lines.append(res.rstrip("\n"))
     prev = None
     for ent in entries:
         if ent["manifest"] is None:
@@ -433,7 +518,8 @@ def xplane_device_seconds(path: str) -> dict | None:
 def xprof_report(*, label: str, logdir: str,
                  profiled_wall_s: float | None,
                  reconstructed_s: float | None,
-                 error: str | None = None) -> dict:
+                 error: str | None = None,
+                 error_class: str | None = None) -> dict:
     """Build (and record) the divergence report for one profiled rep.
 
     ``source`` is column-accurate about what the profiled side IS:
@@ -467,6 +553,7 @@ def xprof_report(*, label: str, logdir: str,
         "reconstructed_s": reconstructed_s,
         "total_s": total, "source": source,
         "divergence_pct": div, "error": error,
+        "error_class": error_class,
     }
     _XPROF.append(report)
     return report
@@ -474,7 +561,9 @@ def xprof_report(*, label: str, logdir: str,
 
 def render_xprof(report: dict) -> str:
     if report.get("error"):
-        return (f"xprof {report['label']}: unavailable "
+        cls = report.get("error_class")
+        cls_s = f" [{cls}]" if cls else ""
+        return (f"xprof {report['label']}: unavailable{cls_s} "
                 f"({report['error']})")
     div = report.get("divergence_pct")
     div_s = f"{div:+.1f}%" if div is not None else "n/a"

@@ -152,6 +152,15 @@ def validate_bench(obj, where: str = "BENCH") -> list[str]:
         if not isinstance(h, int) or isinstance(h, bool) or h < 0:
             errors.append(f"{w}: optional key 'hbm_peak_bytes' must be "
                           f"a non-negative integer or null")
+    # resilience records (tpu_aggcomm/resilience/policy.py): each must at
+    # least carry its site and kind or the jax-free replay cannot group it
+    if "resilience" in parsed and parsed["resilience"] is not None:
+        r = parsed["resilience"]
+        if not isinstance(r, list) or not all(
+                isinstance(x, dict) and isinstance(x.get("site"), str)
+                and isinstance(x.get("kind"), str) for x in r):
+            errors.append(f"{w}: optional key 'resilience' must be a "
+                          f"list of objects with str 'site' and 'kind'")
     return errors
 
 

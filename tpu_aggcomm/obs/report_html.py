@@ -789,7 +789,8 @@ def render_html(payload: dict) -> str:
 def write_report(out_path: str, *, history_root: str = ".",
                  trace_paths: list[str] | None = None) -> str:
     """Build the payload and write the dashboard; returns ``out_path``."""
+    from tpu_aggcomm.obs.atomic import atomic_write
     doc = render_html(build_payload(history_root, trace_paths))
-    with open(out_path, "w") as fh:
+    with atomic_write(out_path) as fh:
         fh.write(doc)
     return out_path

@@ -369,12 +369,13 @@ class TraceRecorder:
                     break
         except Exception:
             pass
+        from tpu_aggcomm.obs.atomic import atomic_write
         jsonl = f"{prefix}.trace.jsonl"
-        with open(jsonl, "w") as fh:
+        with atomic_write(jsonl) as fh:
             for e in self._events:
                 fh.write(json.dumps(e) + "\n")
         pft = f"{prefix}.trace.json"
-        with open(pft, "w") as fh:
+        with atomic_write(pft) as fh:
             json.dump(to_chrome_trace(self._events), fh)
         return jsonl, pft
 

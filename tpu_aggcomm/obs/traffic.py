@@ -621,10 +621,11 @@ def write_artifact(path: str, audit: dict,
                    overlay: dict | None = None) -> str:
     """Write a traffic-v1 JSON artifact (schema-checked by
     ``scripts/check_bench_schema.py`` when committed as TRAFFIC_*.json)."""
+    from tpu_aggcomm.obs.atomic import atomic_write
     blob = dict(audit)
     if overlay is not None:
         blob["overlay"] = overlay
-    with open(path, "w") as fh:
+    with atomic_write(path) as fh:
         json.dump(blob, fh, indent=1, sort_keys=False)
         fh.write("\n")
     return path

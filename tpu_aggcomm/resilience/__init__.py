@@ -1,0 +1,43 @@
+"""Resilient execution supervisor (ISSUE 7).
+
+PR 6 made the framework tolerate *declared* faults (``--fault`` →
+static schedule repair). This package handles the runtime failure modes
+the project actually hits on the tunnel host — transient axon-tunnel
+RPC errors, kills that must never land mid-kernel, OOM-killed capture
+jobs — as policy instead of folklore:
+
+- :mod:`tpu_aggcomm.resilience.policy` — error taxonomy
+  (transient-tunnel / compile / verify / program), seeded
+  exponential-backoff retry with deterministic replay, and the chaos
+  injection hook the CI smoke gate drives.
+- :mod:`tpu_aggcomm.resilience.journal` — crash-safe JSONL run journal
+  keyed by config + manifest fingerprint (``sweep --resume``,
+  ``scripts/tpu_capture_all.py --resume``).
+- :mod:`tpu_aggcomm.resilience.watchdog` — soft per-dispatch deadlines
+  derived from the roofline floor + prior walls, and round-boundary-only
+  cancellation (the tunnel-wedge rule as enforced policy).
+- :mod:`tpu_aggcomm.resilience.detect` — advisory fault detection:
+  measured round walls (``obs.metrics.round_stats``, verbatim) matched
+  against the PR 6 fault grammar, emitting a *proposed* ``--fault``
+  spec string. Advisory output only — never a silent behavior change.
+
+Everything here is jax-free (obs discipline — the replay, resume and
+journal paths run where ``import jax`` may hang on a dead tunnel);
+``obs.trace``/``obs.ledger``, which this package records into, are
+jax-free too.
+"""
+
+from tpu_aggcomm.resilience.policy import (RETRYABLE, RetryPolicy,
+                                           classify_error, replay_attempts,
+                                           retry_call)
+from tpu_aggcomm.resilience.journal import RunJournal
+from tpu_aggcomm.resilience.watchdog import (CancelledAtBoundary,
+                                             check_boundary,
+                                             derive_deadline,
+                                             safe_cancellation)
+from tpu_aggcomm.resilience.detect import propose_fault_specs
+
+__all__ = ["RETRYABLE", "RetryPolicy", "classify_error", "replay_attempts",
+           "retry_call", "RunJournal", "CancelledAtBoundary",
+           "check_boundary", "derive_deadline", "safe_cancellation",
+           "propose_fault_specs"]

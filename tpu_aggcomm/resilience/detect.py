@@ -1,0 +1,93 @@
+"""Online fault detection: measured round walls → proposed --fault spec.
+
+Closes the PR 6 detect→repair loop from the measurement side: the
+straggler statistics of a recorded run (``obs.metrics.round_stats``,
+used VERBATIM — the same numbers ``inspect trace`` prints) are matched
+against the slow-rank fault signature, and a *proposed* ``--fault``
+spec string in the PR 6 grammar comes out (validated by a
+``parse_fault`` round trip, so a proposal is always re-injectable).
+
+Detection is ADVISORY ONLY — an extra output line on ``inspect trace``;
+it never alters schedules, timers, or verdicts. The signature is
+deliberately conservative (a rank must dominate the critical path in a
+strict majority of >= 3 rounds AND by a meaningful factor) because a false
+"rank R is degraded" line would send an operator chasing ghosts.
+
+jax-free (obs.metrics + faults.spec are jax-free).
+"""
+
+from __future__ import annotations
+
+import statistics
+
+__all__ = ["propose_fault_specs", "render_proposals",
+           "MIN_FACTOR", "MIN_ROUNDS", "CRIT_SHARE"]
+
+#: A rank must be the critical rank in MORE than this share of the
+#: (multi-rank, per-round) stats rows to be proposed as degraded —
+#: strictly more: "critical in 1 of 2 rounds" is a coin flip, and the
+#: committed healthy FAULT trace trips exactly that on host jitter.
+CRIT_SHARE = 0.5
+#: ... and its rounds' max/p50 ratio (round_stats numbers, verbatim)
+#: must reach this factor: below it, ordinary scheduling jitter.
+MIN_FACTOR = 1.5
+#: ... over at least this many usable rounds: two rounds cannot show
+#: persistence, and persistence is the whole slow-rank signature.
+MIN_ROUNDS = 3
+
+
+def propose_fault_specs(events: list[dict]) -> list[dict]:
+    """Slow-rank proposals for every run in a trace event list.
+
+    Each proposal: ``{"run", "method", "name", "rank", "factor",
+    "spec", "crit_rounds", "rounds"}`` where ``spec`` is a canonical
+    PR 6 fault string (``slow:rR*F``). Runs without per-round
+    multi-rank decomposition (collectives, single-rank rows) yield
+    nothing — no data, no guess."""
+    from tpu_aggcomm.faults.spec import parse_fault
+    from tpu_aggcomm.obs.metrics import round_stats
+
+    proposals = []
+    for run in (e for e in events if e.get("ev") == "run"):
+        rid = run["id"]
+        stats = [s for s in round_stats(events, rid)
+                 if s["ranks"] > 1 and s["p50"] > 0]
+        if len(stats) < MIN_ROUNDS:
+            continue
+        crit_count: dict[int, int] = {}
+        for s in stats:
+            crit_count[s["critical_rank"]] = \
+                crit_count.get(s["critical_rank"], 0) + 1
+        rank = max(crit_count, key=crit_count.get)
+        if crit_count[rank] <= CRIT_SHARE * len(stats):
+            continue
+        factors = [s["max"] / s["p50"] for s in stats
+                   if s["critical_rank"] == rank]
+        factor = statistics.median(factors)
+        if factor < MIN_FACTOR:
+            continue
+        # round-trip through the PR 6 parser: a proposal must BE a valid
+        # injectable spec, canonical form, or it is not emitted at all
+        spec = parse_fault(f"slow:r{int(rank)}*{factor:.2g}").canonical()
+        proposals.append({
+            "run": rid, "method": run.get("method"),
+            "name": run.get("name"), "rank": int(rank),
+            "factor": round(factor, 2), "spec": spec,
+            "crit_rounds": crit_count[rank], "rounds": len(stats)})
+    return proposals
+
+
+def render_proposals(proposals: list[dict]) -> str:
+    """Advisory lines for ``inspect trace`` (empty string when there is
+    nothing to say — healthy traces stay byte-identical)."""
+    if not proposals:
+        return ""
+    lines = []
+    for p in proposals:
+        lines.append(
+            f"resilience: run {p['run']} (m={p['method']} "
+            f"\"{p['name']}\") — rank {p['rank']} critical in "
+            f"{p['crit_rounds']}/{p['rounds']} rounds, median max/p50 "
+            f"{p['factor']:.2f}x; proposed fault spec (advisory, "
+            f"re-injectable via --fault): {p['spec']}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,296 @@
+"""Error taxonomy + seeded retry/backoff — the resilience policy core.
+
+The taxonomy turns the bare ``except Exception`` swallows the harness
+used to carry into named verdicts:
+
+- ``transient-tunnel`` — the axon tunnel's RPC layer hiccuped
+  (UNAVAILABLE / DEADLINE_EXCEEDED / socket trouble). The ONLY
+  retryable class: the tunnel historically recovers (bench.py's probe
+  window exists for the same reason), and the programs are idempotent
+  (deterministic fills), so a bounded re-dispatch is honest.
+- ``compile`` — Mosaic/XLA lowering or compilation rejected the
+  program. Deterministic: retrying re-runs the same compiler on the
+  same input.
+- ``verify`` — ``--verify`` found wrong bytes. NEVER retried: a
+  correctness failure must surface (bench.py's RC_CORRECTNESS rule).
+- ``program`` — everything else (schedule deadlock, API misuse).
+
+Retry backoff is **seeded**: the jittered exponential schedule comes
+from ``random.Random(seed)``, every attempt lands in the trace
+(``ledger.resilience`` instants) and the ledger's resilience records,
+and :func:`replay_attempts` re-derives the schedule from the recorded
+policy fields alone — same seed + same error sequence ⟹ same attempt
+timeline, reproducible jax-free from committed artifacts (the tune
+``--replay`` discipline applied to retries).
+
+Chaos injection (``TPU_AGGCOMM_CHAOS="site:N,..."``) makes a retry site
+fail its first N attempts with a synthetic transient error — exercised
+by ``scripts/chaos_smoke.py`` in ci_tier1.sh. Inert (one memoized env
+lookup) when the variable is unset.
+
+jax-free (stdlib + obs.trace/obs.ledger, which are jax-free): the
+classification and replay paths run where ``import jax`` may hang.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+
+from tpu_aggcomm.obs import ledger, trace
+
+__all__ = ["TRANSIENT", "COMPILE", "VERIFY", "PROGRAM", "RETRYABLE",
+           "ChaosError", "classify_error", "RetryPolicy", "retry_call",
+           "replay_attempts", "maybe_chaos_fail"]
+
+TRANSIENT = "transient-tunnel"
+COMPILE = "compile"
+VERIFY = "verify"
+PROGRAM = "program"
+
+#: Only tunnel transients are retryable: compile and program errors are
+#: deterministic, and a verify failure must surface, never be re-rolled.
+RETRYABLE = frozenset({TRANSIENT})
+
+# Classification is by exception-type NAME (walking the MRO) plus
+# message tokens — never by importing backend/jax exception types here:
+# this module must classify errors it could not itself import (jaxlib's
+# XlaRuntimeError carries the gRPC status in its message).
+_VERIFY_TYPES = frozenset({"VerificationError"})
+_PROGRAM_TYPES = frozenset({"DeadlockError", "RepairError",
+                            "FaultSpecError"})
+_TRANSIENT_TYPES = frozenset({"ConnectionError", "ConnectionResetError",
+                              "ConnectionAbortedError",
+                              "ConnectionRefusedError", "BrokenPipeError",
+                              "TimeoutError", "ChaosError"})
+_TRANSIENT_TOKENS = ("unavailable", "deadline_exceeded",
+                     "deadline exceeded", "socket closed",
+                     "connection reset", "connection refused",
+                     "broken pipe", "tunnel", "unreachable",
+                     "rpc failed", "injected transient")
+_COMPILE_TOKENS = ("mosaic", "lowering", "compilation", "compile",
+                   "stablehlo", "mlir", "hlo")
+
+
+class ChaosError(ConnectionError):
+    """The synthetic transient raised by chaos injection — a real
+    ConnectionError subclass so it classifies as transient-tunnel by
+    type AND by message, exactly like the tunnel errors it mimics."""
+
+
+def classify_error(exc: BaseException) -> str:
+    """One of ``transient-tunnel`` / ``compile`` / ``verify`` /
+    ``program``. Type names take precedence over message tokens (a
+    VerificationError mentioning "connection" in its diff stays a
+    verify error); unknown errors default to ``program`` — the
+    NON-retryable default, so an unclassified failure can never loop."""
+    names = {c.__name__ for c in type(exc).__mro__}
+    if names & _VERIFY_TYPES:
+        return VERIFY
+    if names & _PROGRAM_TYPES:
+        return PROGRAM
+    if names & _TRANSIENT_TYPES:
+        return TRANSIENT
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    if any(t in msg for t in _TRANSIENT_TOKENS):
+        return TRANSIENT
+    if any(t in msg for t in _COMPILE_TOKENS):
+        return COMPILE
+    return PROGRAM
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with seeded exponential backoff + jitter.
+
+    The whole backoff schedule is a pure function of the policy fields
+    (``random.Random(seed)``), so two runs with the same policy and the
+    same error sequence produce the SAME attempt timeline — the
+    invariant :func:`replay_attempts` audits from artifacts."""
+
+    max_attempts: int = 3
+    backoff_base_s: float = 0.25
+    backoff_mult: float = 2.0
+    jitter_frac: float = 0.25
+    seed: int = 0
+
+    @classmethod
+    def from_env(cls, env=None) -> "RetryPolicy":
+        """Policy from ``TPU_AGGCOMM_RETRY_{MAX,BASE,MULT,JITTER,SEED}``
+        (defaults above) — how CI/capture sessions shrink or stretch the
+        schedule without code changes."""
+        e = os.environ if env is None else env
+        return cls(
+            max_attempts=int(e.get("TPU_AGGCOMM_RETRY_MAX", 3)),
+            backoff_base_s=float(e.get("TPU_AGGCOMM_RETRY_BASE", 0.25)),
+            backoff_mult=float(e.get("TPU_AGGCOMM_RETRY_MULT", 2.0)),
+            jitter_frac=float(e.get("TPU_AGGCOMM_RETRY_JITTER", 0.25)),
+            seed=int(e.get("TPU_AGGCOMM_RETRY_SEED", 0)))
+
+    def backoff_schedule(self) -> list[float]:
+        """Seconds to sleep after failed attempt k (k = 1-based index
+        into this list): ``base * mult**k * (1 + jitter*U[0,1))`` with a
+        seeded RNG. Deterministic from the policy fields alone."""
+        rng = random.Random(self.seed)
+        return [self.backoff_base_s * self.backoff_mult ** k
+                * (1.0 + self.jitter_frac * rng.random())
+                for k in range(max(self.max_attempts - 1, 0))]
+
+    def as_record(self) -> dict:
+        """The policy fields every attempt record carries, so replay
+        needs nothing but the artifact."""
+        return {"max_attempts": self.max_attempts,
+                "backoff_base_s": self.backoff_base_s,
+                "backoff_mult": self.backoff_mult,
+                "jitter_frac": self.jitter_frac,
+                "seed": self.seed}
+
+
+# --------------------------------------------------------------------------
+# Chaos injection (ci_tier1.sh smoke gate).
+
+_CHAOS: dict | None = None
+
+
+def _chaos_budget() -> dict:
+    global _CHAOS
+    if _CHAOS is None:
+        _CHAOS = {}
+        spec = os.environ.get("TPU_AGGCOMM_CHAOS", "")
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, n = part.partition(":")
+            try:
+                _CHAOS[name.strip()] = int(n)
+            except ValueError:
+                raise ValueError(
+                    f"malformed TPU_AGGCOMM_CHAOS entry {part!r} "
+                    f"(want 'site:N')")
+    return _CHAOS
+
+
+def _reset_chaos() -> None:
+    """Forget the memoized chaos budget (tests only)."""
+    global _CHAOS
+    _CHAOS = None
+
+
+def maybe_chaos_fail(site: str) -> None:
+    """Raise a synthetic transient while the site's injected-failure
+    budget lasts. A chaos key matches a site exactly or as a ``:``
+    prefix ("dispatch" matches "dispatch:m1:i0")."""
+    budget = _chaos_budget()
+    if not budget:
+        return
+    for prefix, left in budget.items():
+        if left > 0 and (site == prefix or site.startswith(prefix + ":")):
+            budget[prefix] = left - 1
+            raise ChaosError(
+                f"UNAVAILABLE: injected transient fault at {site} "
+                f"(chaos {prefix!r}, {left - 1} left)")
+
+
+# --------------------------------------------------------------------------
+# The retry loop.
+
+def retry_call(fn, *, site: str, policy: RetryPolicy | None = None,
+               classify=classify_error, sleep=time.sleep):
+    """Run ``fn()`` under the classified retry policy.
+
+    EVERY attempt — including a first-try success — lands as a
+    ``kind="attempt"`` resilience record in the ledger AND a
+    ``ledger.resilience`` trace instant, carrying the policy fields and
+    (for retries) the exact backoff slept, so the timeline replays
+    deterministically from artifacts. Non-retryable errors (and the
+    final exhausted attempt) re-raise unchanged."""
+    pol = policy if policy is not None else RetryPolicy.from_env()
+    backoffs = pol.backoff_schedule()
+    for attempt in range(1, max(pol.max_attempts, 1) + 1):
+        try:
+            maybe_chaos_fail(site)
+            result = fn()
+        except Exception as e:
+            cls = classify(e)
+            retryable = cls in RETRYABLE and attempt < pol.max_attempts
+            backoff = backoffs[attempt - 1] if retryable else None
+            rec = ledger.record_resilience(
+                site, kind="attempt", attempt=attempt,
+                outcome="retry" if retryable else "raise",
+                error_class=cls,
+                error=f"{type(e).__name__}: {e}"[:500],
+                backoff_s=backoff, **pol.as_record())
+            trace.instant("ledger.resilience", **rec)
+            if not retryable:
+                raise
+            sleep(backoff)
+            continue
+        rec = ledger.record_resilience(
+            site, kind="attempt", attempt=attempt, outcome="ok",
+            **pol.as_record())
+        trace.instant("ledger.resilience", **rec)
+        return result
+    raise AssertionError("unreachable: final attempt raises or returns")
+
+
+# --------------------------------------------------------------------------
+# Deterministic replay from artifacts (tune --replay discipline).
+
+def replay_attempts(records: list[dict]) -> tuple[str, list[str]]:
+    """Audit recorded attempt timelines: ``("REPRODUCED", [])`` when
+    every site's recorded backoffs match the schedule re-derived from
+    its recorded policy fields and the attempt sequence is well-formed
+    (contiguous attempts, retries strictly before the terminal
+    ok/raise); ``("MISMATCH", problems)`` otherwise.
+
+    ``records`` are ``kind="attempt"`` resilience records, from a bench
+    artifact's ``resilience`` list or a trace's ``ledger.resilience``
+    instants — jax-free either way."""
+    problems: list[str] = []
+    by_site: dict[str, list[dict]] = {}
+    for r in records:
+        if r.get("kind") != "attempt":
+            continue
+        by_site.setdefault(str(r.get("site")), []).append(r)
+    for site, recs in by_site.items():
+        recs = sorted(recs, key=lambda r: int(r.get("attempt", 0)))
+        want_attempts = list(range(1, len(recs) + 1))
+        got_attempts = [int(r.get("attempt", 0)) for r in recs]
+        if got_attempts != want_attempts:
+            problems.append(f"{site}: attempt sequence {got_attempts} "
+                            f"is not contiguous from 1")
+            continue
+        pol = RetryPolicy(
+            max_attempts=int(recs[0].get("max_attempts", 0)),
+            backoff_base_s=float(recs[0].get("backoff_base_s", 0.0)),
+            backoff_mult=float(recs[0].get("backoff_mult", 0.0)),
+            jitter_frac=float(recs[0].get("jitter_frac", 0.0)),
+            seed=int(recs[0].get("seed", 0)))
+        schedule = pol.backoff_schedule()
+        for r in recs[:-1]:
+            if r.get("outcome") != "retry":
+                problems.append(
+                    f"{site}: attempt {r.get('attempt')} has outcome "
+                    f"{r.get('outcome')!r} but is not the last attempt")
+        if recs[-1].get("outcome") not in ("ok", "raise"):
+            problems.append(f"{site}: terminal attempt has outcome "
+                            f"{recs[-1].get('outcome')!r}")
+        for r in recs:
+            if r.get("outcome") != "retry":
+                continue
+            k = int(r["attempt"]) - 1
+            if k >= len(schedule):
+                problems.append(f"{site}: attempt {r['attempt']} retried "
+                                f"beyond the policy's schedule")
+                continue
+            want = schedule[k]
+            got = r.get("backoff_s")
+            if not isinstance(got, (int, float)) \
+                    or abs(float(got) - want) > 1e-12:
+                problems.append(
+                    f"{site}: attempt {r['attempt']} recorded backoff "
+                    f"{got!r}, seeded schedule says {want!r}")
+    return ("REPRODUCED" if not problems else "MISMATCH", problems)

@@ -1,0 +1,170 @@
+"""Deadline watchdog + round-boundary-only cancellation.
+
+Two policies, both SOFT by construction:
+
+- **Deadlines** never kill anything. A mid-kernel SIGTERM has wedged
+  the axon tunnel for hours, twice (CLAUDE.md gotchas), so the deadline
+  derived here (roofline floor × reps × slack, prior observed walls,
+  the tunnel's RPC probe) is checked AFTER a dispatch returns: an
+  overrun produces a ``kind="deadline"`` resilience record, a trace
+  instant and a stderr warning — evidence for the operator, not a
+  signal to the kernel.
+- **Cancellation** lands only at round boundaries. Inside
+  :func:`safe_cancellation`, SIGINT/SIGTERM set a deferred flag instead
+  of interrupting; the dispatch loop calls :func:`check_boundary`
+  between cells and the pending cancellation materializes there as
+  :class:`CancelledAtBoundary` — after the in-flight program finished,
+  never mid-kernel. A second SIGINT (an operator insisting at a
+  genuinely hung prompt) restores the default handler, so the escape
+  hatch exists but requires explicit insistence. The tunnel-wedge rule
+  becomes enforced policy, not a comment.
+
+jax-free; the roofline import inside :func:`schedule_floor_s` is gated
+to the jax lowerings it models (harness/roofline.py pulls in
+backends/jax_shard), so local/native oracle runs never touch it.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+from tpu_aggcomm.obs import ledger, trace
+
+__all__ = ["CancelledAtBoundary", "safe_cancellation", "check_boundary",
+           "cancellation_pending", "derive_deadline", "schedule_floor_s",
+           "soft_deadline_check"]
+
+
+class CancelledAtBoundary(RuntimeError):
+    """A deferred SIGINT/SIGTERM honored at a round boundary."""
+
+
+# Module-level state: one cancellation scope per process (signal
+# handlers are process-global anyway).
+_STATE = {"active": False, "pending": None, "sigint_count": 0}
+
+
+class safe_cancellation:
+    """Context manager deferring SIGINT/SIGTERM to round boundaries.
+
+    Only installs handlers on the main thread (signal.signal raises
+    elsewhere); off the main thread it is a transparent no-op and
+    Python's default delivery applies."""
+
+    def __enter__(self):
+        self._installed = []
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        _STATE.update(active=True, pending=None, sigint_count=0)
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                old = signal.signal(sig, _defer_signal)
+            except (ValueError, OSError):
+                continue
+            self._installed.append((sig, old))
+        return self
+
+    def __exit__(self, *exc):
+        for sig, old in self._installed:
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        _STATE.update(active=False, pending=None, sigint_count=0)
+        return False
+
+
+def _defer_signal(signum, frame) -> None:
+    name = signal.Signals(signum).name
+    if signum == signal.SIGINT:
+        _STATE["sigint_count"] += 1
+        if _STATE["sigint_count"] >= 2:
+            # the operator insists: restore default delivery and raise —
+            # the documented escape hatch for a genuinely hung program
+            signal.signal(signal.SIGINT, signal.default_int_handler)
+            raise KeyboardInterrupt
+    _STATE["pending"] = name
+    print(f"# {name} received: deferring cancellation to the next round "
+          f"boundary — killing a TPU client mid-kernel can wedge the "
+          f"tunnel (CLAUDE.md)"
+          + ("; press Ctrl-C again to force" if signum == signal.SIGINT
+             else ""),
+          file=sys.stderr, flush=True)
+
+
+def cancellation_pending() -> str | None:
+    """The deferred signal name, if one arrived inside the scope."""
+    return _STATE["pending"] if _STATE["active"] else None
+
+
+def check_boundary(label: str) -> None:
+    """Honor a deferred cancellation HERE (a round/cell boundary: no
+    program in flight). No-op — one dict lookup — otherwise."""
+    sig = cancellation_pending()
+    if sig is None:
+        return
+    rec = ledger.record_resilience(label, kind="cancel", signal=sig)
+    trace.instant("ledger.resilience", **rec)
+    _STATE["pending"] = None
+    raise CancelledAtBoundary(
+        f"cancelled at round boundary {label} (deferred {sig}); "
+        f"re-run with --resume to continue from the journal")
+
+
+# --------------------------------------------------------------------------
+# Soft deadlines.
+
+def schedule_floor_s(schedule, backend_name: str) -> float | None:
+    """The roofline fenced floor for one rep of ``schedule`` under a
+    jax lowering; None for backends the model does not cover (local/
+    native oracles, TAM/collective schedules) — roofline imports the
+    jax_shard lowering, so the gate keeps oracle runs jax-free."""
+    if backend_name not in ("jax_sim", "jax_shard"):
+        return None
+    try:
+        from tpu_aggcomm.harness.roofline import rep_bytes
+        return rep_bytes(schedule, lowering=backend_name).floor_seconds(
+            fenced=True)
+    except Exception:
+        return None
+
+
+def derive_deadline(*, floor_s: float | None = None, ntimes: int = 1,
+                    rpc_probe_s: float | None = None,
+                    prior_walls=(), slack: float = 50.0,
+                    min_deadline_s: float = 30.0) -> float:
+    """A generous soft deadline (seconds) for one dispatch.
+
+    Takes the MAX of three honest estimates — ``slack ×`` the roofline
+    floor for the whole dispatch (floor × reps, plus a per-dispatch RPC
+    term when the tunnel probe measured one), ``5 ×`` the slowest prior
+    wall observed for the same site family (compile excluded once a
+    wall exists), and an absolute floor that absorbs first-dispatch
+    compilation. Generous by design: this deadline flags, it never
+    kills."""
+    candidates = [float(min_deadline_s)]
+    if floor_s is not None and floor_s > 0:
+        candidates.append(slack * floor_s * max(int(ntimes), 1)
+                          + 10.0 * (rpc_probe_s or 0.1))
+    walls = [w for w in prior_walls if isinstance(w, (int, float)) and w > 0]
+    if walls:
+        candidates.append(5.0 * max(walls))
+    return max(candidates)
+
+
+def soft_deadline_check(site: str, *, wall_s: float,
+                        deadline_s: float | None, out=None) -> bool:
+    """After a dispatch RETURNED: record + warn if it overran its soft
+    deadline. Returns True on overrun. Never interrupts anything."""
+    if deadline_s is None or wall_s <= deadline_s:
+        return False
+    rec = ledger.record_resilience(
+        site, kind="deadline", wall_s=wall_s, deadline_s=deadline_s)
+    trace.instant("ledger.resilience", **rec)
+    print(f"# watchdog: {site} took {wall_s:.1f}s (soft deadline "
+          f"{deadline_s:.1f}s) — tunnel or chip may be degraded; "
+          f"advisory only, nothing was interrupted",
+          file=out if out is not None else sys.stderr)
+    return True

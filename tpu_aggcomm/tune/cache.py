@@ -99,7 +99,8 @@ def save_tune(root: str, *, key: dict, manifest: dict | None,
             "synthetic": bool(synthetic),
             "created_unix": time.time()}
     path = artifact_path(root, key)
-    with open(path, "w") as fh:
+    from tpu_aggcomm.obs.atomic import atomic_write
+    with atomic_write(path) as fh:
         json.dump(blob, fh, indent=1)
         fh.write("\n")
     return path
