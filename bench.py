@@ -394,6 +394,19 @@ def check_regression() -> int:
     for d in verdict.get("manifest_drift") or []:
         print(f"# manifest drift: {d['key']}: {d['a']} -> {d['b']}",
               file=sys.stderr)
+    trend = verdict.get("trend")
+    if trend is not None:
+        line = (f"# trend [{trend.get('series')}]: {trend['verdict']} "
+                f"over {trend['rounds']} round(s)")
+        if trend.get("slope_pct_per_round") is not None:
+            lo, hi = trend["ci_pct_per_round"]
+            line += (f", slope {trend['slope_pct_per_round']:+.1f}%/round "
+                     f"(95% CI [{lo:+.1f}%, {hi:+.1f}%], "
+                     f"tolerance {trend['tolerance_pct']:.0f}%, "
+                     f"seed {trend['seed']})")
+        if trend.get("note"):
+            line += f" — {trend['note']}"
+        print(line, file=sys.stderr)
     # the one-JSON-line stdout contract holds in this mode too; the full
     # per-round history stays on stderr
     slim = {k: v for k, v in verdict.items() if k != "history"}

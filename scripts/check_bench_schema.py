@@ -21,9 +21,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpu_aggcomm.obs.regress import (load_history, parsed_schema_version,
-                                     validate_bench, validate_multichip,
-                                     validate_traffic, validate_tune)
+from tpu_aggcomm.obs.history import load_history
+from tpu_aggcomm.obs.regress import (parsed_schema_version, validate_bench,
+                                     validate_multichip, validate_traffic,
+                                     validate_tune)
 
 
 def check(root: str) -> int:

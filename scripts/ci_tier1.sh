@@ -55,6 +55,17 @@ for f in TUNE_*.json; do
   [ -e "$f" ] || continue
   python -m tpu_aggcomm.cli tune --replay "$f" || post_rc=1
 done
+# live-telemetry gate (obs/export.py + obs/history.py, jax-free):
+# render OpenMetrics from every committed trace and validate it with
+# the parser in obs/regress.py (format drift fails HERE, not in a
+# scraper), pin the exported quantiles float-exact against
+# obs.metrics.round_stats, and cross-check the seeded multi-round
+# trend gate between `inspect history` and --check-regression (same
+# artifacts + same seed must mean the same verdict byte-for-byte).
+python scripts/telemetry_gate.py || post_rc=1
+# longitudinal history view over the committed artifacts (jax-free);
+# exits nonzero on a confirmed drifting-up bench series
+python -m tpu_aggcomm.cli inspect history > /dev/null || post_rc=1
 # chaos smoke (tpu_aggcomm/resilience/): a jax_sim run whose dispatch
 # fails transiently N times (TPU_AGGCOMM_CHAOS) must converge via the
 # seeded retry policy, pass --verify byte-exact, keep bench.py's

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""CI gate for the live-telemetry pipeline (ISSUE 8). jax-free.
+
+Three checks over COMMITTED artifacts only (no backend, no sweep):
+
+1. **OpenMetrics render+parse** — fold every committed ``*.trace.jsonl``
+   through ``obs.export.trace_registry`` and validate the rendered text
+   with the small parser in ``obs/regress.py``
+   (``validate_openmetrics``). A format drift in the exporter fails the
+   build here, not in someone's scraper.
+2. **Float-exactness** — the rendered ``<p>_round_wall_seconds`` gauges
+   and the ``<p>_rank_round_seconds_exact`` summary quantiles must
+   round-trip byte-for-byte against ``obs.metrics.round_stats`` /
+   ``percentile`` over the same events — the exporter's numbers ARE the
+   ``inspect trace`` numbers, never an approximation.
+3. **Trend consistency** — ``obs.history.check_trends`` over the repo
+   and the ``trend`` block inside ``obs.regress.check_regression`` must
+   agree verdict-for-verdict on the shared series (same artifacts, same
+   seed ⟹ same verdict: the regression-gate seed discipline).
+
+Usage: ``python scripts/telemetry_gate.py [root]`` (default repo root).
+Prints one line per check; exits nonzero on any failure.
+"""
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_aggcomm.obs import export
+from tpu_aggcomm.obs.history import _tail_jsonl, check_trends
+from tpu_aggcomm.obs.metrics import cell_means, percentile, round_stats
+from tpu_aggcomm.obs.regress import (check_regression, parse_openmetrics,
+                                     validate_openmetrics)
+
+
+def _sample_map(parsed: dict) -> dict:
+    """{(name, labels-tuple): value} for exact comparisons."""
+    return {(s["name"], tuple(sorted(s["labels"].items()))): s["value"]
+            for s in parsed["samples"]}
+
+
+def check_trace(path: str) -> int:
+    events = _tail_jsonl(path)
+    name = os.path.basename(path)
+    text = export.trace_registry(events).render()
+    errors = validate_openmetrics(text)
+    if errors:
+        for e in errors:
+            print(f"FAIL {name}: openmetrics: {e}")
+        return len(errors)
+    parsed = parse_openmetrics(text)
+    samples = _sample_map(parsed)
+    bad = 0
+    for run in (e for e in events if e.get("ev") == "run"):
+        rid = run["id"]
+        lab = {"run": str(rid), "method": str(run.get("name", "?")),
+               "backend": str(run.get("backend", "?"))}
+        # gauge vs round_stats: VERBATIM, so == on floats is the test
+        for rs in round_stats(events, rid):
+            key = (f"{export.PREFIX}_round_wall_seconds",
+                   tuple(sorted(dict(lab, round=str(rs["round"])).items())))
+            got = samples.get(key)
+            if got != rs["wall"]:
+                print(f"FAIL {name}: run {rid} round {rs['round']}: "
+                      f"exported wall {got!r} != round_stats {rs['wall']!r}")
+                bad += 1
+        vals = [s for _k, s in sorted(cell_means(events, rid).items())]
+        for q in export.QUANTILES:
+            key = (f"{export.PREFIX}_rank_round_seconds_exact",
+                   tuple(sorted(dict(lab, quantile=repr(float(q))).items())))
+            want = percentile(vals, q * 100.0) if vals else None
+            got = samples.get(key)
+            if vals and got != want:
+                print(f"FAIL {name}: run {rid} q={q}: exported {got!r} "
+                      f"!= percentile {want!r}")
+                bad += 1
+    if not bad:
+        print(f"ok   {name}: openmetrics valid, "
+              f"{len(parsed['samples'])} samples float-exact")
+    return bad
+
+
+def check_trend_consistency(root: str) -> int:
+    trends = check_trends(root)
+    verdict = check_regression(root)
+    bad = 0
+    for e in trends["errors"]:
+        print(f"FAIL history: {e}")
+        bad += 1
+    tr = verdict.get("trend")
+    if tr is None:
+        # no measurable newest round — nothing to cross-check
+        print("ok   trend: no current headline; regression trend inactive")
+        return bad
+    key = tr.get("series")
+    gate = trends["series"].get(key)
+    if gate is None:
+        print(f"FAIL trend: regression gate series {key!r} missing from "
+              f"inspect history")
+        return bad + 1
+    # identical inputs + identical seed must mean identical verdicts
+    mismatch = {k: (gate.get(k), tr.get(k))
+                for k in ("verdict", "rounds", "slope_pct_per_round",
+                          "ci_pct_per_round", "seed")
+                if gate.get(k) != tr.get(k)}
+    if mismatch:
+        for k, (a, b) in mismatch.items():
+            print(f"FAIL trend [{key}]: history {k}={a!r} != "
+                  f"regression {k}={b!r}")
+        return bad + len(mismatch)
+    print(f"ok   trend [{key}]: {gate['verdict']} — history and "
+          f"regression gates agree (seed {gate['seed']})")
+    return bad
+
+
+def main(root: str) -> int:
+    traces = sorted(glob.glob(os.path.join(root, "*.trace.jsonl")))
+    if not traces:
+        print(f"FAIL no committed *.trace.jsonl under {root}")
+        return 1
+    n_bad = 0
+    for path in traces:
+        n_bad += check_trace(path)
+    n_bad += check_trend_consistency(root)
+    print(f"{len(traces)} trace(s) checked, {n_bad} failure(s)")
+    return 1 if n_bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else
+                  os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))

@@ -31,6 +31,13 @@ each logged with a PASS/FAIL marker so a partial run is still evidence:
 Concurrent-discipline note: stage 3 executes BOTH disciplines (the
 probe script runs pallas_dma and pallas_dma_conc); the wave-accounting
 table in RESULTS_TPU.md is the structural evidence either way.
+
+Live telemetry (opt-in): set TPU_AGGCOMM_METRICS_PORT=<port> to expose
+stage-progress counters + a stage-wall histogram at
+http://127.0.0.1:<port>/metrics (obs/export.py) for the duration of the
+batch — curl it from another terminal instead of grepping capture.log.
+OFF by default: without the env var nothing is imported, bound or
+spawned.
 """
 
 import os
@@ -104,6 +111,30 @@ def main() -> int:
     fp = journal.begin_session(man)
 
     results: dict[str, str] = {}
+    stage_walls: list[float] = []
+
+    # env-gated live telemetry (obs/export.py): a multi-hour capture
+    # batch is exactly the job you want to curl from another terminal.
+    # OFF by default — without TPU_AGGCOMM_METRICS_PORT nothing below
+    # imports obs.export, binds a socket, or starts a thread.
+    metrics_server = None
+    if os.environ.get("TPU_AGGCOMM_METRICS_PORT", "").strip():
+        from tpu_aggcomm.obs import export
+
+        def _metrics_text():
+            reg = export.MetricsRegistry()
+            for status in ("PASS", "FAIL", "SKIP"):
+                reg.counter(f"{export.PREFIX}_capture_stages",
+                            sum(1 for v in results.values()
+                                if v == status), status=status)
+            for w in stage_walls:
+                reg.observe(f"{export.PREFIX}_capture_stage_wall_seconds",
+                            w)
+            return reg.render()
+
+        metrics_server = export.serve_from_env(_metrics_text)
+        if metrics_server is not None:
+            print(f"# metrics endpoint: {metrics_server.url}", flush=True)
 
     def run_stage(name: str, argv: list, env: dict | None = None,
                   artifacts: list | None = None) -> bool:
@@ -120,6 +151,7 @@ def main() -> int:
         t0 = time.time()
         ok = stage(name, argv, env)
         results[name] = "PASS" if ok else "FAIL"
+        stage_walls.append(time.time() - t0)
         # persist ok/fail + artifact paths: only status="done" (PASS)
         # satisfies a future --resume; failed stages always re-run
         journal.record({"stage": name}, fingerprint=fp,
@@ -218,6 +250,8 @@ def main() -> int:
         for k in ("bench", "mosaic-execute", "gated-tests", "followup",
                   "flagship"):
             results[k] = "SKIP"
+    if metrics_server is not None:
+        metrics_server.close()
     print("===== capture summary =====")
     for k, v in results.items():
         print(f"  {k:16s} {v}")

@@ -81,7 +81,9 @@ def test_obs_package_imports_no_jax():
          "import tpu_aggcomm.obs, tpu_aggcomm.obs.regress, "
          "tpu_aggcomm.obs.metrics, tpu_aggcomm.obs.compare, "
          "tpu_aggcomm.obs.report_html, tpu_aggcomm.obs.perfetto, "
-         "tpu_aggcomm.obs.ledger, tpu_aggcomm.obs.traffic, sys; "
+         "tpu_aggcomm.obs.ledger, tpu_aggcomm.obs.traffic, "
+         "tpu_aggcomm.obs.export, tpu_aggcomm.obs.live, "
+         "tpu_aggcomm.obs.history, sys; "
          "assert 'jax' not in sys.modules, 'obs imported jax'"],
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr
@@ -179,7 +181,17 @@ def test_perfetto_valid_and_monotone(tmp_path):
         assert key in e["args"], (e["name"], e["args"])
     names = {e["name"] for e in counters}
     assert {"bytes_in_flight", "traffic_msgs",
-            "traffic_max_incast"} <= names
+            "traffic_max_incast", "latency_p99_ms"} <= names
+    # the per-round latency quantile tracks (obs/export.py projected
+    # onto the timeline) must carry p50/p95 as round_stats VERBATIM
+    from tpu_aggcomm.obs.metrics import round_stats
+    events = load_events(paths[0])
+    for rs in round_stats(events, 0):
+        for q in ("p50", "p95"):
+            want = rs[q] * 1e3
+            got = [e["args"]["value"] for e in counters
+                   if e["name"] == f"latency_{q}_ms"]
+            assert want in got, (rs["round"], q, want, got)
 
 
 def test_perfetto_rank_tracks(tmp_path):

@@ -1,0 +1,448 @@
+"""Live-telemetry pipeline (ISSUE 8) guarantees:
+
+- jax-free: obs/export.py, obs/live.py and obs/history.py — plus the
+  ``inspect history`` / ``inspect live`` CLI paths — run where ``import
+  jax`` raises (poisoned-jax subprocess pins, the traffic/tune recipe);
+- float-exact: the OpenMetrics exposition's round gauges are
+  ``obs.metrics.round_stats`` VERBATIM and the ``_exact`` summary
+  quantiles are the same ``percentile`` arithmetic over the same
+  attribution cells — parse-and-compare equality, not approx;
+- OFF by default, zero-cost when off: ``serve_from_env`` with no
+  port/env returns None, and a plain sweep never imports
+  ``tpu_aggcomm.obs.export`` at all (sys.modules pin);
+- live endpoint: a sweep run with the endpoint armed prints its URL and
+  serves parseable OpenMetrics mid-run (scraped from the parent);
+- trend gate: seeded, deterministic, verdicts match construction
+  (drifting-up/down/stable/insufficient), and over the COMMITTED
+  artifacts ``inspect history`` agrees verdict-for-verdict with the
+  ``trend`` block inside ``bench.py --check-regression``;
+- history index writes go through ``obs.atomic_write``: a SIGKILL
+  mid-write (fsync patched to die) leaves the previous index intact;
+- ``inspect live`` renders a real sweep's journal: done cells, the
+  remaining grid, and a watchdog-model ETA;
+- ``inspect ledger`` drift additionally summarizes resilience records
+  (retries per site, suppressed classes) between consecutive rounds.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+from tpu_aggcomm.obs import export, trace
+from tpu_aggcomm.obs.history import (build_index, check_trends, trend_gate,
+                                     write_index)
+from tpu_aggcomm.obs.ledger import diff_resilience
+from tpu_aggcomm.obs.live import attach, sweep_status, tail_events
+from tpu_aggcomm.obs.metrics import cell_means, percentile, round_stats
+from tpu_aggcomm.obs.regress import (check_regression, parse_openmetrics,
+                                     validate_openmetrics)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _poisoned_env(tmp_path):
+    """A sys.path entry where ``import jax`` raises (the traffic/tune
+    recipe): the telemetry pipeline must run on a host whose tunnel is
+    wedged so badly that importing jax would hang forever."""
+    poison = tmp_path / "jax"
+    poison.mkdir()
+    (poison / "__init__.py").write_text(
+        "raise ImportError('poisoned jax: telemetry must not import "
+        "jax')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
+    return env
+
+
+def _traced_run(prefix, **kw):
+    cfg = ExperimentConfig(nprocs=8, cb_nodes=2, data_size=64,
+                           comm_size=2, method=1, ntimes=3,
+                           backend="jax_sim", verify=True, **kw)
+    trace.enable()
+    try:
+        run_experiment(cfg, out=io.StringIO())
+    finally:
+        paths = trace.flush(prefix)
+        trace.disable()
+    return paths
+
+
+# ------------------------------------------------------------ jax-free pins
+
+def test_telemetry_modules_survive_poisoned_jax(tmp_path):
+    """export/live/history import AND do real work where jax raises."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "from tpu_aggcomm.obs import export, live, history\n"
+         "reg = export.MetricsRegistry()\n"
+         "reg.counter('x', 2.0, kind='a'); reg.observe('y_seconds', 0.5)\n"
+         "text = reg.render()\n"
+         "assert text.endswith('# EOF\\n'), text\n"
+         "assert history.trend_gate([(1, 1.0), (2, 1.0)])['verdict'] "
+         "== 'insufficient'\n"
+         "assert live.tail_events('/nonexistent') == []\n"
+         "assert 'jax' not in sys.modules"],
+        cwd=REPO, env=_poisoned_env(tmp_path), capture_output=True,
+        text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_inspect_history_survives_poisoned_jax(tmp_path):
+    """The ci_tier1.sh gate command, byte-for-byte, where jax is broken."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "history"],
+        cwd=REPO, env=_poisoned_env(tmp_path), capture_output=True,
+        text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "trend:" in r.stdout
+    assert "measurable rounds" in r.stdout
+
+
+def test_inspect_live_survives_poisoned_jax(tmp_path):
+    """Attaching to a not-yet-started sweep (no journal) where jax is
+    broken: a board, a nonzero exit (work remains), no traceback."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "live",
+         "--results-csv", str(tmp_path / "absent.csv"),
+         "--comm-sizes", "2,4"],
+        cwd=REPO, env=_poisoned_env(tmp_path), capture_output=True,
+        text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "no journal entries yet" in r.stdout
+    assert "remaining: 2 cell(s)" in r.stdout
+    assert "Traceback" not in r.stderr
+
+
+def test_telemetry_gate_survives_poisoned_jax(tmp_path):
+    """The whole CI gate script is itself a jax-free supervisor tool."""
+    r = subprocess.run(
+        [sys.executable, "scripts/telemetry_gate.py"],
+        cwd=REPO, env=_poisoned_env(tmp_path), capture_output=True,
+        text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "float-exact" in r.stdout
+
+
+# -------------------------------------------------- OpenMetrics round trip
+
+def test_openmetrics_roundtrip_float_exact(tmp_path):
+    """Acceptance: exported quantiles match ``inspect trace``'s round
+    stats float-exactly — gauge == round_stats value, ``_exact``
+    summary == the same percentile arithmetic, via parse-and-compare."""
+    paths = _traced_run(str(tmp_path / "om"))
+    events = trace.load_events(paths[0])
+    text = export.trace_registry(events).render()
+    assert validate_openmetrics(text) == []
+    parsed = parse_openmetrics(text)
+    assert parsed["eof"]
+    samples = {(s["name"], tuple(sorted(s["labels"].items()))): s["value"]
+               for s in parsed["samples"]}
+    run = next(e for e in events if e.get("ev") == "run")
+    lab = {"run": str(run["id"]), "method": str(run["name"]),
+           "backend": str(run["backend"])}
+    stats = round_stats(events, run["id"])
+    assert stats, "traced throttled run produced no round stats"
+    for rs in stats:
+        rl = tuple(sorted(dict(lab, round=str(rs["round"])).items()))
+        for gauge, want in (("round_wall_seconds", rs["wall"]),
+                            ("round_p50_seconds", rs["p50"]),
+                            ("round_p95_seconds", rs["p95"])):
+            got = samples[(f"{export.PREFIX}_{gauge}", rl)]
+            assert got == want, (gauge, rs["round"], got, want)
+    vals = [v for _k, v in sorted(cell_means(events, run["id"]).items())]
+    for q in export.QUANTILES:
+        key = (f"{export.PREFIX}_rank_round_seconds_exact",
+               tuple(sorted(dict(lab, quantile=repr(float(q))).items())))
+        assert samples[key] == percentile(vals, q * 100.0)
+    # the histogram count covers every attribution cell exactly once
+    cnt_key = (f"{export.PREFIX}_rank_round_seconds",
+               tuple(sorted(lab.items())))
+    assert samples[(f"{export.PREFIX}_rank_round_seconds_count",
+                    tuple(sorted(lab.items())))] == len(vals)
+    del cnt_key
+
+
+def test_validate_openmetrics_rejects_breakage():
+    reg = export.MetricsRegistry()
+    reg.observe("t_seconds", 0.25)
+    good = reg.render()
+    assert validate_openmetrics(good) == []
+    # no terminator
+    assert any("EOF" in e for e in
+               validate_openmetrics(good.replace("# EOF\n", "")))
+    # sample without a TYPE declaration
+    assert any("no TYPE" in e for e in
+               validate_openmetrics("orphan_total 1\n# EOF\n"))
+    # junk line = loud single-error verdict
+    assert len(validate_openmetrics("!!!\n# EOF\n")) == 1
+    # non-cumulative histogram buckets
+    bad = ("# TYPE h histogram\n"
+           'h_bucket{le="0.1"} 5\nh_bucket{le="0.2"} 3\n'
+           'h_bucket{le="+Inf"} 5\nh_count 5\nh_sum 1.0\n# EOF\n')
+    assert any("cumulative" in e or "decreas" in e
+               for e in validate_openmetrics(bad))
+
+
+def test_latency_histogram_exact_quantiles():
+    h = export.LatencyHistogram()
+    vals = [1e-6, 5e-6, 2e-6, 9e-6, 4e-6]
+    for v in vals:
+        h.observe(v)
+    assert h.count == len(vals)
+    assert h.sum == pytest.approx(sum(vals))
+    for q in (0.5, 0.95, 0.99):
+        assert h.quantile(q) == percentile(vals, q * 100.0)
+    assert sum(h.counts) == len(vals)
+
+
+# ------------------------------------------------------------- the endpoint
+
+def test_metrics_server_http():
+    reg = export.MetricsRegistry()
+    reg.counter("tpu_aggcomm_demo", 3.0, stage="x")
+    reg.observe("tpu_aggcomm_demo_wall_seconds", 0.125)
+    srv = export.MetricsServer(reg.render, port=0)
+    try:
+        assert srv.port > 0
+        with urllib.request.urlopen(srv.url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            body = resp.read().decode()
+        assert validate_openmetrics(body) == []
+        assert "tpu_aggcomm_demo_total" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                srv.url.replace("/metrics", "/other"), timeout=10)
+    finally:
+        srv.close()
+
+
+def test_serve_from_env_off_by_default():
+    """Absent/empty/garbage env = no server, no socket, no thread."""
+    assert export.serve_from_env(lambda: "", env={}) is None
+    assert export.serve_from_env(
+        lambda: "", env={export.METRICS_PORT_ENV: ""}) is None
+    assert export.serve_from_env(
+        lambda: "", env={export.METRICS_PORT_ENV: "not-a-port"}) is None
+    srv = export.serve_from_env(
+        lambda: "# EOF\n", env={export.METRICS_PORT_ENV: "0"})
+    try:
+        assert srv is not None and srv.port > 0
+    finally:
+        srv.close()
+
+
+def test_sweep_without_endpoint_never_imports_export(tmp_path):
+    """Zero-cost pin: a plain sweep (no flag, no env var) must not load
+    the telemetry module at all — the gate is on the import itself."""
+    env = dict(os.environ)
+    env.pop(export.METRICS_PORT_ENV, None)
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "from tpu_aggcomm.cli import main\n"
+         "rc = main(['sweep', '-n', '8', '-a', '2', '-d', '64',\n"
+         "           '-m', '1', '--backend', 'local',\n"
+         "           '--comm-sizes', '2', '--results-csv', 'r.csv'])\n"
+         "assert rc == 0, rc\n"
+         "assert 'tpu_aggcomm.obs.export' not in sys.modules, \\\n"
+         "    'telemetry code loaded on the unarmed hot path'\n"
+         "assert 'jax' not in sys.modules"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_sweep_endpoint_serves_openmetrics_midrun(tmp_path):
+    """Acceptance: scrape /metrics from the parent while a CPU sweep
+    runs; the exposition parses and carries the sweep counters."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop(export.METRICS_PORT_ENV, None)
+    # enough cells that the endpoint outlives the first scrape; the
+    # child prints its URL on stderr before the first cell runs
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "sweep",
+         "-n", "32", "-a", "8", "-d", "2048", "-m", "1", "-i", "100",
+         "--backend", "local", "--comm-sizes", "1,2,4,8,16",
+         "--results-csv", "r.csv", "--metrics-port", "0"],
+        cwd=str(tmp_path), env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE, text=True)
+    url = None
+    try:
+        for line in proc.stderr:
+            if line.startswith("# metrics endpoint:"):
+                url = line.split(":", 1)[1].strip()
+                break
+        assert url, "sweep never announced its metrics endpoint"
+        body = None
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            body = resp.read().decode()
+        assert validate_openmetrics(body) == []
+        parsed = parse_openmetrics(body)
+        names = {s["name"] for s in parsed["samples"]}
+        assert f"{export.PREFIX}_sweep_cells_total" in names
+    finally:
+        proc.stderr.close()
+        rc = proc.wait(timeout=300)
+    assert rc == 0
+
+
+# --------------------------------------------------------------- trend gate
+
+def test_trend_gate_verdicts():
+    up = trend_gate([(1, 1.0), (2, 1.4), (3, 1.9), (4, 2.5), (5, 3.2)])
+    assert up["verdict"] == "drifting-up"
+    down = trend_gate([(1, 3.2), (2, 2.5), (3, 1.9), (4, 1.4), (5, 1.0)])
+    assert down["verdict"] == "drifting-down"
+    flat = trend_gate([(1, 1.00), (2, 1.01), (3, 0.99), (4, 1.00),
+                       (5, 1.02)])
+    assert flat["verdict"] == "stable"
+    short = trend_gate([(1, 1.0), (2, 9.9)])
+    assert short["verdict"] == "insufficient"
+    assert "trend gate inactive" in short["note"]
+
+
+def test_trend_gate_seeded_deterministic():
+    """Same points + same seed => byte-identical verdict (the
+    regression-gate seed discipline)."""
+    pts = [(1, 1.0), (2, 1.2), (3, 1.1), (4, 1.5), (5, 1.4)]
+    a = trend_gate(pts, seed=7)
+    b = trend_gate(pts, seed=7)
+    assert a == b
+    c = trend_gate(pts, seed=8)
+    assert c["ci_pct_per_round"] != a["ci_pct_per_round"]
+
+
+def test_trend_gate_needs_ci_confirmation():
+    """A steep point slope whose bootstrap CI includes zero must stay
+    stable — a two-round blip cannot fake a trajectory."""
+    g = trend_gate([(1, 1.0), (2, 1.0), (3, 5.0)])
+    assert g["verdict"] == "stable"
+    assert g["note"] and "CI includes zero" in g["note"]
+
+
+def test_check_trends_matches_check_regression():
+    """Over the COMMITTED artifacts: the history gate and the trend
+    block inside --check-regression agree verdict-for-verdict (same
+    artifacts + same seed => same verdict)."""
+    trends = check_trends(REPO)
+    assert trends["errors"] == []
+    verdict = check_regression(REPO)
+    tr = verdict.get("trend")
+    if tr is None:
+        pytest.skip("no measurable current round in the committed history")
+    gate = trends["series"][tr["series"]]
+    for k in ("verdict", "rounds", "slope_pct_per_round",
+              "ci_pct_per_round", "seed"):
+        assert gate[k] == tr[k], (k, gate[k], tr[k])
+    # and the whole thing is deterministic call-over-call
+    assert check_trends(REPO) == trends
+
+
+# ------------------------------------------------------------ history index
+
+def test_history_index_schema_and_families(tmp_path):
+    index = build_index(REPO)
+    assert index["schema"] == "history-v1"
+    assert index["bench"], "committed bench history missing from index"
+    assert index["errors"] == []
+    assert any(t["verdict"] for t in index["traffic"])
+    path = write_index(str(tmp_path / "HISTORY.json"), index)
+    with open(path) as fh:
+        assert json.load(fh)["schema"] == "history-v1"
+
+
+def test_history_write_index_atomic_under_sigkill(tmp_path):
+    """SIGKILL mid-write (fsync patched to die) must leave the previous
+    index byte-intact — write_index goes through obs.atomic_write."""
+    target = tmp_path / "HISTORY.json"
+    original = '{"schema": "history-v1", "sentinel": true}\n'
+    target.write_text(original)
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import os, signal\n"
+         "os.fsync = lambda fd: os.kill(os.getpid(), signal.SIGKILL)\n"
+         "from tpu_aggcomm.obs.history import write_index\n"
+         f"write_index({str(target)!r}, {{'schema': 'history-v1', "
+         "'huge': 'x' * 100000})\n"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == -9, (r.returncode, r.stderr)
+    assert target.read_text() == original
+    # the aborted temp file must not linger as a fake artifact either
+    leftovers = [p for p in os.listdir(tmp_path)
+                 if p.endswith(".tmp")]
+    del leftovers  # mkstemp leftovers are allowed; the TARGET is what
+    #                 must stay intact (reader globs *.json, not *.tmp)
+
+
+# ------------------------------------------------------------- live monitor
+
+def test_live_attach_over_real_sweep(tmp_path, capsys):
+    """Run a real (tiny, local) sweep, then attach: done cells render,
+    a missing grid cell shows as remaining with exit 1, and the full
+    grid exits 0."""
+    from tpu_aggcomm.cli import main
+    csv = str(tmp_path / "r.csv")
+    rc = main(["sweep", "-n", "8", "-a", "2", "-d", "64", "-m", "1",
+               "--backend", "local", "--comm-sizes", "2,4",
+               "--results-csv", csv])
+    capsys.readouterr()
+    assert rc == 0
+    status = sweep_status(csv, comm_sizes=[2, 4])
+    assert [c["comm"] for c in status["cells"]] == [2, 4]
+    assert all(c["status"] == "done" for c in status["cells"])
+    assert status["remaining"] == []
+    assert status["eta"]["per_cell_s"] is not None
+    assert status["eta"]["soft_budget_s"] >= 30.0   # watchdog floor
+    out = io.StringIO()
+    assert attach(csv, comm_sizes=[2, 4], out=out) == 0
+    assert "done  comm 2" in out.getvalue()
+    out = io.StringIO()
+    assert attach(csv, comm_sizes=[2, 4, 8], out=out) == 1
+    assert "remaining: 1 cell(s)" in out.getvalue()
+    assert "next comm 8" in out.getvalue()
+
+
+def test_tail_events_tolerates_torn_line(tmp_path):
+    p = tmp_path / "t.trace.jsonl"
+    p.write_text('{"ev": "run", "id": 0}\n'
+                 '{"ev": "span", "run": 0}\n'
+                 '{"ev": "instant", "na')      # torn mid-append
+    evs = tail_events(str(p))
+    assert [e["ev"] for e in evs] == ["run", "span"]
+    # trace.load_events must still refuse the same file (committed
+    # artifacts with torn lines are corrupt, not "live")
+    with pytest.raises(ValueError):
+        trace.load_events(str(p))
+
+
+# ----------------------------------------------------------- ledger RESIL
+
+def test_diff_resilience_lines():
+    a = [{"kind": "attempt", "site": "dispatch", "outcome": "retry"},
+         {"kind": "attempt", "site": "dispatch", "outcome": "ok"},
+         {"kind": "suppressed", "error_class": "TRANSIENT"}]
+    b = [{"kind": "attempt", "site": "dispatch", "outcome": "retry"},
+         {"kind": "attempt", "site": "dispatch", "outcome": "retry"},
+         {"kind": "attempt", "site": "dispatch", "outcome": "retry"},
+         {"kind": "suppressed", "error_class": "TRANSIENT"},
+         {"kind": "suppressed", "error_class": "TRANSIENT"}]
+    lines = diff_resilience(a, b)
+    assert any("retries at dispatch: 1 -> 3" in ln for ln in lines)
+    assert any("suppressed TRANSIENT errors: 1 -> 2" in ln
+               for ln in lines)
+    # identical records = no drift lines
+    assert diff_resilience(a, a) == []
+    # absent on both sides = nothing to say
+    assert diff_resilience(None, None) == []

@@ -215,6 +215,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "warning + fallback to -m on a miss or drift")
     sw.add_argument("--tune-root", default=".",
                     help="directory holding TUNE_*.json (default: .)")
+    sw.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve live OpenMetrics at http://127.0.0.1:"
+                         "PORT/metrics for the duration of the sweep "
+                         "(0 = ephemeral port, printed to stderr); "
+                         "equivalent to TPU_AGGCOMM_METRICS_PORT; OFF "
+                         "by default — no thread, no socket, no import")
     sw.add_argument("--fault", action="append", default=None,
                     metavar="SPEC",
                     help="fault scenario as an extra sweep axis "
@@ -301,7 +308,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "consecutive ones")
     ins.add_argument("what", nargs="?", choices=["trace", "compare",
                                                  "report", "ledger",
-                                                 "traffic"],
+                                                 "traffic", "live",
+                                                 "history"],
                      default=None,
                      help="'trace' to summarize *.trace.jsonl files, "
                           "'compare' to diff two of them, 'report' for "
@@ -309,8 +317,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "manifests + environment drift, 'traffic' for "
                           "the static communication-matrix / incast / "
                           "throttle-conformance audit (-m 0 sweeps every "
-                          "method as a pass/fail gate) — instead of a "
-                          "compiled schedule")
+                          "method as a pass/fail gate), 'live' to attach "
+                          "to a running sweep from another terminal "
+                          "(tails the crash-safe journal + trace JSONL, "
+                          "jax-free), 'history' for the longitudinal "
+                          "artifact index + seeded multi-round trend "
+                          "gate — instead of a compiled schedule")
     ins.add_argument("trace_file", nargs="*", default=[],
                      help="trace files: one or more to summarize "
                           "('trace'), exactly two files or directories to "
@@ -363,9 +375,28 @@ def build_parser() -> argparse.ArgumentParser:
                           "per-round effective bytes/s, fraction of the "
                           "HBM roofline, incast-vs-straggler correlation")
     ins.add_argument("--json", metavar="PATH", default=None,
-                     help="'traffic' only: also write the audit as a "
+                     help="'traffic': also write the audit as a "
                           "traffic-v1 JSON artifact (TRAFFIC_*.json is "
-                          "schema-checked by scripts/check_bench_schema.py)")
+                          "schema-checked by scripts/check_bench_schema."
+                          "py); 'history': also write the longitudinal "
+                          "history-v1 index (atomic_write)")
+    ins.add_argument("--results-csv", default="results.csv",
+                     help="'live' only: the running sweep's results CSV "
+                          "— its crash-safe journal "
+                          "(<csv>.journal.jsonl) is what gets tailed "
+                          "(default: results.csv)")
+    ins.add_argument("--follow", action="store_true",
+                     help="'live' only: keep refreshing every --interval "
+                          "seconds until the grid completes (Ctrl-C to "
+                          "detach; read-only either way)")
+    ins.add_argument("--interval", type=float, default=2.0,
+                     help="'live' --follow refresh period in seconds "
+                          "(default: 2)")
+    ins.add_argument("--comm-sizes", type=str, default=None,
+                     help="'live' only: the --comm-sizes grid the sweep "
+                          "was launched with, so remaining-cell ETA "
+                          "counts the right cells (default: the Theta "
+                          "grid)")
 
     # analyze — summarize accumulated results.csv rows
     an = sub.add_parser(
@@ -697,6 +728,7 @@ def _run_sweep(args) -> int:
                         f"{MAX_MEASURED_ROUNDS}); trim --comm-sizes or "
                         f"use --chained for the deep cells")
     import json
+    import os
     import sys
     import time
 
@@ -722,6 +754,39 @@ def _run_sweep(args) -> int:
         journal = RunJournal(_sweep_journal(args.results_csv))
         man = ledger.manifest()
         fp = journal.begin_session(man)
+    # live OpenMetrics endpoint (obs/export.py) — OFF by default: the
+    # import itself sits behind the flag/env gate, so a plain sweep
+    # never loads the telemetry code (zero-cost obs invariant). State
+    # the hot path touches is one `is not None` check per cell.
+    metrics_server = None
+    metrics_state = None
+    if getattr(args, "metrics_port", None) is not None \
+            or os.environ.get("TPU_AGGCOMM_METRICS_PORT", "").strip():
+        from tpu_aggcomm.obs import export
+        from tpu_aggcomm.obs import trace as obstrace
+        metrics_state = {"done": 0, "fail": 0, "walls": []}
+
+        def _metrics_text(state=metrics_state):
+            # built fresh per scrape: sweep progress + cell-wall
+            # histogram from the supervisor state, everything latency-
+            # shaped from the attribution cell stream when tracing is on
+            reg = export.MetricsRegistry()
+            reg.counter(f"{export.PREFIX}_sweep_cells", state["done"],
+                        status="done")
+            reg.counter(f"{export.PREFIX}_sweep_cells", state["fail"],
+                        status="fail")
+            for w in state["walls"]:
+                reg.observe(f"{export.PREFIX}_sweep_cell_wall_seconds", w)
+            if obstrace.enabled():
+                export.trace_registry(list(obstrace.current().events),
+                                      reg)
+            return reg.render()
+
+        metrics_server = export.serve_from_env(
+            _metrics_text, port=getattr(args, "metrics_port", None))
+        if metrics_server is not None:
+            print(f"# metrics endpoint: {metrics_server.url}",
+                  file=sys.stderr, flush=True)
     try:
         with _tracing(getattr(args, "trace", None)), safe_cancellation():
             for fs in faults:
@@ -790,9 +855,16 @@ def _run_sweep(args) -> int:
                                                for r in records}),
                             artifacts=[args.results_csv],
                             wall_s=time.perf_counter() - t_cell)
+                    if metrics_state is not None:
+                        metrics_state["done"] += 1
+                        metrics_state["walls"].append(
+                            time.perf_counter() - t_cell)
     except CancelledAtBoundary as e:
         print(f"sweep: {e}", file=sys.stderr)
         return 130
+    finally:
+        if metrics_server is not None:
+            metrics_server.close()
     return 0
 
 
@@ -1111,6 +1183,33 @@ def _run_inspect(args) -> int:
         except (OSError, ValueError, KeyError) as e:
             raise SystemExit(f"inspect ledger: unreadable artifact: {e}")
         return 0
+    if args.what == "live":
+        # attachable sweep monitor (obs/live.py): tails the crash-safe
+        # resilience journal + trace JSONL of a sweep running in ANOTHER
+        # process — jax-free by design, so it works while that process
+        # owns the only TPU client (or while a dead tunnel would hang
+        # `import jax` here)
+        from tpu_aggcomm.obs.live import attach
+        comm_sizes = None
+        if args.comm_sizes:
+            try:
+                comm_sizes = [int(x) for x in args.comm_sizes.split(",")
+                              if x.strip()]
+            except ValueError:
+                raise SystemExit(
+                    f"inspect live: malformed --comm-sizes "
+                    f"{args.comm_sizes!r} (want e.g. 4,8,16)")
+        return attach(args.results_csv, comm_sizes=comm_sizes,
+                      trace_paths=args.trace_file, follow=args.follow,
+                      interval=args.interval)
+    if args.what == "history":
+        from tpu_aggcomm.obs.history import (build_index, check_trends,
+                                             render_history, write_index)
+        print(render_history(args.history_root), end="")
+        if args.json:
+            path = write_index(args.json, build_index(args.history_root))
+            print(f"history index written: {path}")
+        return 0 if check_trends(args.history_root)["ok"] else 1
     if args.method is None:
         raise SystemExit("inspect: -m is required "
                          "(or use 'inspect trace <file>')")

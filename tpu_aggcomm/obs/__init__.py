@@ -28,6 +28,18 @@ The observability subsystem of the framework (ISSUE 1):
   RPC probe), per-method compile/first-dispatch telemetry, HBM peak,
   opt-in device-profiler cross-check (``--xprof``), and manifest drift
   detection across artifacts (``cli inspect ledger``).
+- :mod:`tpu_aggcomm.obs.export` — live telemetry (ISSUE 8): log-bucketed
+  latency histograms with exact quantile reconstruction, OpenMetrics
+  text rendering, and the env/flag-gated stdlib ``/metrics`` endpoint
+  (``sweep --metrics-port`` / ``TPU_AGGCOMM_METRICS_PORT``; OFF by
+  default, never imported unless armed).
+- :mod:`tpu_aggcomm.obs.live` — attachable sweep monitor: tails the
+  crash-safe resilience journal + trace JSONL of a sweep running in
+  another process, torn-line tolerant (``cli inspect live``).
+- :mod:`tpu_aggcomm.obs.history` — longitudinal history store: unified
+  artifact discovery (BENCH/MULTICHIP/TUNE/TRAFFIC/traces), per-(metric,
+  platform) time series, and the seeded multi-round trend gate
+  (``cli inspect history``; feeds ``bench.py --check-regression``).
 
 Tracing is OFF by default and zero-cost when off: ``trace.span(...)``
 returns a shared no-op context manager, and nothing here imports jax, so

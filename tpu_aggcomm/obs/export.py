@@ -1,0 +1,337 @@
+"""OpenMetrics export: latency histograms + an optional /metrics thread.
+
+The post-hoc analytics (``inspect trace``, the regression gate) answer
+"what happened"; this module answers "what is happening" — the same
+numbers, rendered in the OpenMetrics/Prometheus text format so an
+external scraper or a plain ``curl`` can watch a long-running sweep or
+capture batch live. Three pieces:
+
+- :class:`LatencyHistogram` — HDR-style log-bucketed counts for the
+  scrape-friendly cumulative view, PLUS the exact observations, so
+  quantiles are reconstructed exactly (``obs.metrics.percentile`` over
+  the retained values — the same arithmetic ``round_stats`` uses, so an
+  exported p50/p95 matches ``inspect trace`` float-for-float, never a
+  bucket-midpoint approximation). Observation counts here are
+  per-rep/per-round walls — dozens to thousands of floats — so keeping
+  them exact is cheap and honest.
+- :class:`MetricsRegistry` + :func:`trace_registry` — counters, gauges
+  and histograms rendered as OpenMetrics text. Trace-derived metrics
+  come from the attribution cell stream (``round_stats`` /
+  ``cell_means`` over recorder events) — NEVER from host callbacks;
+  the exporter reads the same events the flight recorder writes.
+- :class:`MetricsServer` / :func:`serve_from_env` — a stdlib
+  ``http.server`` thread exposing ``/metrics``. OFF by default: it
+  exists only when ``TPU_AGGCOMM_METRICS_PORT`` is set (or a CLI flag
+  passes a port), and nothing in the hot path imports this module
+  otherwise (the zero-cost obs invariant; pinned in tests). Binds
+  127.0.0.1 only — telemetry is for the operator's terminal, not the
+  network.
+
+jax-free, stdlib only (obs discipline).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+__all__ = ["LatencyHistogram", "MetricsRegistry", "MetricsServer",
+           "trace_registry", "serve_from_env", "METRICS_PORT_ENV",
+           "default_buckets", "PREFIX"]
+
+#: The env var that switches the /metrics endpoint ON (absent/empty =
+#: no server, no socket, no thread — the documented default).
+METRICS_PORT_ENV = "TPU_AGGCOMM_METRICS_PORT"
+
+#: Metric-name prefix for everything this repo exports.
+PREFIX = "tpu_aggcomm"
+
+#: Exact summary quantiles rendered beside every histogram.
+QUANTILES = (0.5, 0.95, 0.99)
+
+
+def default_buckets() -> tuple[float, ...]:
+    """HDR-style log bucket upper bounds: 5 per decade from 100 ns to
+    1000 s — wide enough for a sub-µs local rep and a tunnel-throttled
+    flagship cell on the same axis."""
+    return tuple(10.0 ** (-7 + i / 5.0) for i in range(51))
+
+
+def _fmt(v) -> str:
+    """Float formatting that round-trips exactly (``float(repr(x)) ==
+    x``) — the exported quantiles must survive parse-and-compare."""
+    if isinstance(v, float):
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        return repr(v)
+    return str(v)
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class LatencyHistogram:
+    """Log-bucketed latency histogram with exact quantile recall."""
+
+    def __init__(self, buckets: tuple[float, ...] | None = None):
+        self.bounds = tuple(buckets) if buckets else default_buckets()
+        self.counts = [0] * (len(self.bounds) + 1)   # +1: the +Inf bucket
+        self.values: list[float] = []                 # exact observations
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.values.append(v)
+        for i, b in enumerate(self.bounds):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return len(self.values)
+
+    @property
+    def sum(self) -> float:
+        return math.fsum(self.values)
+
+    def quantile(self, q: float) -> float:
+        """EXACT quantile of the observed values — the same
+        ``obs.metrics.percentile`` arithmetic ``round_stats`` uses, so
+        this matches ``inspect trace`` float-for-float."""
+        from tpu_aggcomm.obs.metrics import percentile
+        return percentile(self.values, q * 100.0)
+
+
+class MetricsRegistry:
+    """Thread-safe counter/gauge/histogram store with an OpenMetrics
+    text renderer. Samples are keyed (name, sorted label items)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = {}
+        self._gauges: dict[tuple, float] = {}
+        self._hists: dict[tuple, LatencyHistogram] = {}
+
+    @staticmethod
+    def _key(name: str, labels: dict) -> tuple:
+        return (name, tuple(sorted((str(k), str(v))
+                                   for k, v in labels.items())))
+
+    def counter(self, name: str, inc: float = 1.0, **labels) -> None:
+        key = self._key(name, labels)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + inc
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._gauges[self._key(name, labels)] = float(value)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        key = self._key(name, labels)
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = LatencyHistogram()
+            h.observe(value)
+
+    def render(self) -> str:
+        """The registry as OpenMetrics text (ends with ``# EOF``).
+
+        Histograms render the cumulative bucket view plus a sibling
+        ``<name>_exact`` summary carrying the exact quantiles — a
+        scraper gets the standard shape, a human diffing against
+        ``inspect trace`` gets the float-exact numbers."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {k: (h.bounds, list(h.counts), list(h.values))
+                     for k, h in self._hists.items()}
+        lines: list[str] = []
+        for family in sorted({name for name, _ in counters}):
+            lines.append(f"# TYPE {family} counter")
+            for (name, litems), v in sorted(counters.items()):
+                if name == family:
+                    lines.append(f"{name}_total"
+                                 f"{_labels(dict(litems))} {_fmt(v)}")
+        for family in sorted({name for name, _ in gauges}):
+            lines.append(f"# TYPE {family} gauge")
+            for (name, litems), v in sorted(gauges.items()):
+                if name == family:
+                    lines.append(f"{name}{_labels(dict(litems))} "
+                                 f"{_fmt(v)}")
+        from tpu_aggcomm.obs.metrics import percentile
+        for family in sorted({name for name, _ in hists}):
+            lines.append(f"# TYPE {family} histogram")
+            exact: list[str] = []
+            for (name, litems), (bounds, counts, values) in \
+                    sorted(hists.items()):
+                if name != family:
+                    continue
+                base = dict(litems)
+                cum = 0
+                for b, c in zip(bounds, counts):
+                    cum += c
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_labels(dict(base, le=_fmt(float(b))))} {cum}")
+                cum += counts[-1]
+                lines.append(f"{name}_bucket"
+                             f"{_labels(dict(base, le='+Inf'))} {cum}")
+                lines.append(f"{name}_count{_labels(base)} "
+                             f"{len(values)}")
+                lines.append(f"{name}_sum{_labels(base)} "
+                             f"{_fmt(math.fsum(values))}")
+                if values:
+                    for q in QUANTILES:
+                        exact.append(
+                            f"{name}_exact"
+                            f"{_labels(dict(base, quantile=_fmt(float(q))))}"
+                            f" {_fmt(percentile(values, q * 100.0))}")
+            if exact:
+                lines.append(f"# TYPE {family}_exact summary")
+                lines.extend(exact)
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+def trace_registry(events: list[dict],
+                   registry: MetricsRegistry | None = None
+                   ) -> MetricsRegistry:
+    """Fold one flight-recorder event stream into a registry.
+
+    Everything latency-shaped is derived from the attribution cell
+    stream (``round_stats`` / ``cell_means`` replaying the recorded
+    Timer arithmetic) — never from host callbacks. Per run:
+
+    - gauges ``<p>_round_{wall,p50,p95}_seconds{run,round}`` — the
+      ``round_stats`` values VERBATIM (float-exact vs ``inspect
+      trace``);
+    - histogram ``<p>_rank_round_seconds{run}`` observing every
+      per-(rank, round) mean cell — its exact summary quantiles are the
+      same percentile arithmetic over the same values;
+    - counters for resilience attempts/retries (``ledger.resilience``
+      instants) and gauges for HBM peak and peak incast depth.
+    """
+    from tpu_aggcomm.obs.metrics import cell_means, round_stats
+    reg = registry if registry is not None else MetricsRegistry()
+    runs = [e for e in events if e.get("ev") == "run"]
+    for run in runs:
+        rid = run["id"]
+        lab = {"run": rid, "method": run.get("name", "?"),
+               "backend": run.get("backend", "?")}
+        for rs in round_stats(events, rid):
+            rl = dict(lab, round=rs["round"])
+            reg.gauge(f"{PREFIX}_round_wall_seconds", rs["wall"], **rl)
+            reg.gauge(f"{PREFIX}_round_p50_seconds", rs["p50"], **rl)
+            reg.gauge(f"{PREFIX}_round_p95_seconds", rs["p95"], **rl)
+        for (_rank, _rnd), secs in sorted(cell_means(events, rid).items()):
+            reg.observe(f"{PREFIX}_rank_round_seconds", secs, **lab)
+    hbm_peak = None
+    for e in events:
+        ev = e.get("ev")
+        if ev == "hbm" and e.get("peak_bytes") is not None:
+            p = int(e["peak_bytes"])
+            hbm_peak = p if hbm_peak is None else max(hbm_peak, p)
+        elif ev == "instant" and e.get("name") == "ledger.resilience":
+            args = e.get("args") or {}
+            kind = args.get("kind", "?")
+            reg.counter(f"{PREFIX}_resilience_records",
+                        site=args.get("site", "?"), kind=kind)
+            if kind == "attempt" and args.get("outcome") == "retry":
+                reg.counter(f"{PREFIX}_retries",
+                            site=args.get("site", "?"))
+        elif ev == "counter" and e.get("name") == "traffic_max_incast":
+            reg.gauge(f"{PREFIX}_traffic_max_incast", e["value"],
+                      run=e.get("run", "?"))
+    if hbm_peak is not None:
+        reg.gauge(f"{PREFIX}_hbm_peak_bytes", hbm_peak)
+    return reg
+
+
+class MetricsServer:
+    """A daemon-thread ``http.server`` serving ``/metrics``.
+
+    ``source`` is a zero-arg callable returning the OpenMetrics text at
+    scrape time — the server holds no copy, so a scrape always sees the
+    current registry/trace state. Never constructed unless telemetry
+    was explicitly enabled (:func:`serve_from_env` or a CLI flag)."""
+
+    CONTENT_TYPE = ("application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8")
+
+    def __init__(self, source, port: int = 0, host: str = "127.0.0.1"):
+        import http.server
+
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 (stdlib API name)
+                if self.path.rstrip("/") not in ("/metrics", ""):
+                    self.send_error(404)
+                    return
+                body = server._source().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", server.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes are not stderr news
+                pass
+
+        self._source = source
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tpu-aggcomm-metrics",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def serve_from_env(source, env=None, *,
+                   port: int | None = None) -> MetricsServer | None:
+    """Start a :class:`MetricsServer` iff telemetry was asked for.
+
+    ``port`` (a CLI flag) wins; otherwise ``TPU_AGGCOMM_METRICS_PORT``
+    in ``env`` (default ``os.environ``). Absent/empty/garbage = None —
+    no socket, no thread, nothing. Port 0 binds an ephemeral port
+    (read it back from ``.port``/``.url``)."""
+    if port is None:
+        import os
+        raw = (env if env is not None else os.environ).get(
+            METRICS_PORT_ENV, "").strip()
+        if not raw:
+            return None
+        try:
+            port = int(raw)
+        except ValueError:
+            import sys
+            print(f"# telemetry: ignoring non-integer "
+                  f"{METRICS_PORT_ENV}={raw!r}", file=sys.stderr)
+            return None
+    return MetricsServer(source, port=port)

@@ -1,0 +1,392 @@
+"""Longitudinal artifact history: one index over every committed family.
+
+Every observability surface so far is pairwise or single-artifact: the
+regression gate compares the newest round against the best prior one,
+``inspect ledger`` diffs consecutive manifests, ``inspect compare``
+diffs two traces. This module is the longitudinal view those tools
+implicitly assume:
+
+- **artifact discovery** — :func:`load_history` is THE definition of
+  "the committed ``<KIND>_rNN.json`` history" (round parsing, ordering,
+  corrupt-artifact handling). It lives here so ``obs/regress.py``,
+  ``obs/report_html.py`` and ``scripts/check_bench_schema.py`` all read
+  the same file set in the same order — three private copies of the
+  scan logic is how two tools silently disagree about what round N is.
+- **index** — :func:`build_index` folds every artifact family
+  (BENCH_r*/MULTICHIP_r*/TUNE_*/TRAFFIC_*/``*.trace.jsonl``) into one
+  JSON-able longitudinal record: per-(metric, platform) bench time
+  series, multichip verdicts, tuner winners, traffic verdicts, and
+  per-(method, backend, fault) trace critical-path totals.
+  :func:`write_index` persists it through ``obs.atomic_write`` — the
+  index is evidence, and a kill mid-write must not tear it.
+- **trend gate** — :func:`trend_gate` extends the pairwise regression
+  question ("slower than the best prior round?") to the longitudinal
+  one ("is this metric drifting across the whole history?"): an OLS
+  slope over >= ``MIN_TREND_ROUNDS`` rounds, significance-tested with a
+  seeded pair-resampling bootstrap (same seed discipline as
+  ``obs/regress.py`` and ``tune --replay``: same artifacts in ⟹ same
+  verdict out). ``bench.py --check-regression`` and ``cli inspect
+  history`` both consume it.
+
+jax-free throughout (obs discipline): the supervisor, the replay CLIs
+and ``inspect history`` import this where ``import jax`` may hang on a
+dead tunnel.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import random
+import re
+import statistics
+
+__all__ = ["load_history", "build_index", "write_index", "trend_gate",
+           "check_trends", "bench_series", "render_history",
+           "MIN_TREND_ROUNDS", "TREND_TOLERANCE", "HISTORY_SCHEMA"]
+
+#: Schema tag of the persisted index artifact (versioned like
+#: TUNE_SCHEMAS / TRAFFIC_SCHEMAS: new tag = new entry, old tags stay
+#: readable forever).
+HISTORY_SCHEMA = "history-v1"
+
+#: Fewest measurable rounds in a series before a slope means anything —
+#: below this the gate reports "insufficient" instead of inventing a
+#: trend from two points (which is just the pairwise delta again).
+MIN_TREND_ROUNDS = 3
+
+#: Relative slope (fraction of the series median, per round) that
+#: counts as drift. Differenced-chain numbers jitter a few percent
+#: round-to-round; 10%/round sustained across the history is a real
+#: trajectory, not noise.
+TREND_TOLERANCE = 0.10
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+def load_history(root: str = ".", kind: str = "BENCH", *,
+                 errors: list[str] | None = None
+                 ) -> list[tuple[int, str, dict]]:
+    """All ``<kind>_rNN.json`` under ``root`` as (round, path, blob),
+    sorted by round. A missing or empty directory is an empty history,
+    not an error. Unparsable JSON raises by default — a corrupt
+    artifact should fail loudly, not vanish from the history — unless
+    the caller passes an ``errors`` list, in which case the corruption
+    is recorded there (one message per bad artifact) and the rest of
+    the history still loads: ``check_regression`` uses this so a single
+    mangled artifact yields a schema-error verdict (one JSON line,
+    nonzero exit) instead of a naked traceback."""
+    out = []
+    for path in glob.glob(os.path.join(root, f"{kind}_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                out.append((int(m.group(1)), path, json.load(fh)))
+        except ValueError as e:
+            if errors is None:
+                raise
+            errors.append(f"{os.path.basename(path)}: unparsable JSON "
+                          f"({e})")
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The longitudinal index.
+
+def bench_series(root: str = ".", *,
+                 errors: list[str] | None = None
+                 ) -> dict[str, list[dict]]:
+    """Per-(metric, platform) bench time series from the committed
+    history: ``{"<metric> | <platform>": [{"round", "value", "unit",
+    "samples_n", "compile_seconds", "hbm_peak_bytes", "file"}, ...]}``,
+    rounds ascending, unmeasurable rounds (parsed null / value null)
+    excluded — a failed round is not a data point on a latency curve."""
+    series: dict[str, list[dict]] = {}
+    for rnd, path, blob in load_history(root, "BENCH", errors=errors):
+        p = blob.get("parsed")
+        if not isinstance(p, dict) or not isinstance(
+                p.get("value"), (int, float)) or isinstance(
+                p.get("value"), bool):
+            continue
+        key = f"{p.get('metric', '?')} | {p.get('platform', 'unknown')}"
+        s = p.get("samples")
+        series.setdefault(key, []).append({
+            "round": rnd, "value": float(p["value"]),
+            "unit": p.get("unit", "s"),
+            "samples_n": len(s) if isinstance(s, list) else 0,
+            "compile_seconds": p.get("compile_seconds"),
+            "hbm_peak_bytes": p.get("hbm_peak_bytes"),
+            "file": os.path.basename(path)})
+    return series
+
+
+def _tail_jsonl(path: str) -> list[dict]:
+    """Torn-line-tolerant JSONL read (a live trace may be mid-append)."""
+    out: list[dict] = []
+    try:
+        fh = open(path)
+    except OSError:
+        return out
+    with fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def _trace_rows(root: str) -> list[dict]:
+    """One row per run of every ``*.trace.jsonl`` under ``root``: the
+    run's shape/fault identity plus the max-over-ranks critical total
+    (re-aggregated from the attribution cell stream — never a host
+    callback)."""
+    from tpu_aggcomm.obs.trace import aggregate_run
+    rows: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "*.trace.jsonl"))):
+        events = _tail_jsonl(path)
+        for run in (e for e in events if e.get("ev") == "run"):
+            agg = aggregate_run(events, run["id"])
+            total = max((a["total"] for a in agg.values()), default=None)
+            rows.append({
+                "file": os.path.basename(path), "run": run["id"],
+                "method": run.get("method"), "name": run.get("name"),
+                "backend": run.get("backend"),
+                "fault": run.get("fault"),
+                "nprocs": run.get("nprocs"),
+                "comm_size": run.get("comm_size"),
+                "critical_total_s": total})
+    return rows
+
+
+def build_index(root: str = ".") -> dict:
+    """The unified longitudinal index over every artifact family under
+    ``root``. Load errors land in ``errors`` (shown, not swallowed)."""
+    errors: list[str] = []
+    bench = bench_series(root, errors=errors)
+    multichip = [{"round": rnd, "ok": blob.get("ok"),
+                  "skipped": blob.get("skipped"),
+                  "n_devices": blob.get("n_devices"),
+                  "file": os.path.basename(path)}
+                 for rnd, path, blob in load_history(root, "MULTICHIP",
+                                                     errors=errors)]
+    tune = []
+    for path in sorted(glob.glob(os.path.join(root, "TUNE_*.json"))):
+        try:
+            with open(path) as fh:
+                blob = json.load(fh)
+        except (OSError, ValueError) as e:
+            errors.append(f"{os.path.basename(path)}: {e}")
+            continue
+        tune.append({"file": os.path.basename(path),
+                     "key": blob.get("key"),
+                     "winner": (blob.get("race") or {}).get("winner"),
+                     "batches_run": (blob.get("race") or {}).get(
+                         "batches_run")})
+    traffic = []
+    for path in sorted(glob.glob(os.path.join(root, "TRAFFIC_*.json"))):
+        try:
+            with open(path) as fh:
+                blob = json.load(fh)
+        except (OSError, ValueError) as e:
+            errors.append(f"{os.path.basename(path)}: {e}")
+            continue
+        cfg = blob.get("config") or {}
+        conf = blob.get("conformance") or {}
+        traffic.append({"file": os.path.basename(path),
+                        "method": cfg.get("method"),
+                        "fault": cfg.get("fault"),
+                        "verdict": conf.get("verdict"),
+                        "peak": conf.get("peak"),
+                        "bound": conf.get("bound")})
+    return {"schema": HISTORY_SCHEMA, "root": os.path.abspath(root),
+            "bench": bench, "multichip": multichip, "tune": tune,
+            "traffic": traffic, "traces": _trace_rows(root),
+            "errors": errors}
+
+
+def write_index(path: str, index: dict) -> str:
+    """Persist one index through ``obs.atomic_write`` (a kill mid-write
+    must leave ``path`` absent or intact, never torn)."""
+    from tpu_aggcomm.obs.atomic import atomic_write
+    with atomic_write(path) as fh:
+        json.dump(index, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# The multi-round trend gate.
+
+def _ols_slope(points: list[tuple[float, float]]) -> float | None:
+    """Least-squares slope of value vs round; None when degenerate
+    (fewer than two distinct rounds)."""
+    n = len(points)
+    if n < 2:
+        return None
+    mx = sum(p[0] for p in points) / n
+    my = sum(p[1] for p in points) / n
+    var = sum((p[0] - mx) ** 2 for p in points)
+    if var == 0:
+        return None
+    return sum((p[0] - mx) * (p[1] - my) for p in points) / var
+
+
+def trend_gate(points, *, tolerance: float = TREND_TOLERANCE,
+               n_boot: int = 2000, alpha: float = 0.05,
+               seed: int = 0, min_rounds: int = MIN_TREND_ROUNDS) -> dict:
+    """Is one (round, value) series drifting across its whole history?
+
+    The point estimate is the OLS slope normalized by the series median
+    (fraction-of-median per round; the headline metric is seconds per
+    rep, so POSITIVE slope = regressing). Significance: a seeded
+    pair-resampling bootstrap (resample the (round, value) points with
+    replacement, re-fit the slope; degenerate resamples with fewer than
+    two distinct rounds are discarded and redrawn, boundedly) gives a
+    ``1 - alpha`` CI on the relative slope — the same
+    point-beyond-tolerance AND CI-excludes-zero double gate the
+    pairwise regression check uses, so a two-round blip cannot fake a
+    trajectory. Verdicts::
+
+        insufficient   fewer than ``min_rounds`` measurable rounds
+        stable         no confirmed drift either way
+        drifting-up    slope > tolerance and CI above zero (REGRESSING)
+        drifting-down  slope < -tolerance and CI below zero (improving)
+
+    Deterministic by construction: same points + same seed ⟹ same
+    verdict byte-for-byte (regression-gate seed discipline)."""
+    pts = [(float(r), float(v)) for r, v in points]
+    out = {"verdict": "insufficient", "rounds": len(pts),
+           "slope_pct_per_round": None, "ci_pct_per_round": None,
+           "tolerance_pct": tolerance * 100.0, "seed": seed,
+           "note": None}
+    if len(pts) < min_rounds:
+        out["note"] = (f"{len(pts)} measurable round(s) < {min_rounds}; "
+                       f"trend gate inactive")
+        return out
+    med = statistics.median(v for _r, v in pts)
+    if med == 0:
+        out["note"] = "series median is zero; relative slope undefined"
+        return out
+    slope = _ols_slope(pts)
+    if slope is None:
+        out["note"] = "degenerate series (single distinct round)"
+        return out
+    rel = slope / abs(med)
+    out["slope_pct_per_round"] = rel * 100.0
+
+    rng = random.Random(seed)
+    n = len(pts)
+    slopes: list[float] = []
+    draws = 0
+    while len(slopes) < n_boot and draws < 10 * n_boot:
+        draws += 1
+        sample = [pts[rng.randrange(n)] for _ in range(n)]
+        s = _ols_slope(sample)
+        if s is not None:
+            slopes.append(s / abs(med))
+    if not slopes:
+        out["note"] = "bootstrap degenerate (no resample with two rounds)"
+        out["verdict"] = "stable"
+        return out
+    from tpu_aggcomm.obs.metrics import percentile
+    slopes.sort()
+    lo = percentile(slopes, 100.0 * (alpha / 2))
+    hi = percentile(slopes, 100.0 * (1 - alpha / 2))
+    out["ci_pct_per_round"] = [lo * 100.0, hi * 100.0]
+    if rel > tolerance and lo > 0:
+        out["verdict"] = "drifting-up"
+    elif rel < -tolerance and hi < 0:
+        out["verdict"] = "drifting-down"
+    else:
+        out["verdict"] = "stable"
+        if abs(rel) > tolerance:
+            out["note"] = ("point slope exceeds tolerance but bootstrap "
+                           "CI includes zero — not flagged")
+    return out
+
+
+def check_trends(root: str = ".", *, tolerance: float = TREND_TOLERANCE,
+                 seed: int = 0) -> dict:
+    """The trend gate over every per-(metric, platform) bench series
+    under ``root``. ``ok`` is False only on a confirmed ``drifting-up``
+    verdict — improvement and insufficient history are not failures."""
+    errors: list[str] = []
+    series = bench_series(root, errors=errors)
+    gates = {key: trend_gate([(r["round"], r["value"]) for r in rows],
+                             tolerance=tolerance, seed=seed)
+             for key, rows in sorted(series.items())}
+    return {"check": "trend", "ok": not errors and not any(
+                g["verdict"] == "drifting-up" for g in gates.values()),
+            "tolerance_pct": tolerance * 100.0, "seed": seed,
+            "series": gates, "errors": errors}
+
+
+# ---------------------------------------------------------------------------
+# Rendering (``cli inspect history``).
+
+def _fmt_val(v, unit: str) -> str:
+    return f"{v:.6g} {unit}" if isinstance(v, (int, float)) else "-"
+
+
+def render_history(root: str = ".") -> str:
+    """The ``inspect history`` text view: every bench series with its
+    trend verdict, then one summary line per other artifact family."""
+    index = build_index(root)
+    trends = check_trends(root)
+    lines: list[str] = []
+    for key, rows in sorted(index["bench"].items()):
+        gate = trends["series"].get(key, {})
+        lines.append(f"== {key} ({len(rows)} measurable rounds) ==")
+        for r in rows:
+            extras = []
+            if r["samples_n"]:
+                extras.append(f"{r['samples_n']} samples")
+            if r["compile_seconds"] is not None:
+                extras.append(f"compile {r['compile_seconds']:.3g} s")
+            ex = f"  [{', '.join(extras)}]" if extras else ""
+            lines.append(f"  r{r['round']:02d}: "
+                         f"{_fmt_val(r['value'], r['unit'])}{ex}")
+        slope = gate.get("slope_pct_per_round")
+        ci = gate.get("ci_pct_per_round")
+        detail = []
+        if slope is not None:
+            detail.append(f"slope {slope:+.1f}%/round")
+        if ci is not None:
+            detail.append(f"95% CI [{ci[0]:+.1f}%, {ci[1]:+.1f}%]")
+        detail.append(f"tolerance {gate.get('tolerance_pct', 0):.0f}%/round"
+                      f" (seed {gate.get('seed')})")
+        lines.append(f"  trend: {gate.get('verdict', '?').upper()} — "
+                     + ", ".join(detail))
+        if gate.get("note"):
+            lines.append(f"  note: {gate['note']}")
+    if not index["bench"]:
+        lines.append("no measurable bench history")
+    mc = index["multichip"]
+    if mc:
+        ok = sum(1 for m in mc if m.get("ok"))
+        lines.append(f"multichip: {len(mc)} rounds, {ok} ok, "
+                     f"{sum(1 for m in mc if m.get('skipped'))} skipped")
+    if index["tune"]:
+        winners = ", ".join(f"{t['file']}={t['winner']}"
+                            for t in index["tune"])
+        lines.append(f"tune cache: {winners}")
+    if index["traffic"]:
+        verd = ", ".join(f"{t['file']}={t['verdict']}"
+                         for t in index["traffic"])
+        lines.append(f"traffic audits: {verd}")
+    tr = index["traces"]
+    if tr:
+        faulted = sum(1 for t in tr if t.get("fault"))
+        lines.append(f"traces: {len(tr)} runs across "
+                     f"{len({t['file'] for t in tr})} files "
+                     f"({faulted} faulted)")
+    for e in index["errors"]:
+        lines.append(f"ERROR: {e}")
+    return "\n".join(lines) + "\n"

@@ -49,7 +49,8 @@ __all__ = ["SCHEMA_VERSION", "collect_manifest", "manifest",
            "record_device", "record_compile", "compile_records",
            "total_compile_seconds", "record_hbm_peak", "hbm_peak",
            "record_resilience", "resilience_records",
-           "render_resilience", "reset", "diff_manifests", "DRIFT_IGNORE",
+           "render_resilience", "diff_resilience", "reset",
+           "diff_manifests", "DRIFT_IGNORE",
            "load_ledger", "render_manifest", "render_ledgers",
            "xprof_report", "xprof_reports", "render_xprof",
            "xplane_device_seconds"]
@@ -372,11 +373,52 @@ def render_resilience(records: list[dict], indent: str = "  ") -> str:
     return "\n".join(lines) + "\n"
 
 
+def _resilience_summary(records) -> tuple[dict, dict]:
+    """(retries per site, suppressed counts per error class) for one
+    artifact's resilience records — the two tunnel-health signals worth
+    diffing round-over-round."""
+    retries: dict[str, int] = {}
+    suppressed: dict[str, int] = {}
+    for r in records or []:
+        kind = r.get("kind")
+        if kind == "attempt" and r.get("outcome") == "retry":
+            site = str(r.get("site"))
+            retries[site] = retries.get(site, 0) + 1
+        elif kind == "suppressed":
+            cls = str(r.get("error_class") or "?")
+            suppressed[cls] = suppressed.get(cls, 0) + 1
+    return retries, suppressed
+
+
+def diff_resilience(a, b) -> list[str]:
+    """Tunnel-health drift between two artifacts' resilience records:
+    one line per site whose retry count changed and per suppressed
+    error class whose count changed. Empty when both rounds look
+    equally healthy — two clean rounds add no noise to a DRIFT block;
+    a round that suddenly needed retries shows up right next to the
+    manifest drift that may explain it."""
+    ra, sa = _resilience_summary(a)
+    rb, sb = _resilience_summary(b)
+    lines: list[str] = []
+    for site in sorted(set(ra) | set(rb)):
+        if ra.get(site, 0) != rb.get(site, 0):
+            lines.append(f"retries at {site}: "
+                         f"{ra.get(site, 0)} -> {rb.get(site, 0)}")
+    for cls in sorted(set(sa) | set(sb)):
+        if sa.get(cls, 0) != sb.get(cls, 0):
+            lines.append(f"suppressed {cls} errors: "
+                         f"{sa.get(cls, 0)} -> {sb.get(cls, 0)}")
+    return lines
+
+
 def render_ledgers(paths: list[str]) -> str:
     """``inspect ledger [FILE...]``: per-artifact manifest blocks plus
     DRIFT lines between each consecutive pair that both carry a
     manifest — differing jax versions, platforms, or armed environments
-    between compared rounds must jump off the page."""
+    between compared rounds must jump off the page. The same pairwise
+    blocks carry RESIL lines (``diff_resilience``) when the rounds'
+    retry/suppression profiles differ — a tunnel-health regression
+    lands beside the environment change that may explain it."""
     entries = [load_ledger(p) for p in paths]
     lines: list[str] = []
     for ent in entries:
@@ -405,6 +447,9 @@ def render_ledgers(paths: list[str]) -> str:
                                  f"{_fmt(d['a'])} -> {_fmt(d['b'])}")
             else:
                 lines.append("  no environment drift")
+            for r in diff_resilience(prev.get("resilience"),
+                                     ent.get("resilience")):
+                lines.append(f"  RESIL {r}")
         prev = ent
     if not entries:
         lines.append("no artifacts given")

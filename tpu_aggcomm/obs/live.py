@@ -1,0 +1,221 @@
+"""Attach to a running sweep from another terminal (``inspect live``).
+
+A long sweep already leaves two crash-safe breadcrumb streams behind as
+it runs: the resilience run journal (``<results_csv>.journal.jsonl`` —
+one fsync'd line per completed cell, with its wall seconds) and, when
+``--trace`` is on, the flight-recorder JSONL. This module tails BOTH
+from a second process and renders a progress board: which (fault, comm)
+cells are done/failed/remaining, what the running process is currently
+inside (the last trace event), and a per-cell ETA built the same way
+the watchdog builds its soft deadlines — prior observed walls through
+:func:`resilience.watchdog.derive_deadline` (``floor_s=None``: the
+roofline floor path imports the jax lowerings, and this module must
+work precisely when the tunnel is busy or wedged and ``import jax``
+would hang).
+
+Read-only and torn-line tolerant throughout: the journal reader skips
+unparseable lines by contract (journal.py), and :func:`tail_events`
+does the same for trace JSONL — the writer may be mid-append at any
+moment (``trace.load_events`` raises on torn lines by design; a live
+tail cannot). NEVER imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["tail_events", "sweep_status", "render_live", "attach",
+           "THETA_COMM_SIZES"]
+
+#: The default sweep grid (cli.THETA_COMM_SIZES restated here so the
+#: monitor stays importable without the CLI module).
+THETA_COMM_SIZES = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                    4096, 8192, 999_999_999)
+
+
+def tail_events(path: str) -> list[dict]:
+    """Best-effort read of a trace JSONL that may be mid-append.
+
+    Unlike ``trace.load_events`` (which raises: a COMMITTED artifact
+    with a torn line is corrupt), a live tail skips what does not parse
+    — the torn final line is the normal case, not an error."""
+    events: list[dict] = []
+    try:
+        fh = open(path)
+    except OSError:
+        return events
+    with fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "ev" in rec:
+                events.append(rec)
+    return events
+
+
+def _cell_id(key: dict) -> tuple:
+    """(fault, comm) — the axes a sweep varies; everything else in the
+    journal key is the fixed config."""
+    return (key.get("fault"), key.get("comm"))
+
+
+def sweep_status(results_csv: str, *, comm_sizes=None,
+                 trace_paths=()) -> dict:
+    """One snapshot of a (possibly running) sweep, from its journal.
+
+    Returns ``{"journal", "cells": [...], "remaining": [...], "eta":
+    {...}, "activity": {...}}``. ``cells`` is one row per journal entry
+    (latest per (fault, comm) wins): ``{"fault", "comm", "status",
+    "wall_s"}``. ``remaining`` is the planned grid minus done cells —
+    the grid is ``comm_sizes`` when given, else the Theta default, per
+    fault axis already seen in the journal (an attacher who passed a
+    custom ``--comm-sizes`` to the sweep passes the same list here).
+    ``eta`` carries the watchdog-model estimate: per-cell point
+    estimate (median prior wall), soft budget
+    (:func:`derive_deadline` over the prior walls), and the total for
+    what remains. ``activity`` is the tail of the newest trace stream,
+    if any."""
+    from tpu_aggcomm.resilience.journal import RunJournal
+    from tpu_aggcomm.resilience.watchdog import derive_deadline
+
+    journal_path = results_csv + ".journal.jsonl"
+    latest: dict[tuple, dict] = {}
+    for rec in RunJournal(journal_path).entries():
+        key = rec.get("key") or {}
+        latest[_cell_id(key)] = {
+            "fault": key.get("fault"), "comm": key.get("comm"),
+            "status": rec.get("status"), "wall_s": rec.get("wall_s")}
+    cells = [latest[k] for k in sorted(
+        latest, key=lambda k: (str(k[0] or ""), k[1] or 0))]
+
+    grid = [int(c) for c in comm_sizes] if comm_sizes \
+        else list(THETA_COMM_SIZES)
+    faults = sorted({c["fault"] for c in cells}, key=lambda f: str(f or "")) \
+        or [None]
+    done = {(c["fault"], c["comm"]) for c in cells
+            if c["status"] == "done"}
+    remaining = [{"fault": f, "comm": c}
+                 for f in faults for c in grid if (f, c) not in done]
+
+    walls = [c["wall_s"] for c in cells
+             if c["status"] == "done"
+             and isinstance(c.get("wall_s"), (int, float))]
+    eta = {"per_cell_s": None, "soft_budget_s": None, "total_s": None,
+           "basis": len(walls)}
+    if walls:
+        ordered = sorted(walls)
+        mid = len(ordered) // 2
+        per_cell = (ordered[mid] if len(ordered) % 2
+                    else 0.5 * (ordered[mid - 1] + ordered[mid]))
+        eta["per_cell_s"] = per_cell
+        # the watchdog's deadline model over the same prior walls: the
+        # "nothing is wrong" upper bound per cell (floor_s stays None —
+        # the roofline path imports the jax lowerings, and live must
+        # run where import jax hangs)
+        eta["soft_budget_s"] = derive_deadline(floor_s=None,
+                                               prior_walls=walls)
+        eta["total_s"] = per_cell * len(remaining)
+
+    activity = None
+    newest = None
+    for p in trace_paths:
+        try:
+            mt = os.path.getmtime(p)
+        except OSError:
+            continue
+        if newest is None or mt > newest[0]:
+            newest = (mt, p)
+    if newest is not None:
+        events = tail_events(newest[1])
+        if events:
+            last = events[-1]
+            run = next((e for e in reversed(events)
+                        if e.get("ev") == "run"), None)
+            activity = {
+                "trace": newest[1], "events": len(events),
+                "age_s": max(0.0, time.time() - newest[0]),
+                "last_ev": last.get("ev"),
+                "last_name": last.get("name"),
+                "run": (run or {}).get("name"),
+                "backend": (run or {}).get("backend")}
+    return {"journal": journal_path, "cells": cells,
+            "remaining": remaining, "eta": eta, "activity": activity}
+
+
+def _fmt_s(s) -> str:
+    if s is None:
+        return "?"
+    if s >= 3600:
+        return f"{s / 3600:.1f}h"
+    if s >= 60:
+        return f"{s / 60:.1f}m"
+    return f"{s:.1f}s"
+
+
+def render_live(status: dict) -> str:
+    """The progress board as text (one ``inspect live`` frame)."""
+    lines = [f"sweep journal: {status['journal']}"]
+    cells = status["cells"]
+    if not cells:
+        lines.append("  (no journal entries yet — sweep not started, or "
+                     "started without --results-csv)")
+    for c in cells:
+        tag = f" [fault {c['fault']}]" if c["fault"] else ""
+        wall = f" ({_fmt_s(c['wall_s'])})" \
+            if isinstance(c.get("wall_s"), (int, float)) else ""
+        lines.append(f"  {c['status']:>4s}  comm {c['comm']}{wall}{tag}")
+    rem = status["remaining"]
+    eta = status["eta"]
+    lines.append(f"remaining: {len(rem)} cell(s)"
+                 + (f" — next comm {rem[0]['comm']}"
+                    + (f" [fault {rem[0]['fault']}]"
+                       if rem[0]["fault"] else "")
+                    if rem else ""))
+    if eta["per_cell_s"] is not None:
+        lines.append(
+            f"eta: ~{_fmt_s(eta['per_cell_s'])}/cell (median of "
+            f"{eta['basis']} prior wall(s)) -> ~{_fmt_s(eta['total_s'])} "
+            f"total; watchdog soft budget "
+            f"{_fmt_s(eta['soft_budget_s'])}/cell")
+    else:
+        lines.append("eta: no completed cells yet (no prior walls to "
+                     "model from)")
+    act = status["activity"]
+    if act is not None:
+        lines.append(
+            f"activity: {act['trace']} — {act['events']} events, last "
+            f"{act['last_ev']}"
+            + (f" {act['last_name']}" if act.get("last_name") else "")
+            + (f", run {act['run']} ({act['backend']})"
+               if act.get("run") else "")
+            + f", file age {_fmt_s(act['age_s'])}")
+    return "\n".join(lines)
+
+
+def attach(results_csv: str, *, comm_sizes=None, trace_paths=(),
+           follow: bool = False, interval: float = 2.0,
+           out=None) -> int:
+    """Print the progress board; with ``follow``, keep reprinting every
+    ``interval`` seconds until the grid is complete (or Ctrl-C).
+
+    Exit code 0 when every planned cell is done, 1 while work remains
+    (so a one-shot call doubles as a scriptable "is it finished?")."""
+    import sys
+    stream = out if out is not None else sys.stdout
+    while True:
+        status = sweep_status(results_csv, comm_sizes=comm_sizes,
+                              trace_paths=trace_paths)
+        print(render_live(status), file=stream, flush=True)
+        if not status["remaining"]:
+            return 0
+        if not follow:
+            return 1
+        print("--", file=stream, flush=True)
+        try:
+            time.sleep(max(float(interval), 0.2))
+        except KeyboardInterrupt:
+            return 1

@@ -18,7 +18,12 @@ form), loadable in ``ui.perfetto.dev`` or ``chrome://tracing``:
   entering flight per throttle round) plus the ``traffic_msgs`` /
   ``traffic_max_incast`` tracks (per-round message count and incast
   fan-in depth, static accounting from obs/traffic.py — args key
-  ``value``, since they count messages, not bytes).
+  ``value``, since they count messages, not bytes), plus the
+  ``latency_p50_ms`` / ``latency_p95_ms`` / ``latency_p99_ms`` tracks:
+  per-round rank-latency quantiles (obs/metrics.py over the
+  reconstructed cell means — p50/p95 are ``round_stats`` VERBATIM, p99
+  the same percentile arithmetic), one sample per (run, round) at the
+  round's first slice timestamp, so the tail shows ON the timeline.
 
 Multi-run legibility: the process names carry the backend(s) and the
 ``process_labels`` metadata lists every run (``m<id> <method name>
@@ -65,7 +70,7 @@ def to_chrome_trace(events: list[dict]) -> dict:
         _meta(HOST_PID, 1, "thread_name", "host timeline"),
         _meta(RANKS_PID, 0, "process_name", ranks_name),
         _meta(RANKS_PID, 0, "thread_name",
-              "counters (bytes_in_flight, traffic_*)"),
+              "counters (bytes_in_flight, traffic_*, latency_*)"),
     ]
     if run_labels:
         for pid in (HOST_PID, RANKS_PID):
@@ -133,6 +138,41 @@ def to_chrome_trace(events: list[dict]) -> dict:
                 "name": e["name"], "ts": e["ts"],
                 "args": {key: e["value"]}})
         # "run"/"timer"/"meta" events carry no timeline geometry
+
+    # per-round latency quantile counters: the histogram view
+    # (obs/export.py) projected onto the timeline. p50/p95 are the
+    # round_stats values VERBATIM and p99 is the same percentile
+    # arithmetic over the same per-rank cell means — derived from the
+    # attribution cell stream like every reconstructed slice, never
+    # from host callbacks. Emitted at each round's first slice
+    # timestamp so the counter sample sits where the round starts.
+    from tpu_aggcomm.obs.metrics import cell_means, percentile, round_stats
+    for rid in sorted(runs):
+        round_ts: dict = {}
+        for e in events:
+            if e["ev"] == "span" and e["run"] == rid \
+                    and e["bucket"] != "total":
+                rnd = e["round"]
+                if rnd not in round_ts or e["ts"] < round_ts[rnd]:
+                    round_ts[rnd] = e["ts"]
+        means = cell_means(events, rid)
+        for rs in round_stats(events, rid):
+            rnd = rs["round"]
+            ts = round_ts.get(rnd)
+            if ts is None:
+                continue
+            vals = sorted(s for (_rank, r), s in means.items()
+                          if r == rnd)
+            for name, v in (("latency_p50_ms", rs["p50"]),
+                            ("latency_p95_ms", rs["p95"]),
+                            ("latency_p99_ms",
+                             percentile(vals, 99.0) if vals else None)):
+                if v is None:
+                    continue
+                slices.append({
+                    "ph": "C", "pid": RANKS_PID, "tid": 0,
+                    "name": name, "ts": ts,
+                    "args": {"value": v * 1e3}})
 
     if hbm_seen:
         out.append(_meta(HOST_PID, HBM_TID, "thread_name", "hbm"))

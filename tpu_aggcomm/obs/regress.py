@@ -37,18 +37,38 @@ differenced runtimes, so the compile tolerance is wider
 reported in the verdict (informational — drift explains a delta, it is
 not itself a failure).
 
+Beyond the pairwise gate, the verdict carries a ``trend`` block: the
+seeded multi-round slope test from obs/history.py over the current
+(metric, platform) series — "is this metric drifting across the WHOLE
+history", not just "vs the best prior round". A drifting-up trend
+fails the gate like a pairwise regression does; the committed history
+is the input either way, so both verdicts are reproducible from the
+same artifacts.
+
+Artifact discovery itself (``load_history``) lives in obs/history.py —
+the ONE scanner every consumer (this module, report_html, the schema
+checker, ``inspect history``) shares, re-exported here for
+compatibility.
+
+This module also hosts the OpenMetrics text parser/validator
+(``parse_openmetrics`` / ``validate_openmetrics``) used by the CI
+telemetry gate: the text obs/export.py renders must parse, its
+histogram buckets must be cumulative with ``+Inf`` matching ``_count``,
+and its exact-quantile summaries must be internally consistent.
+
 No jax anywhere here — bench.py's supervisor process imports this.
 """
 
 from __future__ import annotations
 
-import glob
-import json
 import os
 import re
 
+from tpu_aggcomm.obs.history import load_history
+
 __all__ = ["validate_bench", "validate_multichip", "validate_tune",
            "validate_traffic", "load_history", "check_regression",
+           "parse_openmetrics", "validate_openmetrics",
            "parsed_schema_version", "DEFAULT_TOLERANCE",
            "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE", "TUNE_SCHEMAS",
            "TRAFFIC_SCHEMAS"]
@@ -407,36 +427,150 @@ def validate_traffic(obj, where: str = "TRAFFIC") -> list[str]:
     return errors
 
 
-_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+# ---------------------------------------------------------------------------
+# OpenMetrics text parsing — the CI telemetry gate's validator for what
+# obs/export.py renders. Deliberately small: it understands the subset
+# this repo emits (TYPE lines; counter/gauge/histogram/summary samples
+# with optional labels), not the full exposition grammar.
+
+_SAMPLE_RE = re.compile(
+    r'^([A-Za-z_:][A-Za-z0-9_:]*)(\{(.*)\})?\s+(\S+)\s*$')
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
 
 
-def load_history(root: str = ".", kind: str = "BENCH", *,
-                 errors: list[str] | None = None
-                 ) -> list[tuple[int, str, dict]]:
-    """All ``<kind>_rNN.json`` under ``root`` as (round, path, blob),
-    sorted by round. A missing or empty directory is an empty history,
-    not an error. Unparsable JSON raises by default — a corrupt
-    artifact should fail loudly, not vanish from the history — unless
-    the caller passes an ``errors`` list, in which case the corruption
-    is recorded there (one message per bad artifact) and the rest of
-    the history still loads: ``check_regression`` uses this so a single
-    mangled artifact yields a schema-error verdict (one JSON line,
-    nonzero exit) instead of a naked traceback."""
-    out = []
-    for path in glob.glob(os.path.join(root, f"{kind}_r*.json")):
-        m = _ROUND_RE.search(os.path.basename(path))
-        if not m:
+def _parse_value(raw: str) -> float:
+    if raw == "+Inf":
+        return float("inf")
+    if raw == "-Inf":
+        return float("-inf")
+    return float(raw)
+
+
+def parse_openmetrics(text: str) -> dict:
+    """Parse OpenMetrics text into ``{"families": {name: type},
+    "samples": [{"name", "labels", "value"}], "eof": bool}``.
+
+    Raises ``ValueError`` on a line that is neither a comment, blank,
+    TYPE declaration nor a well-formed sample — a torn or hand-mangled
+    exposition must fail loudly, not half-parse."""
+    families: dict[str, str] = {}
+    samples: list[dict] = []
+    eof = False
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if eof:
+            raise ValueError(f"line {lineno}: content after # EOF")
+        if not line.strip():
             continue
+        if line.startswith("#"):
+            parts = line.split()
+            if parts[:2] == ["#", "EOF"] and len(parts) == 2:
+                eof = True
+            elif parts[:2] == ["#", "TYPE"]:
+                if len(parts) != 4:
+                    raise ValueError(f"line {lineno}: malformed TYPE "
+                                     f"line {line!r}")
+                families[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: not a metric sample: "
+                             f"{line!r}")
+        name, _, rawlabels, rawvalue = m.groups()
+        labels = {k: v.replace('\\"', '"').replace("\\n", "\n")
+                  .replace("\\\\", "\\")
+                  for k, v in _LABEL_RE.findall(rawlabels or "")}
         try:
-            with open(path) as fh:
-                out.append((int(m.group(1)), path, json.load(fh)))
-        except ValueError as e:
-            if errors is None:
-                raise
-            errors.append(f"{os.path.basename(path)}: unparsable JSON "
-                          f"({e})")
-    out.sort(key=lambda t: t[0])
-    return out
+            value = _parse_value(rawvalue)
+        except ValueError:
+            raise ValueError(f"line {lineno}: unparseable value "
+                             f"{rawvalue!r}")
+        samples.append({"name": name, "labels": labels, "value": value})
+    return {"families": families, "samples": samples, "eof": eof}
+
+
+_SUFFIXES = ("_bucket", "_count", "_sum", "_total")
+
+
+def _family_of(name: str, families: dict) -> str | None:
+    """The declared family a sample belongs to (longest match wins:
+    ``x_exact`` summary samples must bind to the ``x_exact`` family,
+    not to histogram ``x`` via a bogus suffix split)."""
+    candidates = [name] + [name[:-len(s)] for s in _SUFFIXES
+                           if name.endswith(s)]
+    for cand in sorted(candidates, key=len, reverse=True):
+        if cand in families:
+            return cand
+    return None
+
+
+def validate_openmetrics(text: str) -> list[str]:
+    """Schema errors (empty list = valid) for an OpenMetrics exposition
+    as obs/export.py renders it: must end with ``# EOF``; every sample
+    must belong to a declared TYPE family; histogram buckets must be
+    cumulative (non-decreasing in ``le`` order) with the ``+Inf``
+    bucket equal to ``_count``; summary quantile labels must lie in
+    [0, 1]. A parse failure is a single-error verdict."""
+    try:
+        parsed = parse_openmetrics(text)
+    except ValueError as e:
+        return [f"openmetrics: {e}"]
+    errors: list[str] = []
+    if not parsed["eof"]:
+        errors.append("openmetrics: missing # EOF terminator")
+    families = parsed["families"]
+    hists: dict[tuple, dict] = {}
+    for s in parsed["samples"]:
+        fam = _family_of(s["name"], families)
+        if fam is None:
+            errors.append(f"openmetrics: sample {s['name']!r} has no "
+                          f"TYPE declaration")
+            continue
+        ftype = families[fam]
+        if ftype == "summary" and "quantile" in s["labels"]:
+            try:
+                q = float(s["labels"]["quantile"])
+            except ValueError:
+                q = -1.0
+            if not 0.0 <= q <= 1.0:
+                errors.append(f"openmetrics: {fam}: quantile label "
+                              f"{s['labels']['quantile']!r} outside "
+                              f"[0, 1]")
+        if ftype != "histogram":
+            continue
+        key = (fam, tuple(sorted((k, v) for k, v in s["labels"].items()
+                                 if k != "le")))
+        h = hists.setdefault(key, {"buckets": [], "count": None,
+                                   "sum_seen": False})
+        if s["name"] == fam + "_bucket":
+            le = s["labels"].get("le")
+            if le is None:
+                errors.append(f"openmetrics: {fam}: bucket without an "
+                              f"'le' label")
+                continue
+            h["buckets"].append((_parse_value(le), s["value"]))
+        elif s["name"] == fam + "_count":
+            h["count"] = s["value"]
+        elif s["name"] == fam + "_sum":
+            h["sum_seen"] = True
+    for (fam, labels), h in sorted(hists.items()):
+        where = f"openmetrics: {fam}{dict(labels) if labels else ''}"
+        buckets = sorted(h["buckets"])
+        if not buckets:
+            errors.append(f"{where}: histogram with no buckets")
+            continue
+        counts = [c for _le, c in buckets]
+        if any(b > a for a, b in zip(counts[1:], counts)):
+            errors.append(f"{where}: bucket counts not cumulative")
+        if buckets[-1][0] != float("inf"):
+            errors.append(f"{where}: missing le=+Inf bucket")
+        elif h["count"] is not None and buckets[-1][1] != h["count"]:
+            errors.append(f"{where}: +Inf bucket {buckets[-1][1]} != "
+                          f"_count {h['count']}")
+        if h["count"] is None:
+            errors.append(f"{where}: missing _count sample")
+        if not h["sum_seen"]:
+            errors.append(f"{where}: missing _sum sample")
+    return errors
 
 
 def _gate_samples(parsed: dict):
@@ -464,7 +598,9 @@ def check_regression(root: str = ".",
          "gate_note": str | null, "ci_delta_pct": [lo, hi] | null,
          "compile_delta_pct": float | null,
          "compile_tolerance_pct": float, "compile_note": str | null,
-         "manifest_drift": [{"key","a","b"}, ...], "history": [...]}
+         "manifest_drift": [{"key","a","b"}, ...],
+         "trend": {"verdict", "slope_pct_per_round", ...} | null,
+         "history": [...]}
 
     ``ok`` is False only when the newest measurable round regresses
     against the best prior comparable round, or when any artifact fails
@@ -476,7 +612,10 @@ def check_regression(root: str = ".",
     the point delta alone decides and ``gate_note`` records which side
     lacked samples (``gate: "point"``). No prior comparable round (or
     no measurable current round) is ok=True with delta_pct null — a
-    missing or empty history is not a regression.
+    missing or empty history is not a regression. Independently, a
+    ``drifting-up`` verdict from the longitudinal trend gate
+    (obs/history.py, seeded) over the current (metric, platform)
+    series also fails the check.
     """
     schema_errors: list[str] = []
     history = load_history(root, "BENCH", errors=schema_errors)
@@ -519,6 +658,7 @@ def check_regression(root: str = ".",
                      "compile_tolerance_pct": COMPILE_TOLERANCE * 100.0,
                      "compile_note": None,
                      "manifest_drift": [],
+                     "trend": None,
                      "history": rows}
     if schema_errors:
         verdict["ok"] = False
@@ -527,6 +667,22 @@ def check_regression(root: str = ".",
         return verdict
     cur = rows[-1]
     verdict["current"] = cur
+
+    # longitudinal trend gate (obs/history.py): the seeded bootstrap
+    # slope test over the WHOLE (metric, platform) series the current
+    # round belongs to — catches a slow creep the pairwise gate never
+    # sees (each round within tolerance of the best prior, yet the
+    # series marching up). Same determinism contract as the pairwise
+    # bootstrap: seeded, so the same artifacts reproduce the verdict.
+    from tpu_aggcomm.obs.history import trend_gate
+    series = [(r["round"], r["value"]) for r in rows
+              if r["metric"] == cur["metric"]
+              and r["platform"] == cur["platform"]]
+    trend = trend_gate(series)
+    trend["series"] = f"{cur['metric']} | {cur['platform']}"
+    verdict["trend"] = trend
+    if trend["verdict"] == "drifting-up":
+        verdict["ok"] = False
     prior = [r for r in rows[:-1]
              if r["metric"] == cur["metric"]
              and r["platform"] == cur["platform"]]

@@ -16,6 +16,10 @@ Four panels:
   platform comparison obs/regress.py exists to refuse). Rounds carrying
   per-trial ``samples`` get min/max whiskers. MULTICHIP status rides
   along as a per-round ok/skip marker row.
+- **longitudinal trend** — the seeded multi-round slope gate
+  (obs/history.py ``check_trends``) per (metric, platform) series:
+  verdict, relative slope per round and its bootstrap CI — the
+  trajectory pane's numbers, judged.
 - **run ledger** — per-round compile seconds, HBM peak, jax version and
   environment drift vs the previous manifest-carrying round
   (parsed-schema v3, obs/ledger.py); pre-v3 rounds show dashes.
@@ -45,9 +49,9 @@ from __future__ import annotations
 import json
 import os
 
+from tpu_aggcomm.obs.history import check_trends, load_history
 from tpu_aggcomm.obs.metrics import (cell_means, critical_path, round_stats,
                                      run_events)
-from tpu_aggcomm.obs.regress import load_history
 from tpu_aggcomm.obs.trace import load_events, round_key
 
 __all__ = ["write_report", "build_payload", "render_html"]
@@ -286,6 +290,7 @@ def build_payload(history_root: str = ".",
             "tune": _tune_rows(history_root),
             "runs": runs,
             "degradation": _degradation_rows(runs),
+            "trend": check_trends(history_root),
             "errors": errors}
 
 
@@ -315,6 +320,8 @@ time; lower is better everywhere (seconds per rep).</p>
 <div id="errors"></div>
 <h2>Bench trajectory (per platform)</h2>
 <div id="trajectory"></div>
+<h2>Longitudinal trend (seeded multi-round slope gate)</h2>
+<div id="trend"></div>
 <h2>Run ledger (compile / HBM / environment)</h2>
 <div id="ledger"></div>
 <h2>Autotuner cache (winner per shape)</h2>
@@ -448,6 +455,48 @@ function fmtS(v) {{
     }}).join("  ");
     host.appendChild(el("p", {{class: "note"}}, "multichip: " + mc));
   }}
+}})();
+
+(function trendPane() {{
+  var host = document.getElementById("trend");
+  var t = DATA.trend || {{}};
+  var keys = Object.keys(t.series || {{}});
+  if (!keys.length) {{
+    host.appendChild(el("p", {{class: "note"}},
+        "no bench series to trend (history too short or unmeasurable)"));
+    return;
+  }}
+  var tbl = el("table");
+  var hr = el("tr");
+  ["series", "rounds", "verdict", "slope %/round", "95% CI %/round",
+   "note"].forEach(function (h, i) {{
+    hr.appendChild(el("th", i === 0 || i === 5 ?
+                      {{class: "l"}} : {{}}, h)); }});
+  tbl.appendChild(hr);
+  keys.sort().forEach(function (k) {{
+    var g = t.series[k];
+    var tr = el("tr");
+    tr.appendChild(el("td", {{class: "l"}}, k));
+    tr.appendChild(el("td", {{}}, String(g.rounds)));
+    var vd = el("td", {{}}, g.verdict.toUpperCase());
+    if (g.verdict === "drifting-up") vd.className = "err";
+    tr.appendChild(vd);
+    tr.appendChild(el("td", {{}},
+        g.slope_pct_per_round === null ? "-" :
+        (g.slope_pct_per_round >= 0 ? "+" : "") +
+        g.slope_pct_per_round.toFixed(1)));
+    tr.appendChild(el("td", {{}}, g.ci_pct_per_round ?
+        "[" + g.ci_pct_per_round[0].toFixed(1) + ", " +
+        g.ci_pct_per_round[1].toFixed(1) + "]" : "-"));
+    tr.appendChild(el("td", {{class: "l"}}, g.note || ""));
+    tbl.appendChild(tr);
+  }});
+  host.appendChild(tbl);
+  host.appendChild(el("p", {{class: "note"}},
+      "seeded bootstrap slope over the whole per-platform series " +
+      "(seed " + t.seed + ", tolerance " + t.tolerance_pct +
+      "%/round) — the longitudinal extension of --check-regression; " +
+      "drifting-up fails the gate"));
 }})();
 
 (function ledgerPane() {{
