@@ -46,6 +46,25 @@ for m in 1 2 3; do
   python -m tpu_aggcomm.cli inspect traffic -m "$m" -n 32 -a 8 -c 4 \
     --fault "deadlink:17>2,deadagg:a3" > /dev/null || post_rc=1
 done
+# schedule model-checker gate (analysis/check.py, jax-free): every
+# method must be statically PROVEN deadlock-free, recv-slot-race-free,
+# byte-conserving, barrier-symmetric, and round-monotone — first
+# healthy, then repaired under the same committed fault spec the
+# traffic gate uses (repair refusals are SKIPPED by design: a dense
+# collective or pairwise exchange that cannot detour must refuse, not
+# silently degrade). This is the liveness complement of the -c bound:
+# ROADMAP item 2 (Mosaic round fusion) may only fuse schedules whose
+# ordering properties are machine-checked, not merely observed.
+python -m tpu_aggcomm.cli inspect check -m 0 -n 32 -a 8 -c 4 \
+  > /dev/null || post_rc=1
+python -m tpu_aggcomm.cli inspect check -m 0 -n 32 -a 8 -c 4 \
+  --fault "deadlink:17>2,deadagg:a3" > /dev/null || post_rc=1
+# codebase invariant lint (analysis/lint.py, jax-free): jax-import
+# purity of the declared-pure packages, no .lower().compile() outside
+# the sanctioned compile-only probe, no unclassified broad except, all
+# one-shot json.dump writers inside atomic_write, and no env values
+# (pool IPs) in committed artifacts — named file:line offenders.
+python scripts/lint_invariants.py || post_rc=1
 # tuned-schedule cache replay (tune/race.py, jax-free): every committed
 # TUNE_*.json must re-derive its recorded elimination order and winner
 # byte-for-byte from its own samples — an artifact that cannot reproduce

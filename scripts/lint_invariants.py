@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""CI gate: the CLAUDE.md invariants, mechanically enforced.
+
+Runs :func:`tpu_aggcomm.analysis.lint.run_lint` over the tree — jax-free
+(it must run precisely where a wedged tunnel hangs ``import jax``) — and
+exits nonzero with named file:line offenders on any violation:
+jax-import purity of the declared-pure packages, no
+``.lower().compile()`` outside the sanctioned compile-only probe, no
+unclassified broad ``except``, one-shot ``json.dump`` writers routed
+through ``obs.atomic_write``, and no env values (pool IPs) in committed
+artifacts. ci_tier1.sh runs this as a post-step.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from tpu_aggcomm.analysis.lint import render_lint, run_lint
+    offenders = run_lint()
+    sys.stdout.write(render_lint(offenders))
+    return 1 if offenders else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

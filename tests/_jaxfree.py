@@ -1,0 +1,56 @@
+"""Shared poisoned-jax subprocess harness for the per-suite jax-free pins.
+
+Seven suites (obs, traffic, tune, faults, resilience, telemetry, ledger —
+plus analysis) pin that their subsystem runs where ``import jax`` raises:
+a dead axon tunnel makes ``import jax`` HANG, and the poison turns that
+hang into an immediate, named failure so a test can assert the import
+never happens at all. The recipe used to be copy-pasted per suite; it
+now lives here, parameterized by the purity CONTRACT itself
+(``tpu_aggcomm.analysis.lint.PURE_PACKAGES``) so the static linter and
+the runtime pins can never disagree about what "jax-free" means.
+
+tests/ has no ``__init__.py`` — import this as ``import _jaxfree``
+(pytest puts each test file's directory on ``sys.path``).
+"""
+
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def poisoned_env(tmp_path, reason="declared-pure code must not import jax"):
+    """A subprocess env where ``import jax`` raises ImportError loudly.
+
+    ``tmp_path`` gains a fake ``jax`` package whose ``__init__`` raises,
+    and PYTHONPATH puts it AHEAD of the real one; the repo root rides
+    along so ``tpu_aggcomm`` stays importable from any cwd.
+    """
+    poison = tmp_path / "jax"
+    poison.mkdir(exist_ok=True)
+    (poison / "__init__.py").write_text(
+        "raise ImportError('poisoned jax: %s')\n" % reason)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
+    return env
+
+
+def pure_modules(prefix=None):
+    """Every module the linter declares jax-pure (analysis.lint's
+    PURE_PACKAGES, resolved against the tree), optionally restricted to
+    those under a dotted ``prefix``."""
+    from tpu_aggcomm.analysis.lint import pure_modules as _pure
+    mods = _pure()
+    if prefix is not None:
+        mods = [m for m in mods
+                if m == prefix or m.startswith(prefix + ".")]
+        assert mods, "no declared-pure modules under %r" % (prefix,)
+    return mods
+
+
+def pure_import_code(prefix=None):
+    """A ``python -c`` snippet importing every declared-pure module
+    (optionally just those under ``prefix``) and asserting jax never
+    loaded — the linter's rule list, executed."""
+    mods = pure_modules(prefix)
+    return ("import " + ", ".join(mods) + ", sys; "
+            "assert 'jax' not in sys.modules, 'pure module imported jax'")

@@ -279,16 +279,11 @@ def test_cli_unrepairable_fault_is_one_clean_line():
 # --------------------------------------------------- jax-free pins (sat. d)
 
 def _poisoned_env(tmp_path):
-    """A sys.path entry where ``import jax`` raises — same recipe as
-    tests/test_traffic.py."""
-    poison = tmp_path / "jax"
-    poison.mkdir()
-    (poison / "__init__.py").write_text(
-        "raise ImportError('poisoned jax: faults/spec + repair must "
-        "not import jax')\n")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
-    return env
+    """Shared recipe (tests/_jaxfree.py, parameterized by the linter's
+    purity contract)."""
+    import _jaxfree
+    return _jaxfree.poisoned_env(
+        tmp_path, "faults/spec + repair must not import jax")
 
 
 def test_repair_survives_poisoned_jax(tmp_path):

@@ -242,14 +242,11 @@ def test_load_ledger_from_trace_jsonl(tmp_path):
 def test_supervisor_surface_survives_poisoned_jax(tmp_path):
     """obs.ledger / obs.regress / obs.compare and the --check-regression
     supervisor must keep working when ``import jax`` would blow up (the
-    dead-tunnel hang, made loud)."""
-    poison = tmp_path / "jax"
-    poison.mkdir()
-    (poison / "__init__.py").write_text(
-        "raise ImportError('poisoned jax: supervisor code must not "
-        "import jax')\n")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
+    dead-tunnel hang, made loud) — shared recipe in tests/_jaxfree.py,
+    parameterized by the linter's purity contract."""
+    import _jaxfree
+    env = _jaxfree.poisoned_env(
+        tmp_path, "supervisor code must not import jax")
 
     r = subprocess.run(
         [sys.executable, "-c",

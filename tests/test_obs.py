@@ -73,19 +73,21 @@ def test_disabled_tracing_is_noop():
     assert trace.flush("/nonexistent/prefix") is None
 
 
-def test_obs_package_imports_no_jax():
+def test_obs_package_imports_no_jax(tmp_path):
     """bench.py's supervisor process is deliberately jax-free (a dead
-    tunnel hangs ``import jax``); obs must stay importable there."""
+    tunnel hangs ``import jax``); obs must stay importable there. The
+    module list comes from the linter's purity contract (tests/_jaxfree
+    over analysis.lint.PURE_PACKAGES), so a NEW obs module is pinned
+    here the moment it exists — no hand-maintained import list to rot —
+    and the poisoned env makes any jax import raise instead of hang."""
+    import _jaxfree
+    mods = _jaxfree.pure_modules("tpu_aggcomm.obs")
+    assert "tpu_aggcomm.obs.traffic" in mods      # the list is real
     r = subprocess.run(
-        [sys.executable, "-c",
-         "import tpu_aggcomm.obs, tpu_aggcomm.obs.regress, "
-         "tpu_aggcomm.obs.metrics, tpu_aggcomm.obs.compare, "
-         "tpu_aggcomm.obs.report_html, tpu_aggcomm.obs.perfetto, "
-         "tpu_aggcomm.obs.ledger, tpu_aggcomm.obs.traffic, "
-         "tpu_aggcomm.obs.export, tpu_aggcomm.obs.live, "
-         "tpu_aggcomm.obs.history, sys; "
-         "assert 'jax' not in sys.modules, 'obs imported jax'"],
-        cwd=REPO, capture_output=True, text=True, timeout=120)
+        [sys.executable, "-c", _jaxfree.pure_import_code("tpu_aggcomm.obs")],
+        cwd=REPO, env=_jaxfree.poisoned_env(tmp_path, "obs must not "
+                                            "import jax"),
+        capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr
 
 

@@ -359,14 +359,11 @@ def test_validate_bench_types_resilience():
 # ------------------------------------------------------- jax-free pins
 
 def _poisoned_env(tmp_path):
-    poison = tmp_path / "jax"
-    poison.mkdir()
-    (poison / "__init__.py").write_text(
-        "raise ImportError('poisoned jax: resilience core must not "
-        "import jax')\n")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
-    return env
+    """Shared recipe (tests/_jaxfree.py, parameterized by the linter's
+    purity contract)."""
+    import _jaxfree
+    return _jaxfree.poisoned_env(
+        tmp_path, "resilience core must not import jax")
 
 
 def test_resilience_core_survives_poisoned_jax(tmp_path):

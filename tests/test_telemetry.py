@@ -48,17 +48,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _poisoned_env(tmp_path):
-    """A sys.path entry where ``import jax`` raises (the traffic/tune
-    recipe): the telemetry pipeline must run on a host whose tunnel is
-    wedged so badly that importing jax would hang forever."""
-    poison = tmp_path / "jax"
-    poison.mkdir()
-    (poison / "__init__.py").write_text(
-        "raise ImportError('poisoned jax: telemetry must not import "
-        "jax')\n")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
-    return env
+    """Shared recipe (tests/_jaxfree.py, parameterized by the linter's
+    purity contract): the telemetry pipeline must run on a host whose
+    tunnel is wedged so badly that importing jax would hang forever."""
+    import _jaxfree
+    return _jaxfree.poisoned_env(tmp_path,
+                                 "telemetry must not import jax")
 
 
 def _traced_run(prefix, **kw):

@@ -50,16 +50,11 @@ def _pattern(nprocs=8, cb_nodes=2, data_size=64, comm_size=2,
 # ------------------------------------------------------------- jax-free pin
 
 def _poisoned_env(tmp_path):
-    """A sys.path entry where ``import jax`` raises — the audit must not
-    even try (same recipe as tests/test_tune.py's --replay pin)."""
-    poison = tmp_path / "jax"
-    poison.mkdir()
-    (poison / "__init__.py").write_text(
-        "raise ImportError('poisoned jax: the traffic auditor must not "
-        "import jax')\n")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
-    return env
+    """Shared recipe (tests/_jaxfree.py, parameterized by the linter's
+    purity contract) — the audit must not even try to import jax."""
+    import _jaxfree
+    return _jaxfree.poisoned_env(
+        tmp_path, "the traffic auditor must not import jax")
 
 
 def test_audit_survives_poisoned_jax(tmp_path):

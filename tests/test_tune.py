@@ -351,14 +351,11 @@ class TestCli:
 
     def test_replay_survives_poisoned_jax(self, tmp_path):
         """The tier-1 replay step must run where jax cannot import —
-        same poisoning recipe as the ledger supervisor test."""
-        poison = tmp_path / "jax"
-        poison.mkdir()
-        (poison / "__init__.py").write_text(
-            "raise ImportError('poisoned jax: tune --replay must not "
-            "import jax')\n")
-        env = dict(os.environ)
-        env["PYTHONPATH"] = str(tmp_path) + os.pathsep + REPO
+        shared recipe (tests/_jaxfree.py, parameterized by the linter's
+        purity contract)."""
+        import _jaxfree
+        env = _jaxfree.poisoned_env(
+            tmp_path, "tune --replay must not import jax")
         r = subprocess.run(
             [sys.executable, "-m", "tpu_aggcomm.cli", "tune", "--replay",
              COMMITTED_TUNE],

@@ -1,0 +1,45 @@
+"""Static analysis: schedule model checking + codebase invariant linting.
+
+Two halves, both jax-free (obs discipline — everything here must run
+where ``import jax`` may hang on a dead tunnel):
+
+- :mod:`tpu_aggcomm.analysis.check` — a symbolic per-rank executor over
+  ``Schedule.programs`` that builds the waits-for event graph (blocking
+  SEND/RECV, ISSEND rendezvous coupling, WAITALL token subsets, BARRIER
+  joins) and PROVES, or REFUTES with a named witness: deadlock-freedom
+  (acyclicity — the offending cycle is named), recv-slot race-freedom
+  (no two in-flight writes to one (rank, row) between matching
+  WAITALLs), byte conservation (per-edge sends == recvs, cross-checked
+  against ``obs/traffic.py`` matrices and the pattern's expected
+  coverage), barrier SPMD symmetry, and round-fence monotonicity — for
+  healthy AND fault-repaired schedules. Surfaced as
+  ``cli inspect check`` (``-m 0`` sweeps every method as the ci_tier1
+  gate). The properties mirror ``backends/local.py`` semantics exactly
+  (SEND modeled eager, ISSEND rendezvous, generation-matched barriers),
+  so a static REFUTED agrees with a runtime ``DeadlockError`` /
+  ``VerificationError`` — tests/test_analysis.py pins that agreement
+  per defect class.
+- :mod:`tpu_aggcomm.analysis.lint` — an AST/import-graph linter that
+  mechanically enforces the CLAUDE.md invariants: jax-import purity of
+  the declared-pure module set (``PURE_PACKAGES``/``pure_modules`` —
+  the one derived rule list the poisoned-jax subprocess pins
+  parameterize from), no ``.lower().compile()``, no broad ``except``
+  outside pragma-classified sites, one-shot JSON artifact writers
+  routed through ``obs.atomic_write``, and no env *values* (pool IPs)
+  in any committed JSON artifact. ``scripts/lint_invariants.py`` runs
+  it as the ci_tier1 gate, naming file:line offenders.
+
+The motivating consumer is ROADMAP item 2 (Schedule→Mosaic fusion):
+removing the ``optimization_barrier`` round fences is only safe against
+schedules whose ordering properties are machine-checked, not merely
+observed by the oracle at one shape.
+"""
+
+from tpu_aggcomm.analysis.check import (CheckError, check_schedule,
+                                        check_sweep, render_check,
+                                        render_check_sweep)
+from tpu_aggcomm.analysis.lint import PURE_PACKAGES, pure_modules, run_lint
+
+__all__ = ["CheckError", "check_schedule", "check_sweep", "render_check",
+           "render_check_sweep", "PURE_PACKAGES", "pure_modules",
+           "run_lint"]

@@ -1,0 +1,421 @@
+"""Codebase invariant linter: the CLAUDE.md rules, mechanically checked.
+
+Every rule here used to live only in prose (CLAUDE.md "Invariants to
+preserve") plus scattered per-feature subprocess tests. This module is
+the ONE derived rule set — the poisoned-jax test pins parameterize from
+:func:`pure_modules`, and ``scripts/lint_invariants.py`` runs
+:func:`run_lint` as a ci_tier1 gate with named file:line offenders.
+
+Rules:
+
+1. **jax-import purity** — the declared-pure packages (obs, faults,
+   resilience, analysis, core, and tune minus ``tune/measure.py``) must
+   not reach ``jax``/``jaxlib`` through their module-level import
+   closure. Function-level lazy imports are exempt by construction (the
+   AST walk skips function bodies) — that is exactly the pattern the
+   tree uses to defer jax. This is the static twin of the poisoned-jax
+   subprocess pins: the linter proves no import path exists, the
+   subprocess proves the interpreter agrees.
+2. **no ``.lower().compile()``** — the AOT path does not share the jit
+   cache and would double-compile through the tunnel (CLAUDE.md ledger
+   invariant). Anywhere in the scan scope. The ONE sanctioned use is a
+   compile-only acceptance probe that never dispatches (CLAUDE.md says
+   to probe compile-only first) — such a site carries a
+   ``# lint: aot-ok (reason)`` pragma.
+3. **no broad ``except``** — bare ``except:`` / ``except Exception`` /
+   ``except BaseException`` is banned unless the line carries a
+   ``# lint: broad-ok (reason)`` pragma: unclassified swallowing is how
+   a PROGRAM error gets retried as if TRANSIENT. The pragma is the
+   classification.
+4. **atomic artifact writes** — every ``json.dump`` call must sit
+   lexically inside ``with atomic_write(...)`` (obs/atomic.py itself
+   exempt): a one-shot artifact written with a plain ``open`` can tear
+   on a mid-write kill. Append-mode journals use ``write(json.dumps +
+   "\\n")`` line appends, which this rule deliberately does not match.
+5. **no env values in committed artifacts** — committed JSON/JSONL
+   artifacts must not contain dotted-quad IPs, and when
+   ``PALLAS_AXON_POOL_IPS`` is set in the linting environment its
+   values must not appear anywhere in them (the ledger records env vars
+   by NAME only).
+
+Scan scope for rules 2-4: ``tpu_aggcomm/``, ``scripts/``, ``bench.py``,
+``__graft_entry__.py``. tests/ are exempt (they deliberately seed
+violations to prove the linter catches them).
+
+jax-free by the same discipline it enforces (and it enforces it on
+itself: ``analysis`` is in :data:`PURE_PACKAGES`).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+__all__ = ["PURE_PACKAGES", "BROAD_OK_PRAGMA", "pure_modules",
+           "module_import_closure", "run_lint", "render_lint"]
+
+#: package (under tpu_aggcomm/) -> module stems excluded from the purity
+#: rule. tune/measure.py is THE one declared jax importer among the pure
+#: packages (tune/__init__.py documents it).
+PURE_PACKAGES: dict = {
+    "core": (),
+    "obs": (),
+    "faults": (),
+    "resilience": (),
+    "analysis": (),
+    "tune": ("measure",),
+}
+
+BROAD_OK_PRAGMA = "# lint: broad-ok"
+AOT_OK_PRAGMA = "# lint: aot-ok"
+
+_JAX_ROOTS = ("jax", "jaxlib")
+
+#: committed artifact globs (repo root) for rule 5
+_ARTIFACT_GLOBS = ("BENCH_r*.json", "MULTICHIP_r*.json", "TUNE_*.json",
+                   "TRAFFIC_*.json", "*.trace.json", "*.trace.jsonl",
+                   "BASELINE.json")
+
+_IPV4 = re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b")
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _scan_files(root: str) -> list:
+    """Python files under the lint scope, repo-relative, sorted."""
+    out = []
+    for sub in ("tpu_aggcomm", "scripts"):
+        for dirpath, dirnames, filenames in os.walk(os.path.join(root, sub)):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    out.append(os.path.relpath(os.path.join(dirpath, f),
+                                               root))
+    for f in ("bench.py", "__graft_entry__.py"):
+        if os.path.exists(os.path.join(root, f)):
+            out.append(f)
+    return sorted(out)
+
+
+def _parse(root: str, relpath: str):
+    with open(os.path.join(root, relpath), encoding="utf-8") as fh:
+        src = fh.read()
+    return src, ast.parse(src, filename=relpath)
+
+
+# ---------------------------------------------------------------------------
+# Rule 1: jax-import purity
+
+def _module_name(relpath: str) -> str:
+    """tpu_aggcomm/obs/traffic.py -> tpu_aggcomm.obs.traffic;
+    package __init__ maps to the package name itself."""
+    parts = relpath[:-3].split(os.sep)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _toplevel_imports(tree) -> list:
+    """Module-level imported names (with line numbers), skipping
+    function bodies — a lazy in-function import is the sanctioned way
+    to defer jax, so it must not count against the importer."""
+    out = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Import):
+                for a in child.names:
+                    out.append((a.name, child.lineno))
+            elif isinstance(child, ast.ImportFrom):
+                if child.module and child.level == 0:
+                    base = child.module
+                    out.append((base, child.lineno))
+                    for a in child.names:
+                        # `from pkg import sub` may bind a submodule:
+                        # record the candidate; the resolver keeps it
+                        # only if such a module exists
+                        out.append((f"{base}.{a.name}", child.lineno))
+            else:
+                walk(child)
+
+    walk(tree)
+    return out
+
+
+def _project_modules(root: str) -> dict:
+    """module name -> relpath for every module under tpu_aggcomm/."""
+    mods = {}
+    for rel in _scan_files(root):
+        if rel.split(os.sep)[0] == "tpu_aggcomm":
+            mods[_module_name(rel)] = rel
+    return mods
+
+
+def pure_modules(root: str | None = None) -> list:
+    """The modules the purity rule covers, as importable dotted names —
+    the single source the poisoned-jax subprocess pins (tests/_jaxfree.py)
+    parameterize from."""
+    root = root or _repo_root()
+    mods = _project_modules(root)
+    out = []
+    for name in sorted(mods):
+        parts = name.split(".")
+        if len(parts) < 2 or parts[0] != "tpu_aggcomm":
+            continue
+        pkg = parts[1]
+        if pkg not in PURE_PACKAGES:
+            continue
+        if len(parts) > 2 and parts[2] in PURE_PACKAGES[pkg]:
+            continue
+        out.append(name)
+    return out
+
+
+def module_import_closure(root: str | None = None) -> dict:
+    """module -> (direct deps, direct external roots, lines) for every
+    project module, from module-level imports only. Importing a
+    submodule also executes its ancestor package __init__s — those are
+    edges too."""
+    root = root or _repo_root()
+    mods = _project_modules(root)
+    graph = {}
+    for name, rel in mods.items():
+        _src, tree = _parse(root, rel)
+        deps = set()
+        externals = {}
+        for imp, lineno in _toplevel_imports(tree):
+            top = imp.split(".")[0]
+            if top == "tpu_aggcomm":
+                target = imp
+                while target and target not in mods:
+                    target = target.rsplit(".", 1)[0] if "." in target else ""
+                if target:
+                    parts = target.split(".")
+                    for k in range(1, len(parts) + 1):
+                        anc = ".".join(parts[:k])
+                        if anc in mods and anc != name:
+                            deps.add(anc)
+            elif top in _JAX_ROOTS:
+                externals.setdefault(top, lineno)
+        graph[name] = (deps, externals, rel)
+    return graph
+
+
+def check_purity(root: str | None = None) -> list:
+    root = root or _repo_root()
+    graph = module_import_closure(root)
+    offenders = []
+    memo: dict = {}
+
+    def reaches_jax(name, stack=()):
+        """First (module, jax_root, line) reachable from name, or None."""
+        if name in memo:
+            return memo[name]
+        if name in stack:
+            return None  # cycle: resolved by the other frames
+        deps, externals, _rel = graph[name]
+        hit = None
+        if externals:
+            top, lineno = sorted(externals.items())[0]
+            hit = (name, top, lineno)
+        else:
+            for dep in sorted(deps):
+                sub = reaches_jax(dep, stack + (name,))
+                if sub:
+                    hit = sub
+                    break
+        memo[name] = hit
+        return hit
+
+    for name in pure_modules(root):
+        hit = reaches_jax(name)
+        if hit:
+            via_mod, jax_root, lineno = hit
+            via = ("directly" if via_mod == name
+                   else f"via {via_mod}")
+            offenders.append({
+                "rule": "jax-purity",
+                "file": graph[via_mod][2], "line": lineno,
+                "detail": f"declared-pure module {name} reaches "
+                          f"'{jax_root}' at module level {via} "
+                          f"({graph[via_mod][2]}:{lineno}) — lazy "
+                          f"function-level import required"})
+    # dedupe: many pure modules funnel through one bad import site
+    seen = set()
+    uniq = []
+    for o in offenders:
+        key = (o["file"], o["line"])
+        if key not in seen:
+            seen.add(key)
+            uniq.append(o)
+    return uniq
+
+
+# ---------------------------------------------------------------------------
+# Rules 2-4: per-file AST rules
+
+def check_file_rules(root: str | None = None) -> list:
+    root = root or _repo_root()
+    offenders = []
+    for rel in _scan_files(root):
+        src, tree = _parse(root, rel)
+        srclines = src.splitlines()
+
+        # rule 2: .lower().compile()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "compile"
+                    and isinstance(node.func.value, ast.Call)
+                    and isinstance(node.func.value.func, ast.Attribute)
+                    and node.func.value.func.attr == "lower"):
+                if AOT_OK_PRAGMA in srclines[node.lineno - 1]:
+                    continue
+                offenders.append({
+                    "rule": "aot-compile", "file": rel, "line": node.lineno,
+                    "detail": ".lower().compile() double-compiles through "
+                              "the tunnel (AOT path does not share the "
+                              "jit cache) — use plain jit dispatch and "
+                              "time host boundaries"})
+
+        # rule 3: broad except without the classification pragma
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            names = []
+            t = node.type
+            if t is None:
+                names = ["<bare>"]
+            elif isinstance(t, ast.Name):
+                names = [t.id]
+            elif isinstance(t, ast.Tuple):
+                names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+            broad = [x for x in names
+                     if x in ("<bare>", "Exception", "BaseException")]
+            if not broad:
+                continue
+            line = srclines[node.lineno - 1]
+            if BROAD_OK_PRAGMA in line:
+                continue
+            offenders.append({
+                "rule": "broad-except", "file": rel, "line": node.lineno,
+                "detail": f"except {broad[0]} without a "
+                          f"'{BROAD_OK_PRAGMA} (reason)' pragma — "
+                          f"unclassified swallowing retries PROGRAM "
+                          f"errors as if TRANSIENT; classify or narrow"})
+
+        # rule 4: json.dump outside atomic_write
+        if rel == os.path.join("tpu_aggcomm", "obs", "atomic.py"):
+            continue
+
+        def with_uses_atomic(w) -> bool:
+            for item in w.items:
+                cx = item.context_expr
+                if isinstance(cx, ast.Call):
+                    f = cx.func
+                    if (isinstance(f, ast.Name) and f.id == "atomic_write") \
+                            or (isinstance(f, ast.Attribute)
+                                and f.attr == "atomic_write"):
+                        return True
+            return False
+
+        def walk_dump(node, inside):
+            for child in ast.iter_child_nodes(node):
+                now = inside
+                if isinstance(child, ast.With) and with_uses_atomic(child):
+                    now = True
+                if (isinstance(child, ast.Call)
+                        and isinstance(child.func, ast.Attribute)
+                        and child.func.attr == "dump"
+                        and isinstance(child.func.value, ast.Name)
+                        and child.func.value.id == "json"
+                        and not now):
+                    offenders.append({
+                        "rule": "atomic-artifact", "file": rel,
+                        "line": child.lineno,
+                        "detail": "json.dump outside 'with "
+                                  "atomic_write(...)' — a kill mid-write "
+                                  "tears the artifact; route one-shot "
+                                  "writers through obs.atomic_write "
+                                  "(append-mode journals use line-append "
+                                  "write(json.dumps...))"})
+                walk_dump(child, now)
+
+        walk_dump(tree, False)
+    return offenders
+
+
+# ---------------------------------------------------------------------------
+# Rule 5: committed artifacts carry no env values
+
+def check_artifacts(root: str | None = None) -> list:
+    import glob
+
+    root = root or _repo_root()
+    offenders = []
+    pool = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    pool_vals = [v for v in re.split(r"[,\s;]+", pool) if v]
+    files = []
+    for pat in _ARTIFACT_GLOBS:
+        files.extend(glob.glob(os.path.join(root, pat)))
+    for path in sorted(set(files)):
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                for lineno, line in enumerate(fh, 1):
+                    m = _IPV4.search(line)
+                    if m:
+                        offenders.append({
+                            "rule": "artifact-env", "file": rel,
+                            "line": lineno,
+                            "detail": f"dotted-quad address "
+                                      f"'{m.group(0)}' in a committed "
+                                      f"artifact — env values (pool IPs) "
+                                      f"must never be recorded; the "
+                                      f"ledger stores env var NAMES only"})
+                    for v in pool_vals:
+                        if v in line:
+                            offenders.append({
+                                "rule": "artifact-env", "file": rel,
+                                "line": lineno,
+                                "detail": "a PALLAS_AXON_POOL_IPS value "
+                                          "appears in a committed "
+                                          "artifact (value withheld)"})
+        except OSError as e:
+            offenders.append({"rule": "artifact-env", "file": rel,
+                              "line": 0, "detail": f"unreadable: {e}"})
+    return offenders
+
+
+# ---------------------------------------------------------------------------
+
+def run_lint(root: str | None = None) -> list:
+    """All rules over the tree: list of offender dicts
+    ``{"rule", "file", "line", "detail"}``, empty = clean."""
+    root = root or _repo_root()
+    out = []
+    out.extend(check_purity(root))
+    out.extend(check_file_rules(root))
+    out.extend(check_artifacts(root))
+    return sorted(out, key=lambda o: (o["rule"], o["file"], o["line"]))
+
+
+def render_lint(offenders: list, root: str | None = None) -> str:
+    n_mods = len(pure_modules(root))
+    if not offenders:
+        return (f"invariant lint: clean ({n_mods} declared-pure modules, "
+                f"{len(PURE_PACKAGES)} packages; rules: jax-purity, "
+                f"aot-compile, broad-except, atomic-artifact, "
+                f"artifact-env)\n")
+    lines = [f"invariant lint: {len(offenders)} offender(s)"]
+    for o in offenders:
+        lines.append(f"  {o['file']}:{o['line']}: [{o['rule']}] "
+                     f"{o['detail']}")
+    return "\n".join(lines) + "\n"

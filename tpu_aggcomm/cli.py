@@ -308,8 +308,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "consecutive ones")
     ins.add_argument("what", nargs="?", choices=["trace", "compare",
                                                  "report", "ledger",
-                                                 "traffic", "live",
-                                                 "history"],
+                                                 "traffic", "check",
+                                                 "live", "history"],
                      default=None,
                      help="'trace' to summarize *.trace.jsonl files, "
                           "'compare' to diff two of them, 'report' for "
@@ -317,7 +317,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "manifests + environment drift, 'traffic' for "
                           "the static communication-matrix / incast / "
                           "throttle-conformance audit (-m 0 sweeps every "
-                          "method as a pass/fail gate), 'live' to attach "
+                          "method as a pass/fail gate), 'check' for the "
+                          "schedule model checker (analysis/check.py, "
+                          "jax-free): deadlock-freedom, recv-slot "
+                          "race-freedom, byte conservation, barrier "
+                          "symmetry, round monotonicity — PROVEN or "
+                          "REFUTED with a named witness (-m 0 sweeps "
+                          "every method as a gate), 'live' to attach "
                           "to a running sweep from another terminal "
                           "(tails the crash-safe journal + trace JSONL, "
                           "jax-free), 'history' for the longitudinal "
@@ -340,10 +346,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "faulted+repaired); the delta is reported as a "
                           "RECOVERY delta naming both specs")
     ins.add_argument("--fault", metavar="SPEC", default=None,
-                     help="'traffic' only: audit the FAULT-REPAIRED "
-                          "schedule (faults/repair.py) instead of the "
-                          "healthy one — the static re-proof that the "
-                          "relay detour still honors the -c bound")
+                     help="'traffic'/'check' only: audit or model-check "
+                          "the FAULT-REPAIRED schedule (faults/repair.py) "
+                          "instead of the healthy one — the static "
+                          "re-proof that the relay detour still honors "
+                          "the -c bound / stays deadlock-free; 'check' "
+                          "-m 0 sweeps every repairable method under the "
+                          "spec (repair refusals are SKIPPED, not failed)")
     ins.add_argument("--out", default="report.html",
                      help="output path for 'inspect report' "
                           "(default: report.html)")
@@ -378,7 +387,8 @@ def build_parser() -> argparse.ArgumentParser:
                      help="'traffic': also write the audit as a "
                           "traffic-v1 JSON artifact (TRAFFIC_*.json is "
                           "schema-checked by scripts/check_bench_schema."
-                          "py); 'history': also write the longitudinal "
+                          "py); 'check': write the check-v1 report; "
+                          "'history': also write the longitudinal "
                           "history-v1 index (atomic_write)")
     ins.add_argument("--results-csv", default="results.csv",
                      help="'live' only: the running sweep's results CSV "
@@ -1027,7 +1037,7 @@ def _resolve_auto(args, nprocs: int, *, sweep: bool = False) -> None:
         try:
             from tpu_aggcomm.tune.measure import record_device_facts
             record_device_facts()
-        except Exception:
+        except Exception:  # lint: broad-ok (device-facts cache is advisory)
             pass
     man = manifest()
     key = cache.tune_key(nprocs=nprocs, data_size=args.data_size,
@@ -1117,6 +1127,61 @@ def _run_inspect_traffic(args) -> int:
     return 1 if audit["conformance"]["verdict"] == "REFUTED" else 0
 
 
+def _run_inspect_check(args) -> int:
+    """Schedule model checker (analysis/check.py, jax-free): prove
+    deadlock-freedom, recv-slot race-freedom, byte conservation, barrier
+    SPMD symmetry, and round-fence monotonicity from the compiled op
+    programs alone. ``-m 0`` sweeps every method in METHODS as a
+    pass/fail gate (scripts/ci_tier1.sh runs exactly that, healthy and
+    under the committed fault spec); ``--fault SPEC`` checks the
+    REPAIRED schedule — the liveness complement of the traffic
+    auditor's -c re-proof."""
+    from tpu_aggcomm.analysis import check as ck
+
+    if args.method is None:
+        raise SystemExit("inspect check: -m is required "
+                         "(-m 0 sweeps every method as a gate)")
+    if args.method == 0:
+        if args.json or args.trace:
+            raise SystemExit("inspect check: --json/--trace apply to a "
+                             "single-method check, not the -m 0 sweep")
+        rows = ck.check_sweep(
+            args.nprocs, args.cb_nodes, args.comm_size,
+            data_size=args.data_size, proc_node=args.proc_node,
+            agg_type=args.agg_type, fault=args.fault,
+            barrier_type=args.barrier_type)
+        print(ck.render_check_sweep(rows, args.nprocs, args.cb_nodes,
+                                    args.comm_size, fault=args.fault),
+              end="")
+        return 1 if any(r["verdict"] == "REFUTED" for r in rows) else 0
+
+    from tpu_aggcomm.core.methods import METHODS, compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+
+    if args.method not in METHODS:
+        raise SystemExit(f"inspect check: unknown method {args.method} "
+                         f"(known: {sorted(METHODS)})")
+    p = AggregatorPattern(
+        nprocs=args.nprocs, cb_nodes=args.cb_nodes,
+        data_size=args.data_size, placement=args.agg_type,
+        proc_node=args.proc_node, comm_size=args.comm_size)
+    sched = compile_method(args.method, p, barrier_type=args.barrier_type)
+    if args.fault:
+        from tpu_aggcomm.faults import (FaultSpecError, RepairError,
+                                        repair_schedule)
+        try:
+            sched = repair_schedule(sched, args.fault,
+                                    barrier_type=args.barrier_type)
+        except (FaultSpecError, RepairError) as e:
+            raise SystemExit(f"inspect check --fault: {e}")
+    report = ck.check_schedule(sched)
+    print(ck.render_check(report), end="")
+    if args.json:
+        path = ck.write_artifact(args.json, report)
+        print(f"check artifact written: {path}")
+    return 1 if report["verdict"] == "REFUTED" else 0
+
+
 def _run_inspect(args) -> int:
     """Schedule-shape report: what the -c/-m/-t choices actually compile
     to. This is the question the per-phase timers approximate at runtime,
@@ -1160,6 +1225,8 @@ def _run_inspect(args) -> int:
         return 0
     if args.what == "traffic":
         return _run_inspect_traffic(args)
+    if args.what == "check":
+        return _run_inspect_check(args)
     if args.what == "report":
         from tpu_aggcomm.obs.report_html import write_report
         path = write_report(args.out, history_root=args.history_root,

@@ -36,18 +36,59 @@ import numpy as np
 
 from tpu_aggcomm.core.pattern import AggregatorPattern
 
-__all__ = ["OpKind", "Op", "Schedule", "TimerBucket", "barrier_rounds_of",
+__all__ = ["OpKind", "Op", "Schedule", "TimerBucket",
+           "ScheduleAsymmetryError", "barrier_signatures",
+           "check_barrier_symmetry", "barrier_rounds_of",
            "schedule_shape_key"]
 
 
+class ScheduleAsymmetryError(AssertionError):
+    """The schedule's barrier structure differs across ranks.
+
+    Rank 0's barrier signature stands in for every rank's in
+    :func:`barrier_rounds_of` and :func:`schedule_shape_key` — an
+    asymmetric schedule would deadlock at runtime (generation-matched
+    n-rank joins) AND alias cache entries it must not share, so both
+    entry points refuse it instead of assuming symmetry.
+    """
+
+
+def barrier_signatures(schedule) -> list:
+    """Per-rank barrier signature: the tuple of round tags of each
+    rank's BARRIER ops, in program order. Equal across ranks iff the
+    schedule is barrier-SPMD-symmetric."""
+    progs = getattr(schedule, "programs", None) or ()
+    return [tuple(op.round for op in prog if op.kind is OpKind.BARRIER)
+            for prog in progs]
+
+
+def check_barrier_symmetry(schedule) -> tuple:
+    """Prove every rank shares rank 0's barrier signature and return it.
+
+    Raises :class:`ScheduleAsymmetryError` naming the first divergent
+    rank otherwise. O(total ops) — cheap next to anything a signature
+    consumer does with the result.
+    """
+    sigs = barrier_signatures(schedule)
+    ref = sigs[0] if sigs else ()
+    for rank, sig in enumerate(sigs):
+        if sig != ref:
+            raise ScheduleAsymmetryError(
+                f"{getattr(schedule, 'name', schedule)}: barrier "
+                f"structure is not SPMD-symmetric — rank {rank} has "
+                f"signature {sig}, rank 0 has {ref}; refusing to reuse "
+                f"rank 0's signature for shape keys")
+    return ref
+
+
 def barrier_rounds_of(schedule) -> dict:
-    """round -> number of MPI_Barrier ops in it, read from rank 0's
-    program (barrier structure is SPMD-symmetric in every method)."""
-    progs = getattr(schedule, "programs", None)
+    """round -> number of MPI_Barrier ops in it. Barrier structure being
+    SPMD-symmetric is CHECKED (:func:`check_barrier_symmetry`), not
+    assumed: an asymmetric schedule raises rather than silently
+    reporting rank 0's view."""
     out: dict[int, int] = {}
-    for op in (progs[0] if progs else ()):
-        if op.kind is OpKind.BARRIER:
-            out[op.round] = out.get(op.round, 0) + 1
+    for rnd in check_barrier_symmetry(schedule):
+        out[rnd] = out.get(rnd, 0) + 1
     return out
 
 
@@ -61,11 +102,12 @@ def schedule_shape_key(schedule) -> tuple:
     ``-b`` modes compile different programs from the same pattern.
     ``variant`` (the canonical fault spec stamped by faults/repair.py)
     keeps repaired/fault-injected programs from aliasing the healthy
-    compiled cache entries — same pattern, different program."""
-    progs = getattr(schedule, "programs", None)
-    barrier_sig = tuple(
-        op.round for op in (progs[0] if progs else ())
-        if op.kind is OpKind.BARRIER)
+    compiled cache entries — same pattern, different program. The
+    barrier signature is rank 0's only after
+    :func:`check_barrier_symmetry` proves every rank matches it — an
+    asymmetric schedule must poison cache reuse (raise), never alias a
+    symmetric entry."""
+    barrier_sig = check_barrier_symmetry(schedule)
     return (schedule.pattern, schedule.method_id,
             getattr(schedule, "collective", False), barrier_sig,
             getattr(schedule, "variant", ""),
@@ -258,7 +300,11 @@ class Schedule:
         receive, duplicates are checked per matching key (src, dst, chan),
         and chan-0 coverage equals the pattern's expected edges minus any
         ``dead_edges`` the repair rerouted (whose payloads arrive via the
-        relay channels instead)."""
+        relay channels instead). Collective schedules get the per-edge
+        checks too (their payload rides ALLTOALLW, so any point-to-point
+        op they carry must still match) plus conservation of the dense
+        matrices: recvcounts must be the exact transpose of sendcounts
+        and every rank must post the same number of collective calls."""
         table = self.recv_slot_table()
         relay = self.relay_recv_table()
         edges = self.data_edges_ext()
@@ -269,8 +315,6 @@ class Schedule:
             if key in seen:
                 raise AssertionError(f"{self.name}: duplicate edge {key}")
             seen.add(key)
-            if self.collective:
-                continue
             if chan:
                 if key not in relay:
                     raise AssertionError(
@@ -280,6 +324,19 @@ class Schedule:
                 if key[:2] not in table:
                     raise AssertionError(
                         f"{self.name}: send {key[:2]} has no matching recv")
+        if self.collective:
+            send, recv = self.pattern.dense_counts()
+            if (send.T != recv).any():
+                raise AssertionError(
+                    f"{self.name}: dense sendcounts do not transpose to "
+                    f"recvcounts — {int(send.sum())} B posted vs "
+                    f"{int(recv.sum())} B expected")
+            arity = {sum(1 for op in prog if op.kind is OpKind.ALLTOALLW)
+                     for prog in self.programs}
+            if len(arity) > 1:
+                raise AssertionError(
+                    f"{self.name}: collective call arity differs across "
+                    f"ranks: {sorted(arity)}")
         # expected coverage: every (sender, receiver) pair of the pattern,
         # less the dead edges whose chan-0 message the repair removed
         p = self.pattern

@@ -217,6 +217,37 @@ def _detour_dead_links(schedule, spec: FaultSpec, dead_agg_ranks):
                bucket=TimerBucket.RECV_WAIT),
         ]
         dead_edges.append((s, d))
+    # Refusal scan: the oracle DROPS every chan-0 message on a dead link
+    # (payload or 0-byte sync alike — backends/local.py try_deliver), so
+    # any crossing op still left after the detours strands its receiver
+    # at runtime. Before the model checker existed this fell through the
+    # "no s->d payload; nothing to reroute" case and returned a
+    # deadlocking program for e.g. the pairwise methods, whose 0-byte
+    # SENDRECV sync exchange touches every directed pair. Refuse
+    # instead — the checker (analysis/check.py) and the oracle agree.
+    # (Signal handshakes ride separate plumbing with no drop rule and
+    # are deliberately not scanned.)
+    for r, prog in enumerate(progs):
+        for op in prog:
+            crossing = None
+            if (op.kind in _SEND_KINDS and op.chan == 0
+                    and (r, op.peer) in dead_links):
+                crossing = (r, op.peer)
+            elif op.kind is OpKind.SENDRECV:
+                if (r, op.peer) in dead_links:
+                    crossing = (r, op.peer)
+                elif (op.peer2, r) in dead_links:
+                    crossing = (op.peer2, r)
+            elif (op.kind in (OpKind.IRECV, OpKind.RECV) and op.chan == 0
+                    and (op.peer, r) in dead_links):
+                crossing = (op.peer, r)
+            if crossing:
+                raise RepairError(
+                    f"dead link {crossing[0]}>{crossing[1]}: rank {r} "
+                    f"still crosses it with a {op.kind.name} "
+                    f"({op.nbytes} B) after detouring — the link drops "
+                    f"it and the receiver deadlocks; no repair for "
+                    f"m={schedule.method_id} ({schedule.name})")
     return progs, n_staging, tuple(dead_edges)
 
 

@@ -110,14 +110,14 @@ def differenced_trials(chain_factory, send0, *, iters_small: int,
             lower_s = time.perf_counter() - t0
             try:
                 cost = _slim_cost(lowered.cost_analysis())
-            except Exception as e:
+            except Exception as e:  # lint: broad-ok (cost_analysis optional across jax versions)
                 cost = None
                 rec = ledger.record_resilience(
                     "chained.cost_analysis", kind="suppressed",
                     error_class=classify_error(e),
                     error=f"{type(e).__name__}: {e}"[:500])
                 trace.instant("ledger.resilience", **rec)
-        except Exception as e:
+        except Exception as e:  # lint: broad-ok (compile telemetry best-effort; error ledgered)
             lower_s = None
             rec = ledger.record_resilience(
                 "chained.lower", kind="suppressed",

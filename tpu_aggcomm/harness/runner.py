@@ -359,7 +359,7 @@ def _sample_device(rec) -> None:
         ledger.record_device(platform=d.platform,
                              device_kind=getattr(d, "device_kind", None))
         stats = getattr(d, "memory_stats", lambda: None)() or {}
-    except Exception as e:
+    except Exception as e:  # lint: broad-ok (device telemetry best-effort; see below)
         # telemetry stays best-effort, but the swallow is classified and
         # visible in the ledger instead of silent (ISSUE 7)
         srec = ledger.record_resilience(
@@ -392,7 +392,7 @@ def _xprof_crosscheck(backend, sched, cfg, method: int, name: str,
         with jax.profiler.trace(logdir):
             backend.run(sched, ntimes=1, iter_=0, verify=False)
         profiled = time.perf_counter() - t0
-    except Exception as e:  # profiler or backend trouble: report, not raise
+    except Exception as e:  # lint: broad-ok (profiler or backend trouble: report, not raise)
         err = f"{type(e).__name__}: {e}"
         err_class = classify_error(e)
         srec = ledger.record_resilience(

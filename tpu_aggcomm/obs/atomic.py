@@ -45,7 +45,7 @@ def atomic_write(path: str, mode: str = "w", **open_kwargs):
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, target)
-    except BaseException:
+    except BaseException:  # lint: broad-ok (tmp cleanup; re-raised below)
         with contextlib.suppress(OSError):
             os.unlink(tmp)
         raise

@@ -71,7 +71,7 @@ def _pkg_version(name: str) -> str | None:
     try:
         from importlib import metadata
         return metadata.version(name)
-    except Exception:
+    except Exception:  # lint: broad-ok (provenance best-effort; None = unknown)
         return None
 
 
@@ -82,7 +82,7 @@ def _git_sha() -> str | None:
         r = subprocess.run(["git", "rev-parse", "--short=12", "HEAD"],
                            cwd=root, capture_output=True, text=True,
                            timeout=10)
-    except Exception:
+    except Exception:  # lint: broad-ok (provenance best-effort; None = unknown)
         return None
     return r.stdout.strip() or None if r.returncode == 0 else None
 

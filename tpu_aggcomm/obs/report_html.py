@@ -142,7 +142,7 @@ def _run_traffic(run: dict) -> dict | None:
             return {"verdict": "EXEMPT", "note":
                     f"dense collective at n={n}: matrix omitted"}
         audit = audit_schedule(sched)
-    except Exception as e:  # an unauditable run must not sink the page
+    except Exception as e:  # lint: broad-ok (an unauditable run must not sink the page)
         return {"verdict": None, "note": f"not auditable: {e}"}
     conf = audit["conformance"]
     out = {"verdict": conf["verdict"], "peak": conf["peak"],

@@ -213,7 +213,7 @@ def retry_call(fn, *, site: str, policy: RetryPolicy | None = None,
         try:
             maybe_chaos_fail(site)
             result = fn()
-        except Exception as e:
+        except Exception as e:  # lint: broad-ok (THE classification site: classify() decides)
             cls = classify(e)
             retryable = cls in RETRYABLE and attempt < pol.max_attempts
             backoff = backoffs[attempt - 1] if retryable else None

@@ -127,7 +127,7 @@ def schedule_floor_s(schedule, backend_name: str) -> float | None:
         from tpu_aggcomm.harness.roofline import rep_bytes
         return rep_bytes(schedule, lowering=backend_name).floor_seconds(
             fenced=True)
-    except Exception:
+    except Exception:  # lint: broad-ok (floor model advisory; ETA falls back)
         return None
 
 

@@ -33,7 +33,7 @@ def record_device_facts() -> None:
         dev = jax.devices()[0]
         ledger.record_device(platform=dev.platform,
                              device_kind=dev.device_kind)
-    except Exception:
+    except Exception:  # lint: broad-ok (device record best-effort)
         pass
 
 
