@@ -33,18 +33,26 @@ python bench.py --check-regression || post_rc=1
 # static throttle-conformance gate (obs/traffic.py, jax-free): every
 # method's in-flight accounting must respect its documented -c bound —
 # a schedule generator that over-posts invalidates the -c semantics the
-# whole benchmark studies, and this catches it with no backend at all
+# whole benchmark studies, and this catches it with no backend at all.
+# --fused-export additionally pins the pallas_fused step export
+# (native/fuse.py) against the op-program matrices: the in-kernel
+# rounds must carry the SAME per-round src->dst bytes as the fenced
+# lowering, or the fusion changed the program it claims to lower
+# (DRIFT fails; unfusable methods are SKIPPED by design).
 python -m tpu_aggcomm.cli inspect traffic -m 0 -n 32 -a 8 -c 4 \
-  > /dev/null || post_rc=1
+  --fused-export > /dev/null || post_rc=1
 # fault-repair conformance gate (faults/repair.py + obs/traffic.py,
 # jax-free): dead-link/dead-aggregator repaired schedules must still
 # respect the documented -c bound — a detour that over-posts would
 # invalidate the throttle semantics exactly when the benchmark claims
 # to have survived the fault. Small grid: the round-structured methods
-# under a combined dead-link + dead-aggregator scenario.
+# under a combined dead-link + dead-aggregator scenario. --fused-export
+# cross-checks the repaired schedule's fused export too (staging-row
+# repairs refuse by design and report SKIPPED).
 for m in 1 2 3; do
   python -m tpu_aggcomm.cli inspect traffic -m "$m" -n 32 -a 8 -c 4 \
-    --fault "deadlink:17>2,deadagg:a3" > /dev/null || post_rc=1
+    --fault "deadlink:17>2,deadagg:a3" --fused-export \
+    > /dev/null || post_rc=1
 done
 # schedule model-checker gate (analysis/check.py, jax-free): every
 # method must be statically PROVEN deadlock-free, recv-slot-race-free,
@@ -56,9 +64,10 @@ done
 # ROADMAP item 2 (Mosaic round fusion) may only fuse schedules whose
 # ordering properties are machine-checked, not merely observed.
 python -m tpu_aggcomm.cli inspect check -m 0 -n 32 -a 8 -c 4 \
-  > /dev/null || post_rc=1
+  --fused-export > /dev/null || post_rc=1
 python -m tpu_aggcomm.cli inspect check -m 0 -n 32 -a 8 -c 4 \
-  --fault "deadlink:17>2,deadagg:a3" > /dev/null || post_rc=1
+  --fault "deadlink:17>2,deadagg:a3" --fused-export \
+  > /dev/null || post_rc=1
 # codebase invariant lint (analysis/lint.py, jax-free): jax-import
 # purity of the declared-pure packages, no .lower().compile() outside
 # the sanctioned compile-only probe, no unclassified broad except, all

@@ -25,7 +25,12 @@ each logged with a PASS/FAIL marker so a partial run is still evidence:
    measured rounds + TAM hops, flagship roofline on the fused lowering
 6. scripts/tpu_flagship.py      — the 16,384x256 Theta shape on one
    chip: m=1 cells + the blocked-engine TAM cell, all chained-timed
-7. cli inspect ledger           — jax-free run-ledger pass over the
+7. scripts/tpu_sweeps.py --fused-only — the fused-vs-fenced n=32
+   throttle grid (whole schedule as ONE Mosaic kernel vs the fenced
+   jax_sim lowering), itself resumable via its own per-cell journal
+   (sweeps_fused.journal.jsonl, keyed shape_key+backend+manifest
+   fingerprint); --resume here passes --resume through
+8. cli inspect ledger           — jax-free run-ledger pass over the
    bench history: manifests, compile seconds, HBM peaks, env drift
 
 Concurrent-discipline note: stage 3 executes BOTH disciplines (the
@@ -175,6 +180,17 @@ def main() -> int:
                   env=env)
         run_stage("followup", [sys.executable, "scripts/tpu_followup.py"])
         run_stage("flagship", [sys.executable, "scripts/tpu_flagship.py"])
+        # fused-schedule grid (ISSUE 10): every cell verified + chained
+        # through the ONE-kernel pallas_fused lowering next to the
+        # fenced jax_sim baseline. Runs strictly after the compile-only
+        # probe proved Mosaic accepts the fused kernels at this exact
+        # shape. Doubly resumable: this stage's entry in the capture
+        # journal, plus the sweep's own per-cell journal (--resume
+        # passes through, so a half-done grid resumes cell-granular).
+        run_stage("fused-grid",
+                  [sys.executable, "scripts/tpu_sweeps.py", "--fused-only"]
+                  + (["--resume"] if RESUME else []),
+                  artifacts=["sweeps_fused.journal.jsonl"])
         # run ledger over everything the session just wrote (plus the
         # committed history): environment manifests, compile seconds,
         # HBM peaks, and drift between consecutive rounds — jax-free,

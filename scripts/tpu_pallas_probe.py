@@ -72,6 +72,47 @@ def main() -> int:
             print(f"        EXECUTED + verified in "
                   f"{time.perf_counter() - t0:.1f}s; "
                   f"rep wall = {timers[0].total_time:.6f}s", flush=True)
+
+    # --- fused-schedule stage (native/fuse.py): the whole throttled
+    # schedule as ONE kernel, compile-only first (round-3 incident rule:
+    # a Mosaic lowering bug must fail HERE, never wedge the tunnel
+    # mid-dispatch). Probed at the quiet-chip grid shape the sweeps
+    # measure (n=32, a=14, d=2048, c=4) across every fusable
+    # semaphore-family method plus the throttled workhorses.
+    from tpu_aggcomm.backends.pallas_fused import PallasFusedBackend
+    from tpu_aggcomm.native.fuse import UnfusableScheduleError, fuse_plan
+
+    print("--- fused-schedule probe (pallas_fused, one kernel per "
+          "schedule) ---", flush=True)
+    pf = AggregatorPattern(nprocs=32, cb_nodes=14, data_size=2048,
+                           comm_size=4, placement=1)
+    fb = PallasFusedBackend(device=dev, interpret=False)
+    for mid in (1, 2, 3, 6, 7, 11, 12, 18):
+        sched = compile_method(mid, pf)
+        try:
+            plan = fuse_plan(sched)
+        except UnfusableScheduleError as e:
+            print(f"m={mid:>2} ({sched.name}): UNFUSABLE by design: {e}",
+                  flush=True)
+            continue
+        rep = fb._one_rep(sched)
+        _ndt, _jdt, w = fb._words(pf)
+        send_shape = jax.ShapeDtypeStruct(
+            (pf.nprocs, plan.n_send_slots, w), np.uint32)
+        t0 = time.perf_counter()
+        compiled = jax.jit(rep).lower(send_shape).compile()  # lint: aot-ok (compile-only acceptance probe; never dispatched)
+        print(f"m={mid:>2} ({sched.name}): FUSED MOSAIC ACCEPTED in "
+              f"{time.perf_counter() - t0:.1f}s "
+              f"({len(plan.rounds)} rounds, {plan.n_edges} edges in "
+              f"one kernel)", flush=True)
+        del compiled
+
+        if "--execute" in sys.argv:
+            t0 = time.perf_counter()
+            recv, timers = fb.run(sched, ntimes=1, verify=True)
+            print(f"        EXECUTED + verified in "
+                  f"{time.perf_counter() - t0:.1f}s; "
+                  f"rep wall = {timers[0].total_time:.6f}s", flush=True)
     return 0
 
 

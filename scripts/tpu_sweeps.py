@@ -8,6 +8,17 @@ Runs, on the ONE tunneled v5e chip with ``jax_sim --chained --verify``:
 printing each cell as it completes plus the µs/rep + GB/s scaling
 summary for RESULTS_TPU.md.
 
+``--fused-only`` instead runs the fused-schedule grid: the n=32 a=14
+throttle grid cell-for-cell on ``pallas_fused`` (whole schedule = ONE
+Mosaic kernel, in-kernel DMA waits as the round fences) next to the
+fenced ``jax_sim`` lowering — the fused-vs-fenced table for
+RESULTS_TPU.md. That grid is resumable: every cell lands in
+``sweeps_fused.journal.jsonl`` keyed by (schedule_shape_key, backend)
+under the session's manifest fingerprint, ``--resume`` skips completed
+cells, and manifest drift (new jax/libtpu) re-runs them with the
+drifted keys NAMED (resilience/journal.py semantics, same as the CLI
+sweep and capture batch).
+
 One process, strictly serial — two TPU clients skew differenced
 numbers 2-7x (CLAUDE.md). Cells print as they finish, so a killed run
 still yields its completed cells from the log.
@@ -68,8 +79,107 @@ GRIDS = [
 ]
 D = 2048
 
+#: fused-vs-fenced grid (--fused-only): the quiet-chip n=32 shape the
+#: r2/r5 tables use, every throttle point, both lowerings of the SAME
+#: compiled schedule — per-cell speedup is meaningful because only the
+#: lowering differs
+FUSED_GRID = (32, 14, (1, 2), (1, 2, 4, 8, 16, 32, 999_999_999))
+FUSED_JOURNAL = "sweeps_fused.journal.jsonl"
+
+
+def fused_grid(resume: bool) -> int:
+    """The ``--fused-only`` body: resumable fused-vs-fenced n=32 grid.
+
+    Journal discipline mirrors the CLI sweep --resume: cells are keyed
+    by ``str(schedule_shape_key(sched))`` (fault variant included —
+    healthy here) plus the backend name, completion counts only under
+    the CURRENT manifest fingerprint, and a drifted environment re-runs
+    the cell with the drifted manifest keys named in the log. A failed
+    cell is journaled as ``fail`` (always re-run) and does not forfeit
+    the rest of the grid."""
+    import jax
+
+    from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+    from tpu_aggcomm.backends.pallas_fused import PallasFusedBackend
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+    from tpu_aggcomm.core.schedule import schedule_shape_key
+    from tpu_aggcomm.obs import ledger
+    from tpu_aggcomm.resilience import RunJournal
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} ({dev.platform})", flush=True)
+    journal = RunJournal(FUSED_JOURNAL)
+    man = ledger.manifest()
+    fp = journal.begin_session(man)
+    backends = (("pallas_fused", PallasFusedBackend(device=dev)),
+                ("jax_sim", JaxSimBackend(device=dev)))
+    n, a, methods, comms = FUSED_GRID
+    rc = 0
+    rows: dict = {}
+    print(f"\n== fused grid: n={n} a={a} d={D} "
+          f"(pallas_fused vs jax_sim, chained + verified) ==", flush=True)
+    for m in methods:
+        for c in comms:
+            p = AggregatorPattern(nprocs=n, cb_nodes=a, data_size=D,
+                                  comm_size=c)
+            sched = compile_method(m, p)
+            for bname, backend in backends:
+                key = {"shape_key": str(schedule_shape_key(sched)),
+                       "backend": bname}
+                if resume:
+                    done, reason = journal.completed(key, fingerprint=fp,
+                                                     manifest=man)
+                    if done:
+                        print(f"  resume: m={m} c={c} {bname}: done under "
+                              f"this manifest — skipping", flush=True)
+                        continue
+                    if reason:
+                        print(f"  m={m} c={c} {bname}: {reason}",
+                              flush=True)
+                t0 = time.perf_counter()
+                try:
+                    with _cell_trace(f"fused_n{n}_m{m}_c{c}_{bname}"):
+                        _recv, timers = backend.run(sched, ntimes=1,
+                                                    verify=True,
+                                                    chained=True)
+                except Exception as e:  # lint: broad-ok (grid-cell isolation: a failed cell is journaled as fail and re-run on --resume; it must not forfeit the remaining cells)
+                    print(f"  m={m} c={c} {bname}: FAIL "
+                          f"{type(e).__name__}: {e}", flush=True)
+                    journal.record(key, fingerprint=fp, status="fail",
+                                   error=f"{type(e).__name__}: {e}",
+                                   wall_s=time.perf_counter() - t0)
+                    rc = 1
+                    continue
+                per_rep = timers[0].total_time
+                _record_cell(n=n, a=a, m=m, c=c, d=D, backend=bname,
+                             per_rep=per_rep,
+                             samples=backend.last_samples)
+                journal.record(key, fingerprint=fp, status="done",
+                               per_rep=per_rep,
+                               samples=backend.last_samples,
+                               wall_s=time.perf_counter() - t0)
+                rows[(m, c, bname)] = per_rep
+                print(f"  m={m} c={c} {bname}: {per_rep * 1e6:.2f} us/rep "
+                      f"(cell wall {time.perf_counter() - t0:.0f}s)",
+                      flush=True)
+
+    print("\n== fused-vs-fenced summary (speedup = jax_sim/pallas_fused) "
+          "==", flush=True)
+    for m in methods:
+        for c in comms:
+            f_ = rows.get((m, c, "pallas_fused"))
+            s = rows.get((m, c, "jax_sim"))
+            if f_ and s:
+                print(f"  m={m} c={c}: fused {f_ * 1e6:.2f} vs fenced "
+                      f"{s * 1e6:.2f} us/rep ({s / f_:.2f}x)", flush=True)
+    return rc
+
 
 def main() -> int:
+    if "--fused-only" in sys.argv:
+        return fused_grid("--resume" in sys.argv)
+
     import jax
 
     from tpu_aggcomm.backends.jax_sim import JaxSimBackend

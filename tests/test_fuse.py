@@ -1,0 +1,346 @@
+"""Schedule→Mosaic fusion (native/fuse.py + backends/pallas_fused.py):
+one Pallas kernel per whole throttled schedule, in-kernel DMA-semaphore
+drains as the round fences.
+
+Pins, per ISSUE 10:
+
+- byte-exact interpret-mode ``--verify`` against the local oracle for
+  EVERY fusable method id, healthy and fault-repaired;
+- unfusable schedules (TAM, dense collectives, staged dead-link
+  repairs, slow-rank injection) refuse with a NAMED error — never a
+  silent fallback to the fenced lowering;
+- round ordering by construction: the fused semaphore dependency chain
+  totally orders the same round ids the model checker's round-fence
+  property proves monotone (analysis/check.py) — a round-k+1 arrival
+  before round-k completion is unrepresentable;
+- the step export equals the op-program traffic accounting
+  (cross_check_export), and a perturbed export is a NAMED drift;
+- fuse's schedule-analysis half stays importable jax-free (poisoned-jax
+  subprocess pin parameterized from the purity contract itself).
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import _jaxfree
+from tpu_aggcomm.backends.pallas_fused import (FusedBackendError,
+                                               PallasFusedBackend)
+from tpu_aggcomm.core.methods import METHODS, compile_method, method_ids
+from tpu_aggcomm.core.pattern import AggregatorPattern
+from tpu_aggcomm.core.schedule import barrier_rounds_of
+from tpu_aggcomm.native import fuse
+from tpu_aggcomm.native.fuse import (MAX_FUSED_EDGES, FusedExportError,
+                                     UnfusableScheduleError,
+                                     cross_check_export, export_sweep,
+                                     fuse_plan, plan_round_matrices,
+                                     semaphore_deps)
+
+NON_TAM = [m for m in method_ids(include_dead=True) if not METHODS[m].tam]
+FUSABLE = [m for m in NON_TAM
+           if not compile_method(m, AggregatorPattern(8, 3, data_size=32,
+                                                      comm_size=3))
+           .collective]
+COLLECTIVE = [m for m in NON_TAM if m not in FUSABLE]
+
+
+def _pattern(**kw):
+    kw.setdefault("data_size", 32)
+    kw.setdefault("comm_size", 3)
+    return AggregatorPattern(kw.pop("nprocs", 8), kw.pop("cb_nodes", 3),
+                             **kw)
+
+
+def _backend():
+    return PallasFusedBackend(interpret=True)
+
+
+# ---------------------------------------------------------------------------
+# byte-exact verify vs the local oracle (interpret mode, CPU)
+
+
+@pytest.mark.parametrize("method", FUSABLE)
+def test_fused_matches_oracle(method):
+    from tpu_aggcomm.backends.local import LocalBackend
+    p = _pattern()
+    sched = compile_method(method, p)
+    recv_f, timers = _backend().run(sched, verify=True, iter_=0)
+    recv_o, _ = LocalBackend().run(sched, verify=True, iter_=0)
+    for a, b in zip(recv_f, recv_o):
+        if a is None:
+            assert b is None
+        else:
+            np.testing.assert_array_equal(a, b)
+    assert timers[0].total_time > 0
+
+
+def test_fused_uint8_lane_path():
+    # data_size not 4-aligned: the kernel arena rides uint8 lanes on the
+    # pallas_dma (4, 128) tile discipline instead of uint32 (8, 128)
+    p = _pattern(data_size=33)
+    _backend().run(compile_method(1, p), verify=True)
+
+
+def test_fused_throttle_and_iters():
+    p = _pattern(nprocs=12, cb_nodes=5, data_size=16, comm_size=2,
+                 proc_node=2)
+    b = _backend()
+    _, timers = b.run(compile_method(3, p), ntimes=2, verify=True, iter_=1)
+    assert len(b.last_rep_timers) == 2
+
+
+def test_fused_chained_measurement():
+    b = _backend()
+    per_rep = b.measure_per_rep(compile_method(1, _pattern()),
+                                iters_small=5, iters_big=505, trials=2,
+                                windows=1)
+    assert per_rep > 0
+    assert len(b.last_samples) == 2
+
+
+def test_fused_fault_repaired_verify():
+    # a repaired schedule with NO staging rows (the dead link is not in
+    # this shape's pattern; the dead aggregator is re-homed by election)
+    # must fuse and verify byte-exact — fault coverage without refusal
+    from tpu_aggcomm.faults import repair_schedule
+    p = _pattern(nprocs=32, cb_nodes=8, data_size=64, comm_size=4,
+                 placement=1)
+    sched = repair_schedule(compile_method(1, p),
+                            "deadlink:17>2,deadagg:a3")
+    assert sched.n_staging == 0 and sched.fault
+    _backend().run(sched, verify=True)
+
+
+def test_fused_unrepaired_deadlink_fails_visibly():
+    # UNREPAIRED dead-link realization must drop payload and fail
+    # --verify loudly (the shared backends' injection rule) — never
+    # deliver stale/zero bytes silently
+    from dataclasses import replace
+
+    from tpu_aggcomm.harness.verify import VerificationError
+    p = _pattern(nprocs=8, cb_nodes=3, placement=1)
+    sched = compile_method(1, p)
+    agg = int(sched.pattern.rank_list[0])
+    src = next(r for r in range(p.nprocs) if r != agg)
+    bad = replace(sched, fault=f"deadlink:{src}>{agg}")
+    with pytest.raises(VerificationError):
+        _backend().run(bad, verify=True)
+
+
+# ---------------------------------------------------------------------------
+# named refusals — never a silent fallback
+
+
+@pytest.mark.parametrize("method", COLLECTIVE)
+def test_fused_refuses_collectives(method):
+    with pytest.raises(UnfusableScheduleError, match="dense collective"):
+        fuse_plan(compile_method(method, _pattern()))
+
+
+def test_fused_refuses_tam():
+    tam = [m for m in method_ids() if METHODS[m].tam]
+    if not tam:
+        pytest.skip("TAM engine not importable")
+    sched = compile_method(tam[0], _pattern(nprocs=8, cb_nodes=2,
+                                            proc_node=4))
+    with pytest.raises(UnfusableScheduleError, match="TAM"):
+        fuse_plan(sched)
+
+
+def test_fused_refuses_staged_repair():
+    # the same detour jax_shard refuses (relay staging rows) must refuse
+    # here too, naming the jax_sim/local escape hatch
+    from tpu_aggcomm.faults import repair_schedule
+    sched = repair_schedule(compile_method(1, _pattern()), "deadlink:5>3")
+    assert sched.n_staging > 0
+    with pytest.raises(UnfusableScheduleError, match="staging rows"):
+        fuse_plan(sched)
+
+
+def test_fused_refuses_slow_injection():
+    from tpu_aggcomm.faults import repair_schedule
+    sched = repair_schedule(compile_method(1, _pattern()), "slow:r3*4.0")
+    with pytest.raises(UnfusableScheduleError, match="slow-rank"):
+        fuse_plan(sched)
+
+
+def test_fused_edge_ceiling_named(monkeypatch):
+    monkeypatch.setattr(fuse, "MAX_FUSED_EDGES", 4)
+    with pytest.raises(UnfusableScheduleError, match="ceiling"):
+        fuse_plan(compile_method(1, _pattern()))
+    assert MAX_FUSED_EDGES > 4  # the real cap is untouched
+
+
+def test_fused_refuses_round_prefix_truncation():
+    with pytest.raises(ValueError, match="round-prefix truncation"):
+        _backend()._one_rep(compile_method(1, _pattern()), upto=1)
+
+
+def test_fused_refuses_profile_and_phases():
+    sched = compile_method(1, _pattern())
+    with pytest.raises(ValueError, match="ONE"):
+        _backend().run(sched, profile_rounds=True)
+    with pytest.raises(ValueError, match="FENCED"):
+        _backend().run(sched, measured_phases=True)
+
+
+def test_fused_off_tpu_named_error(monkeypatch):
+    # interpret NOT requested on a CPU-only host: the first rep build
+    # must raise the named environment error, not fall back silently
+    monkeypatch.delenv("TPU_AGGCOMM_FUSED_INTERPRET", raising=False)
+    b = PallasFusedBackend()
+    with pytest.raises(FusedBackendError, match="interpret"):
+        b.run(compile_method(1, _pattern()), verify=True)
+
+
+def test_fused_interpret_env_gate(monkeypatch):
+    monkeypatch.setenv("TPU_AGGCOMM_FUSED_INTERPRET", "1")
+    PallasFusedBackend().run(compile_method(1, _pattern()), verify=True)
+
+
+def test_runner_gate_refuses_unfusable_named():
+    from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+    import io
+    cfg = ExperimentConfig(nprocs=8, cb_nodes=3, method=5, data_size=32,
+                           comm_size=3, backend="pallas_fused",
+                           results_csv=None)
+    with pytest.raises(ValueError, match="pallas_fused does not support"):
+        run_experiment(cfg, out=io.StringIO())
+
+
+# ---------------------------------------------------------------------------
+# round ordering: the semaphore chain IS the fence structure
+
+
+@pytest.mark.parametrize("method", FUSABLE)
+def test_semaphore_deps_match_check_round_fences(method):
+    sched = compile_method(method, _pattern())
+    plan = fuse_plan(sched)
+    ids = [r for r, _e in plan.rounds]
+    # the plan's rounds are exactly the schedule's data-edge rounds, in
+    # strictly increasing order — no round merged away, none reordered
+    assert ids == sorted({int(e[4]) for e in sched.data_edges()})
+    # the wait graph totally orders consecutive rounds: transitively,
+    # every round k+1 copy start is ordered after every round k wait —
+    # the in-kernel form of the fence the checker's round-monotonicity
+    # property proves on the op programs
+    assert semaphore_deps(plan) == list(zip(ids, ids[1:]))
+    from tpu_aggcomm.analysis.check import check_schedule
+    report = check_schedule(sched)
+    assert report["verdict"] == "PROVEN"
+    assert report["properties"]["round_monotonicity"]["verdict"] == "PROVEN"
+    # barrier fences survive the export byte-for-byte
+    assert plan.barrier_counts() == barrier_rounds_of(sched)
+
+
+def test_recv_slot_write_race_refused(monkeypatch):
+    # two same-round writes into one (dst, slot) cell can race in flight;
+    # fuse_plan must name the racing cell, mirroring the checker's
+    # race-freedom property
+    from tpu_aggcomm.core.schedule import Schedule
+    sched = compile_method(1, _pattern())
+    real = Schedule.data_edges_ext
+
+    def racy(self):
+        ext = real(self).copy()
+        same = np.where(ext[:, 4] == ext[0, 4])[0]
+        assert len(same) >= 2
+        i, j = same[0], same[1]
+        ext[j, 1], ext[j, 3] = ext[i, 1], ext[i, 3]
+        return ext
+
+    monkeypatch.setattr(Schedule, "data_edges_ext", racy)
+    with pytest.raises(UnfusableScheduleError, match="written twice"):
+        fuse_plan(sched)
+
+
+# ---------------------------------------------------------------------------
+# step export vs op-program traffic — the two accountings never drift
+
+
+@pytest.mark.parametrize("method", FUSABLE)
+def test_cross_check_export_matches(method):
+    rep = cross_check_export(compile_method(method, _pattern()))
+    assert rep["status"] == "MATCH"
+    assert rep["edges"] > 0 and rep["rounds"] > 0
+
+
+def test_cross_check_export_skips_unfusable():
+    rep = cross_check_export(compile_method(COLLECTIVE[0], _pattern()))
+    assert rep["status"] == "SKIPPED"
+    assert "collective" in rep["reason"]
+
+
+def test_cross_check_export_names_drift(monkeypatch):
+    sched = compile_method(1, _pattern())
+    real = plan_round_matrices(fuse_plan(sched))
+    r0 = min(real)
+    pair = next(iter(real[r0]))
+    perturbed = {r: dict(c) for r, c in real.items()}
+    perturbed[r0][pair] += 1
+
+    monkeypatch.setattr(fuse, "plan_round_matrices", lambda _p: perturbed)
+    with pytest.raises(FusedExportError, match=f"round {r0}"):
+        cross_check_export(sched)
+
+
+def test_export_sweep_gate_shape():
+    rows = export_sweep(8, 3, 3, data_size=32, proc_node=1, agg_type=1)
+    assert rows
+    for r in rows:
+        if r["method"] in COLLECTIVE:
+            assert r["status"] == "SKIPPED", r
+        elif r["method"] in FUSABLE:
+            assert r["status"] == "MATCH", r
+    assert sum(r["status"] == "MATCH" for r in rows) >= 10
+    assert not any(r["status"] == "DRIFT" for r in rows)
+
+
+def test_tune_sampler_races_fused(tmp_path):
+    # the pallas_fused sampler rides the same cache-bypassing trial hook
+    from tpu_aggcomm.tune.measure import make_pallas_fused_sampler
+    import os
+    os.environ["TPU_AGGCOMM_FUSED_INTERPRET"] = "1"
+    try:
+        sampler = make_pallas_fused_sampler(
+            nprocs=8, data_size=32, proc_node=1, iters_small=5,
+            iters_big=505, batch_trials=2)
+        samples = sampler("m1:a3:c3:t1", 0)
+        assert len(samples) == 2 and all(s > 0 for s in samples)
+    finally:
+        del os.environ["TPU_AGGCOMM_FUSED_INTERPRET"]
+
+
+# ---------------------------------------------------------------------------
+# purity: the schedule-analysis half must run where jax cannot import
+
+
+def test_fuse_analysis_half_is_jax_free(tmp_path):
+    code = _jaxfree.pure_import_code("tpu_aggcomm.native")
+    env = _jaxfree.poisoned_env(tmp_path)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_fuse_plan_runs_jax_free(tmp_path):
+    code = (
+        "from tpu_aggcomm.core.methods import compile_method\n"
+        "from tpu_aggcomm.core.pattern import AggregatorPattern\n"
+        "from tpu_aggcomm.native.fuse import (cross_check_export,\n"
+        "                                     fuse_plan)\n"
+        "import sys\n"
+        "s = compile_method(1, AggregatorPattern(8, 3, data_size=32,\n"
+        "                                        comm_size=3))\n"
+        "plan = fuse_plan(s)\n"
+        "assert plan.n_edges > 0\n"
+        "assert cross_check_export(s)['status'] == 'MATCH'\n"
+        "assert 'jax' not in sys.modules\n")
+    env = _jaxfree.poisoned_env(
+        tmp_path, reason="fuse's plan/export half must run on a host "
+                         "whose tunnel is wedged")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr

@@ -65,6 +65,7 @@ PURE_PACKAGES: dict = {
     "resilience": (),
     "analysis": (),
     "tune": ("measure",),
+    "native": (),
 }
 
 BROAD_OK_PRAGMA = "# lint: broad-ok"

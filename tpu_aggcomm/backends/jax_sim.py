@@ -569,7 +569,7 @@ class JaxSimBackend:
         # measured_phases provenance is column-accurate (VERDICT r4
         # item 7b) and finalized below once the round count is known.
         self.last_provenance = (
-            "jax_sim",
+            self.name,
             "attributed-chained" if chained
             else "attributed-rounds" if (profiled_segs is not None
                                          and len(profiled_segs[0]) > 1)
@@ -613,7 +613,7 @@ class JaxSimBackend:
                     schedule, hops["p2"], hops["p3"], hops["p4"],
                     weights=attr_w)
                 self.last_provenance = (
-                    "jax_sim", "measured-hops(P2,P3,P4)+attributed(ranks)")
+                    self.name, "measured-hops(P2,P3,P4)+attributed(ranks)")
                 self.last_round_times = [
                     [hops["p2"], hops["p3"], hops["p4"]]
                     for _ in range(ntimes)]
@@ -625,7 +625,7 @@ class JaxSimBackend:
                 rep_attr = attribute_round_splits(schedule, splits,
                                                   weights=attr_w)
                 self.last_provenance = (
-                    "jax_sim",
+                    self.name,
                     "measured-rounds(post,deliver)+attributed(waits)")
                 self.last_round_times = [
                     [p_ + d_ for (p_, d_) in splits.values()]
@@ -634,7 +634,7 @@ class JaxSimBackend:
                 # deep scan-lowered schedules: per-round totals measured
                 rep_attr = attribute_rounds(schedule, rt, weights=attr_w)
                 self.last_provenance = (
-                    "jax_sim", "measured-rounds+attributed(buckets)")
+                    self.name, "measured-rounds+attributed(buckets)")
                 self.last_round_times = [list(rt.values())
                                          for _ in range(ntimes)]
             else:
@@ -643,7 +643,7 @@ class JaxSimBackend:
                     schedule, split["post"], split["deliver"],
                     weights=attr_w)
                 self.last_provenance = (
-                    "jax_sim",
+                    self.name,
                     "measured-split(post,deliver)+attributed(waits)")
             for r, t in enumerate(timers):
                 t += Timer.from_array(rep_attr[r].as_array() * ntimes)
@@ -664,7 +664,7 @@ class JaxSimBackend:
                                      profiled_segs)
         else:
             for rep in range(ntimes):
-                with trace.span("jax_sim.dispatch", rep=rep,
+                with trace.span(f"{self.name}.dispatch", rep=rep,
                                 method=schedule.name):
                     t0 = time.perf_counter()
                     out = fn(send_dev)

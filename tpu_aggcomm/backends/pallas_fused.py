@@ -1,0 +1,96 @@
+"""pallas_fused: whole throttled schedules as ONE Pallas kernel.
+
+The fenced jax_sim lowering dispatches one XLA program step per throttle
+round; on the tunneled v5e that is 38–70 µs/rep against pallas_local's
+1.72 µs dense floor (RESULTS_TPU.md). This backend lowers the SAME
+schedule data through :mod:`tpu_aggcomm.native.fuse` instead: every
+round's copies become in-kernel ``make_async_copy`` start/wait pairs and
+the per-round semaphore drain is the fence — rounds remain distinct
+program steps inside the kernel, so the ``-c`` semantics the benchmark
+studies survive fusion (CLAUDE.md invariant: fusing rounds into one
+wait is still forbidden; the per-round drain IS the round boundary).
+
+Everything else rides the JaxSimBackend harness unchanged: dense
+rank-axis send lanes in, ``(n, R+1, w)`` recv lanes out (trash row
+last), byte-exact ``--verify`` against the local oracle, and the
+chained serial-``lax.scan`` differenced timing that is the only honest
+measurement through the ~60–90 ms tunnel. Unfusable schedules (TAM,
+dense collectives, staged dead-link repairs, slow-rank injection)
+refuse with a NAMED error — the jax_shard staged-schedule discipline —
+never a silent fallback to the fenced lowering.
+
+Off-TPU, Mosaic cannot compile the kernel: interpret mode must be asked
+for explicitly (``PallasFusedBackend(interpret=True)`` or
+``TPU_AGGCOMM_FUSED_INTERPRET=1``); otherwise construction of the first
+rep raises :class:`FusedBackendError` naming both escape hatches, so a
+CPU-only CI host can never silently "measure" the interpreter.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+from tpu_aggcomm.native.fuse import build_fused_rep, fuse_plan
+
+__all__ = ["PallasFusedBackend", "FusedBackendError"]
+
+
+class FusedBackendError(RuntimeError):
+    """pallas_fused cannot run in this environment — named reason (no
+    TPU and interpret mode not requested), never a silent fallback."""
+
+
+class PallasFusedBackend(JaxSimBackend):
+    """One fused Pallas kernel per schedule; JaxSimBackend harness."""
+
+    name = "pallas_fused"
+
+    def __init__(self, device=None, interpret: bool | None = None):
+        super().__init__(device=device)
+        if interpret is None:
+            env = os.environ.get("TPU_AGGCOMM_FUSED_INTERPRET", "")
+            interpret = env not in ("", "0")
+        self._interpret = bool(interpret)
+
+    def _resolve_interpret(self) -> bool:
+        """True = Pallas interpreter (CPU verify path), False = Mosaic
+        compile on the attached TPU. Neither available ⇒ named error."""
+        if self._interpret:
+            return True
+        if self._dev().platform == "tpu":
+            return False
+        raise FusedBackendError(
+            "pallas_fused: no TPU attached and interpret mode was not "
+            "requested — pass PallasFusedBackend(interpret=True) or set "
+            "TPU_AGGCOMM_FUSED_INTERPRET=1 for the CPU interpret "
+            "(verify-only) path; Mosaic kernels compile on TPU only")
+
+    # ------------------------------------------------------------------
+    def _one_rep(self, schedule, upto: int | None = None):
+        if upto is not None:
+            raise ValueError(
+                "pallas_fused: round-prefix truncation decomposes the "
+                "fenced program family — the fused kernel is one "
+                "program; measure prefixes on jax_sim")
+        plan = fuse_plan(schedule)          # named refusal if unfusable
+        return build_fused_rep(plan, lane=self._words(schedule.pattern),
+                               interpret=self._resolve_interpret())
+
+    def run(self, schedule, *, ntimes: int = 1, iter_: int = 0,
+            verify: bool = False, chained: bool = False,
+            profile_rounds: bool = False, measured_phases: bool = False):
+        if profile_rounds:
+            raise ValueError(
+                "pallas_fused: per-round dispatch profiling re-fences "
+                "the program the fusion removed — the fused rep is ONE "
+                "kernel; use --profile-rounds on jax_sim")
+        if measured_phases:
+            raise ValueError(
+                "pallas_fused: the measured phase split differences "
+                "prefix programs of the FENCED lowering; use "
+                "--measured-phases on jax_sim")
+        return super().run(schedule, ntimes=ntimes, iter_=iter_,
+                           verify=verify, chained=chained,
+                           profile_rounds=profile_rounds,
+                           measured_phases=measured_phases)

@@ -6,14 +6,14 @@ __all__ = ["BACKENDS", "DEVICE_FREE_BACKENDS", "SHARDED_RANK_BACKENDS",
            "SINGLE_DEVICE_BACKENDS", "get_backend"]
 
 BACKENDS = ("local", "jax_ici", "jax_sim", "jax_shard", "pallas_dma",
-            "pallas_dma_conc", "native")
+            "pallas_dma_conc", "pallas_fused", "native")
 
 # backends that execute without accelerator devices (pure host runtimes)
 DEVICE_FREE_BACKENDS = ("local", "native")
 
 # backends that carry the whole rank set on ONE device (rank count is free,
 # not bounded by the visible device count)
-SINGLE_DEVICE_BACKENDS = ("jax_sim",)
+SINGLE_DEVICE_BACKENDS = ("jax_sim", "pallas_fused")
 
 # backends that carry MANY logical ranks per device (rank count bounded by
 # memory, not the device count — the flagship-scale tier, DISTRIBUTED.md)
@@ -43,6 +43,11 @@ def get_backend(name: str):
             # drain at round end — the Issend-storm mode
             from tpu_aggcomm.backends.pallas_dma import PallasDmaBackend
             return PallasDmaBackend(concurrent=True)
+        if name == "pallas_fused":
+            # whole throttled schedules as ONE Pallas kernel: in-kernel
+            # DMA-semaphore drains are the round fences (native/fuse.py)
+            from tpu_aggcomm.backends.pallas_fused import PallasFusedBackend
+            return PallasFusedBackend()
         if name == "native":
             from tpu_aggcomm.backends.native import NativeBackend
             return NativeBackend()

@@ -353,6 +353,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "the -c bound / stays deadlock-free; 'check' "
                           "-m 0 sweeps every repairable method under the "
                           "spec (repair refusals are SKIPPED, not failed)")
+    ins.add_argument("--fused-export", action="store_true",
+                     help="'traffic'/'check' only: also cross-check the "
+                          "pallas_fused step export (native/fuse.py, "
+                          "jax-free) against the op-program accounting — "
+                          "per-round src->dst byte matrices and fence "
+                          "structure must be identical (DRIFT fails; "
+                          "unfusable schedules are SKIPPED by design); "
+                          "-m 0 sweeps every method")
     ins.add_argument("--out", default="report.html",
                      help="output path for 'inspect report' "
                           "(default: report.html)")
@@ -957,19 +965,27 @@ def _run_tune(args) -> int:
     else:
         if args.backend not in SINGLE_DEVICE_BACKENDS:
             raise SystemExit(
-                f"tune: measured tuning rides the chained jax_sim "
+                f"tune: measured tuning rides the chained single-device "
                 f"scaffold (got --backend {args.backend}); pass "
-                f"--backend jax_sim, or --synthetic SPEC for a "
-                f"backend-free run")
-        from tpu_aggcomm.tune.measure import make_jax_sim_sampler
-        sampler = make_jax_sim_sampler(
-            nprocs=args.nprocs, data_size=args.data_size,
-            proc_node=args.proc_node, iters_small=args.iters_small,
-            iters_big=args.iters_big, batch_trials=args.batch_trials,
-            windows=args.windows)
+                f"--backend jax_sim or pallas_fused, or --synthetic SPEC "
+                f"for a backend-free run")
+        if args.backend == "pallas_fused":
+            from tpu_aggcomm.tune.measure import make_pallas_fused_sampler
+            sampler = make_pallas_fused_sampler(
+                nprocs=args.nprocs, data_size=args.data_size,
+                proc_node=args.proc_node, iters_small=args.iters_small,
+                iters_big=args.iters_big, batch_trials=args.batch_trials,
+                windows=args.windows)
+        else:
+            from tpu_aggcomm.tune.measure import make_jax_sim_sampler
+            sampler = make_jax_sim_sampler(
+                nprocs=args.nprocs, data_size=args.data_size,
+                proc_node=args.proc_node, iters_small=args.iters_small,
+                iters_big=args.iters_big, batch_trials=args.batch_trials,
+                windows=args.windows)
 
     print(f"tune: racing {len(cids)} candidate(s) "
-          f"({'synthetic ' + args.synthetic if args.synthetic else 'measured, chained jax_sim'}), "
+          f"({'synthetic ' + args.synthetic if args.synthetic else 'measured, chained ' + args.backend}), "
           f"n={args.nprocs} d={args.data_size} p={args.proc_node}, "
           f"batches of {args.batch_trials} trial(s), seed {args.seed}")
     res = race_mod.race(cids, sampler, max_batches=args.max_batches,
@@ -1063,6 +1079,41 @@ def _resolve_auto(args, nprocs: int, *, sweep: bool = False) -> None:
               f"-c {args.comm_size} -t {args.agg_type}{tag} from {src}")
 
 
+def _fused_export_sweep(args) -> int:
+    """Cross-check every method's pallas_fused step export against the
+    op-program traffic accounting (native/fuse.py, jax-free). DRIFT is
+    the failure; unfusable schedules are SKIPPED by design."""
+    from tpu_aggcomm.native.fuse import export_sweep, render_export_sweep
+
+    fault = getattr(args, "fault", None)
+    rows = export_sweep(args.nprocs, args.cb_nodes, args.comm_size,
+                        data_size=args.data_size,
+                        proc_node=args.proc_node, agg_type=args.agg_type,
+                        fault=fault, barrier_type=args.barrier_type)
+    print(render_export_sweep(rows, fault=fault), end="")
+    return 1 if any(r["status"] == "DRIFT" for r in rows) else 0
+
+
+def _fused_export_one(sched) -> int:
+    """Single-schedule fused-export cross-check; prints one verdict
+    line. The schedule is whatever the caller audited (repaired when
+    --fault was given), so the two accountings see the same program."""
+    from tpu_aggcomm.native.fuse import FusedExportError, cross_check_export
+
+    try:
+        rep = cross_check_export(sched)
+    except FusedExportError as e:
+        print(f"fused export: DRIFT: {e}")
+        return 1
+    if rep["status"] == "MATCH":
+        print(f"fused export: MATCH ({rep['rounds']} rounds, "
+              f"{rep['edges']} edges, {rep['fences']} fences, "
+              f"{rep['bytes']} B — identical to the op-program matrices)")
+    else:
+        print(f"fused export: SKIPPED: {rep['reason']}")
+    return 0
+
+
 def _run_inspect_traffic(args) -> int:
     """Static traffic audit (obs/traffic.py, jax-free): the per-round
     communication matrix, incast depths, and the -c throttle-conformance
@@ -1086,7 +1137,10 @@ def _run_inspect_traffic(args) -> int:
             agg_type=args.agg_type)
         print(tr.render_sweep(rows, args.nprocs, args.cb_nodes,
                               args.comm_size), end="")
-        return 1 if any(r["verdict"] == "REFUTED" for r in rows) else 0
+        rc = 1 if any(r["verdict"] == "REFUTED" for r in rows) else 0
+        if args.fused_export:
+            rc = max(rc, _fused_export_sweep(args))
+        return rc
 
     from tpu_aggcomm.core.methods import METHODS, compile_method
     from tpu_aggcomm.core.pattern import AggregatorPattern
@@ -1124,7 +1178,10 @@ def _run_inspect_traffic(args) -> int:
     if args.json:
         path = tr.write_artifact(args.json, audit, overlay)
         print(f"traffic artifact written: {path}")
-    return 1 if audit["conformance"]["verdict"] == "REFUTED" else 0
+    rc = 1 if audit["conformance"]["verdict"] == "REFUTED" else 0
+    if args.fused_export:
+        rc = max(rc, _fused_export_one(sched))
+    return rc
 
 
 def _run_inspect_check(args) -> int:
@@ -1153,7 +1210,10 @@ def _run_inspect_check(args) -> int:
         print(ck.render_check_sweep(rows, args.nprocs, args.cb_nodes,
                                     args.comm_size, fault=args.fault),
               end="")
-        return 1 if any(r["verdict"] == "REFUTED" for r in rows) else 0
+        rc = 1 if any(r["verdict"] == "REFUTED" for r in rows) else 0
+        if args.fused_export:
+            rc = max(rc, _fused_export_sweep(args))
+        return rc
 
     from tpu_aggcomm.core.methods import METHODS, compile_method
     from tpu_aggcomm.core.pattern import AggregatorPattern
@@ -1179,7 +1239,10 @@ def _run_inspect_check(args) -> int:
     if args.json:
         path = ck.write_artifact(args.json, report)
         print(f"check artifact written: {path}")
-    return 1 if report["verdict"] == "REFUTED" else 0
+    rc = 1 if report["verdict"] == "REFUTED" else 0
+    if args.fused_export:
+        rc = max(rc, _fused_export_one(sched))
+    return rc
 
 
 def _run_inspect(args) -> int:

@@ -74,9 +74,10 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
                          "(the reference's -d 0 default sends empty messages; "
                          "pass an explicit size)")
     if cfg.chained and cfg.backend not in ("jax_sim", "jax_shard",
-                                           "jax_ici"):
-        raise ValueError("--chained requires --backend jax_sim, jax_shard "
-                         "or jax_ici (serial-chained on-device measurement)")
+                                           "jax_ici", "pallas_fused"):
+        raise ValueError("--chained requires --backend jax_sim, jax_shard, "
+                         "jax_ici or pallas_fused (serial-chained on-device "
+                         "measurement)")
     if cfg.chained and cfg.profile_rounds:
         raise ValueError("--chained and --profile-rounds are exclusive "
                          "(one program vs per-round programs)")
@@ -148,6 +149,23 @@ def run_experiment(cfg: ExperimentConfig, *, out=None) -> list[dict]:
             f"m{m}:{METHODS[m].name}",
             seconds=time.perf_counter() - t0, kind="schedule-build",
             backend=cfg.backend)
+    if cfg.backend == "pallas_fused":
+        # fail BEFORE any method runs, same discipline as the jax_ici TAM
+        # guard: a run-all sweep hitting an unfusable method mid-run would
+        # leave a partial CSV. TAM and the dense collectives have no
+        # throttle rounds to fuse (native/fuse.py refuses them by name);
+        # -m 0 on this backend means "the fusable subset", while naming
+        # one of them explicitly must still refuse upfront.
+        unfusable = [m for m in methods
+                     if METHODS[m].tam or compiled[m].collective]
+        if cfg.method == 0:
+            methods = [m for m in methods if m not in unfusable]
+        elif unfusable:
+            raise ValueError(
+                f"--backend pallas_fused does not support methods "
+                f"{unfusable} (TAM's staged engine and the dense "
+                f"collectives have no throttle rounds to fuse); run "
+                f"them on jax_sim")
     if fspec is not None:
         # repair BEFORE any method runs: an unrepairable method in a
         # run-all sweep must fail upfront, not mid-run with a partial CSV

@@ -1,0 +1,18 @@
+"""TPU-native lowerings and runtimes.
+
+Two residents share this package:
+
+- ``aggcomm_runtime.cc`` — the C++ threaded rank runtime behind the
+  ``native`` backend (ctypes bindings in ``backends/native.py``; the
+  shared library is built on demand into ``native/build/``).
+- :mod:`tpu_aggcomm.native.fuse` — the Schedule→Mosaic fusion layer
+  behind the ``pallas_fused`` backend: whole throttled schedules
+  compiled to ONE Pallas kernel in which in-kernel DMA-semaphore waits
+  are the round fences.
+
+The package is declared jax-pure (``analysis/lint.py:PURE_PACKAGES``):
+module import must never touch jax — ``fuse``'s schedule-analysis half
+(plan building, step export, the traffic cross-check) runs precisely
+where a wedged tunnel hangs ``import jax``; only its kernel-build
+functions import jax, lazily, when a backend asks for a rep.
+"""

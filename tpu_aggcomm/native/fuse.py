@@ -1,0 +1,410 @@
+"""Schedule→Mosaic fusion: one Pallas kernel per whole throttled schedule.
+
+Every method-registry schedule the jax tiers run is a *static step
+program* (core/schedule.py: schedules are data), yet the fenced jax_sim
+lowering pays one ``lax.optimization_barrier``-fenced XLA program step
+per throttle round — on the tunneled v5e that stack of host-level fences
+is why registry methods sit at 38–70 µs while the fused ``pallas_local``
+dense exchange runs at ~1.72 µs (RESULTS_TPU.md; ROADMAP open item 2).
+The persistent-schedule result of arXiv 2604.05099 (build once, execute
+many) says the whole program belongs in one kernel.
+
+This module is that lowering, split in two halves:
+
+- **schedule-analysis half (jax-free)** — :func:`fuse_plan` turns
+  ``Schedule.programs`` into a :class:`FusePlan`: per-round edge lists
+  over the dense rank-axis arenas, fusability decided by NAMED refusal
+  (:class:`UnfusableScheduleError` — TAM, dense collectives, staged
+  dead-link repairs, slow-rank injection, oversize kernels). The step
+  export (:func:`plan_round_matrices`, :func:`semaphore_deps`) and the
+  :func:`cross_check_export` gate against ``obs/traffic.py`` live here
+  too, so ``inspect check``/``inspect traffic`` can audit the fused
+  program exactly where a wedged tunnel hangs ``import jax``.
+- **kernel-build half (lazy jax)** — :func:`build_fused_rep` emits the
+  Pallas kernel: per round, every edge becomes one in-kernel
+  ``pltpu.make_async_copy`` from the sender's send-arena row into the
+  receiver's recv-arena row; ALL of a round's copies post before any
+  wait (in-flight copies per round = the throttle ``-c``, the
+  pallas_dma_conc Issend-storm discipline), and the round's semaphore
+  drain is the fence — round k+1's copy descriptors are program-ordered
+  after round k's waits, so rounds remain distinct program steps in
+  exactly the sense the ``-c`` invariants require. Reference
+  MPI_Barrier rounds need no extra steps on one chip: the round drain
+  already closes every rank's happens-before edge (all ranks live in
+  the one kernel), which the plan records via ``barriers`` for the
+  step-export auditors.
+
+The rep signature matches ``JaxSimBackend._one_rep`` exactly
+(``rep(send (n, S, w) lanes) -> recv (n, R+1, w) lanes``, trash row
+last), so the fused backend inherits the chained serial-scan differenced
+measurement, verification, and attribution unchanged
+(backends/pallas_fused.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpu_aggcomm.core.schedule import Schedule, barrier_rounds_of
+
+__all__ = ["MAX_FUSED_EDGES", "UnfusableScheduleError", "FusedExportError",
+           "FusePlan", "fuse_plan", "plan_round_matrices", "semaphore_deps",
+           "cross_check_export", "export_sweep", "render_export_sweep",
+           "build_fused_rep"]
+
+#: Hard ceiling on the per-kernel copy count: each edge unrolls to one
+#: DMA start + wait pair in the Mosaic instruction stream, so a flagship
+#: shape (n=16,384) would emit a multi-million-instruction kernel. The
+#: quiet-chip grids this lowering targets (n=32) sit near 450 edges;
+#: oversize schedules refuse by name instead of wedging the compiler.
+MAX_FUSED_EDGES = 16384
+
+
+class UnfusableScheduleError(ValueError):
+    """Schedule cannot lower to one fused kernel — named reason, never a
+    silent fallback (the jax_shard staged-schedule refusal discipline)."""
+
+
+class FusedExportError(ValueError):
+    """The fused step export drifted from the op-program traffic
+    accounting — the two views of one schedule must never disagree."""
+
+
+@dataclass(frozen=True)
+class FusePlan:
+    """The fused kernel's step program, derived ONLY from the schedule.
+
+    ``rounds`` is a tuple of ``(round_id, edges)`` in strictly increasing
+    round order; each edge is ``(src, sslot, dst, dslot)`` over the dense
+    rank-axis arenas (``dslot`` indexes pattern recv slots; the trash row
+    is ``n_recv_slots``). ``barriers`` maps round id -> reference
+    MPI_Barrier count attached to that round (fence-structure export
+    only: on one chip the round drain already IS the global fence).
+    """
+
+    nprocs: int
+    data_size: int
+    n_send_slots: int
+    n_recv_slots: int
+    rounds: tuple
+    barriers: tuple  # sorted (round_id, count) pairs
+
+    @property
+    def n_edges(self) -> int:
+        return sum(len(edges) for _r, edges in self.rounds)
+
+    def barrier_counts(self) -> dict:
+        return dict(self.barriers)
+
+
+def fuse_plan(schedule) -> FusePlan:
+    """Build the fused step program, or refuse by name.
+
+    Fusable = round-structured, non-collective, non-TAM, no relay
+    staging rows, no slow-rank injection, every edge joinable to a recv
+    slot. Dead-link realization for UNREPAIRED faulted schedules matches
+    the other lowerings (``faults/inject.dead_edge_mask``): named chan-0
+    edges drop their payload so ``--verify`` fails visibly — a repaired
+    schedule has no such edge left.
+    """
+    from tpu_aggcomm.faults.inject import dead_edge_mask
+    from tpu_aggcomm.faults.spec import parse_fault
+
+    if not isinstance(schedule, Schedule):
+        raise UnfusableScheduleError(
+            f"{getattr(schedule, 'name', schedule)!r}: the hierarchical "
+            f"TAM engine has no rank op programs to fuse (m=15/16 run "
+            f"their 3-hop relay on jax_sim)")
+    if schedule.collective:
+        raise UnfusableScheduleError(
+            f"schedule {schedule.name!r} is a dense collective (m=5/8): "
+            f"it lowers to one vendor exchange and has no throttle "
+            f"rounds to fuse")
+    if getattr(schedule, "n_staging", 0):
+        raise UnfusableScheduleError(
+            f"repaired schedule (fault={schedule.fault!r}): the fused "
+            f"kernel cannot represent relay staging rows; run the "
+            f"detour on local or jax_sim (the jax_shard refusal)")
+    spec = parse_fault(getattr(schedule, "fault", None))
+    if spec.slow:
+        raise UnfusableScheduleError(
+            f"schedule {schedule.name!r} carries slow-rank injection "
+            f"(fault={schedule.fault!r}): the fused kernel does not "
+            f"lower delay loops; run slow-rank scenarios on jax_sim "
+            f"or jax_shard")
+
+    p = schedule.pattern
+    from tpu_aggcomm.harness.verify import slot_shapes
+    n_send_slots, n_recv_slots = slot_shapes(p)
+
+    ext = schedule.data_edges_ext()
+    ext = ext[dead_edge_mask(ext, spec)]
+    if len(ext) and (ext[:, 6] != 0).any():
+        raise UnfusableScheduleError(
+            f"schedule {schedule.name!r} has staging-flagged edges "
+            f"without staging rows — refusing to guess a lowering")
+    if len(ext) and (ext[:, 3] < 0).any():
+        bad = ext[ext[:, 3] < 0][0]
+        raise UnfusableScheduleError(
+            f"schedule {schedule.name!r}: edge {int(bad[0])}->"
+            f"{int(bad[1])} in round {int(bad[4])} has no matching "
+            f"receive slot to land in")
+    if len(ext) > MAX_FUSED_EDGES:
+        raise UnfusableScheduleError(
+            f"schedule {schedule.name!r} has {len(ext)} copy edges, over "
+            f"the fused-kernel ceiling of {MAX_FUSED_EDGES} (each edge "
+            f"unrolls to one in-kernel DMA); use the fenced jax_sim "
+            f"lowering at this scale")
+
+    barriers = barrier_rounds_of(schedule)
+    rounds = []
+    n_rounds = int(ext[:, 4].max()) + 1 if len(ext) else 0
+    for r in range(n_rounds):
+        sel = ext[ext[:, 4] == r]
+        if len(sel) == 0:
+            continue
+        seen: dict = {}
+        edges = []
+        for row in sel:
+            src, dst, ss, ds = (int(row[0]), int(row[1]), int(row[2]),
+                                int(row[3]))
+            cell = (dst, ds)
+            if cell in seen:
+                raise UnfusableScheduleError(
+                    f"schedule {schedule.name!r}: recv slot {cell} is "
+                    f"written twice in round {r} (by {seen[cell]} and "
+                    f"{src}) — racing in-flight copies")
+            seen[cell] = src
+            edges.append((src, ss, dst, ds))
+        rounds.append((r, tuple(edges)))
+
+    orphans = set(barriers) - {r for r, _e in rounds}
+    if orphans:
+        raise UnfusableScheduleError(
+            f"schedule {schedule.name!r} has barrier-only rounds "
+            f"{sorted(orphans)} with no data edges; the fused round "
+            f"lowering cannot represent a standalone fence")
+    return FusePlan(nprocs=p.nprocs, data_size=p.data_size,
+                    n_send_slots=n_send_slots, n_recv_slots=n_recv_slots,
+                    rounds=tuple(rounds),
+                    barriers=tuple(sorted(barriers.items())))
+
+
+def plan_round_matrices(plan: FusePlan) -> dict:
+    """The fused step export: per-round ``{(src, dst): bytes}`` payload
+    matrices, every edge one ``data_size`` arena-row copy — the view
+    :func:`cross_check_export` pins against ``obs/traffic.round_edges``."""
+    out: dict = {}
+    for r, edges in plan.rounds:
+        cell: dict = {}
+        for (src, _ss, dst, _ds) in edges:
+            cell[(src, dst)] = cell.get((src, dst), 0) + plan.data_size
+        out[r] = cell
+    return out
+
+
+def semaphore_deps(plan: FusePlan) -> list:
+    """The in-kernel wait graph as ``(earlier_round, later_round)``
+    pairs: round k+1's copy starts are program-ordered after round k's
+    semaphore drain, so the transitive order covers every round pair —
+    the fence structure tests pin against ``analysis/check.py``'s
+    round-monotonicity property."""
+    ids = [r for r, _e in plan.rounds]
+    return list(zip(ids, ids[1:]))
+
+
+def cross_check_export(schedule) -> dict:
+    """Prove the fused step export equals the op-program traffic view.
+
+    Returns ``{"status": "MATCH", ...}`` or ``{"status": "SKIPPED",
+    "reason": ...}`` (unfusable schedules refuse by design — a refusal
+    is not a drift); raises :class:`FusedExportError` when the two
+    accountings disagree, naming the divergent round and cell. The
+    payload universe on both sides is network edges + COPY self-edges
+    (``Schedule.data_edges`` == ``round_edges``' edges+copies), so the
+    fused kernel's per-round src→dst matrices can never drift from what
+    ``inspect traffic`` audits and bounds against ``-c``.
+    """
+    from tpu_aggcomm.faults.spec import parse_fault
+    from tpu_aggcomm.obs.traffic import round_edges
+
+    spec = parse_fault(getattr(schedule, "fault", None))
+    if spec.deadlinks and isinstance(schedule, Schedule):
+        from tpu_aggcomm.faults.inject import dead_edge_mask
+        if not dead_edge_mask(schedule.data_edges_ext(), spec).all():
+            return {"status": "SKIPPED",
+                    "reason": "unrepaired dead-link realization drops "
+                              "payload by design (masked edges would "
+                              "fail --verify visibly); the export "
+                              "cross-check audits healthy or repaired "
+                              "schedules"}
+    try:
+        plan = fuse_plan(schedule)
+    except UnfusableScheduleError as e:
+        return {"status": "SKIPPED", "reason": str(e)}
+
+    fused = plan_round_matrices(plan)
+    program: dict = {}
+    for r, cell in round_edges(schedule).items():
+        merged: dict = {}
+        for table in (cell["edges"], cell["copies"]):
+            for pair, nbytes in table.items():
+                merged[pair] = merged.get(pair, 0) + int(nbytes)
+        if merged:
+            program[r] = merged
+
+    for r in sorted(set(fused) | set(program)):
+        f, g = fused.get(r, {}), program.get(r, {})
+        for pair in sorted(set(f) | set(g)):
+            if f.get(pair, 0) != g.get(pair, 0):
+                raise FusedExportError(
+                    f"schedule {schedule.name!r} round {r}: fused plan "
+                    f"moves {f.get(pair, 0)} bytes for "
+                    f"{pair[0]}->{pair[1]}, op programs say "
+                    f"{g.get(pair, 0)}")
+
+    deps = semaphore_deps(plan)
+    ids = [r for r, _e in plan.rounds]
+    if ids != sorted(ids):
+        raise FusedExportError(
+            f"schedule {schedule.name!r}: fused rounds out of order "
+            f"({ids})")
+    if plan.barrier_counts() != barrier_rounds_of(schedule):
+        raise FusedExportError(
+            f"schedule {schedule.name!r}: fused barrier export "
+            f"{plan.barrier_counts()} != schedule barriers "
+            f"{barrier_rounds_of(schedule)}")
+    return {"status": "MATCH", "rounds": len(plan.rounds),
+            "edges": plan.n_edges, "fences": len(deps),
+            "bytes": plan.n_edges * plan.data_size}
+
+
+def export_sweep(nprocs: int, cb_nodes: int, comm_size: int, *,
+                 data_size: int = 2048, proc_node: int = 1,
+                 agg_type: int = 0, fault: str | None = None,
+                 barrier_type: int = 0) -> list:
+    """Cross-check every registry method's fused export at one shape —
+    the ``inspect check/traffic --fused-export`` gate body (jax-free).
+    Drift is a row, not an exception, so one bad method cannot hide the
+    rest of the sweep."""
+    from tpu_aggcomm.core.methods import METHODS, compile_method, method_ids
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+
+    p = AggregatorPattern(nprocs=nprocs, cb_nodes=cb_nodes,
+                          data_size=data_size, placement=agg_type,
+                          proc_node=proc_node, comm_size=comm_size)
+    rows = []
+    for m in method_ids():
+        sched = compile_method(m, p, barrier_type=barrier_type)
+        if fault:
+            from tpu_aggcomm.faults import (FaultSpecError, RepairError,
+                                            repair_schedule)
+            try:
+                sched = repair_schedule(sched, fault,
+                                        barrier_type=barrier_type)
+            except (FaultSpecError, RepairError) as e:
+                rows.append({"method": m, "name": METHODS[m].name,
+                             "status": "SKIPPED",
+                             "reason": f"repair refused: {e}"})
+                continue
+        try:
+            rep = cross_check_export(sched)
+        except FusedExportError as e:
+            rows.append({"method": m, "name": METHODS[m].name,
+                         "status": "DRIFT", "reason": str(e)})
+            continue
+        rows.append({"method": m, "name": METHODS[m].name, **rep})
+    return rows
+
+
+def render_export_sweep(rows: list, *, fault: str | None = None) -> str:
+    lines = [f"fused step export vs op-program traffic"
+             f"{' (fault=' + fault + ')' if fault else ''}:"]
+    for r in rows:
+        if r["status"] == "MATCH":
+            lines.append(f"  m={r['method']:>2} {r['name']:<26} MATCH "
+                         f"({r['rounds']} rounds, {r['edges']} edges, "
+                         f"{r['fences']} fences)")
+        else:
+            lines.append(f"  m={r['method']:>2} {r['name']:<26} "
+                         f"{r['status']}: {r['reason']}")
+    n_drift = sum(1 for r in rows if r["status"] == "DRIFT")
+    lines.append(f"  {sum(1 for r in rows if r['status'] == 'MATCH')} "
+                 f"matched, {sum(1 for r in rows if r['status'] == 'SKIPPED')} "
+                 f"skipped (unfusable by design), {n_drift} drifted")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# kernel-build half — everything below imports jax, lazily
+
+
+def _row_geometry(lane_dtype, w: int) -> tuple:
+    """(sublanes, lanes) of one arena slot row, tile-aligned for the
+    lane dtype: uint32 rides the (8, 128) tile, uint8 the pallas_dma
+    (4, 128) discipline. Rows are always copied WHOLE so the DMA engine
+    never slices inside a tile."""
+    sub = 8 if np.dtype(lane_dtype).itemsize == 4 else 4
+    lanes = max(128, -(-(-(-w // sub)) // 128) * 128)  # pad128(ceil(w/sub))
+    return sub, lanes
+
+
+def build_fused_rep(plan: FusePlan, *, lane, interpret: bool):
+    """Emit ``rep(send (n, S, w) lanes) -> recv (n, R+1, w) lanes`` — one
+    ``pl.pallas_call`` over the whole plan.
+
+    Arenas are ``(n, slots, sub, lanes)`` in the lane dtype; each slot
+    row is one tile-aligned ``(sub, lanes)`` block so every copy is a
+    whole-row DMA with STATIC indices (no dynamic sublane slicing —
+    the Mosaic legality rule pallas_dma's first compiled runs surfaced).
+    The recv output aliases a zero-initialized input (Mosaic forbids
+    direct stores into ANY-space refs). Per round: start every edge's
+    ``make_async_copy``, then drain them on the shared DMA semaphore —
+    the drain is the round fence.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tpu_aggcomm.compat import tpu_compiler_params
+
+    _ndt, jdt, w = lane
+    n, S, R = plan.nprocs, plan.n_send_slots, plan.n_recv_slots
+    sub, lanes = _row_geometry(np.dtype(jdt), w)
+    rounds = plan.rounds
+
+    def kernel(send_r, recv0_r, recv_r, sem):
+        del recv0_r  # recv_r aliases it; zeroing happens in XLA
+        for _rid, edges in rounds:
+            copies = [pltpu.make_async_copy(
+                send_r.at[src, ss], recv_r.at[dst, ds], sem)
+                for (src, ss, dst, ds) in edges]
+            for c in copies:      # the round's in-flight window (-c wide)
+                c.start()
+            for c in copies:      # the drain IS the round fence
+                c.wait()
+
+    grid_call = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, R + 1, sub, lanes), jdt),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA],
+        compiler_params=tpu_compiler_params(has_side_effects=True),
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )
+
+    pad = sub * lanes - w
+
+    def rep(send):
+        sa = jnp.pad(send, ((0, 0), (0, 0), (0, pad)))
+        sa = sa.reshape(n, S, sub, lanes)
+        recv0 = jnp.zeros((n, R + 1, sub, lanes), dtype=jdt)
+        out = grid_call(sa, recv0)
+        return out.reshape(n, R + 1, sub * lanes)[:, :, :w]
+
+    return rep

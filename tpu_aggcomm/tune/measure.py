@@ -19,7 +19,8 @@ the same environment computes.
 
 from __future__ import annotations
 
-__all__ = ["record_device_facts", "make_jax_sim_sampler"]
+__all__ = ["record_device_facts", "make_jax_sim_sampler",
+           "make_pallas_fused_sampler"]
 
 
 def record_device_facts() -> None:
@@ -50,6 +51,39 @@ def make_jax_sim_sampler(*, nprocs: int, data_size: int, proc_node: int,
 
     record_device_facts()
     backend = JaxSimBackend()
+    schedules: dict[str, object] = {}
+
+    def sampler(cid: str, batch: int) -> list[float]:
+        if cid not in schedules:
+            c = parse_cid(cid)
+            schedules[cid] = compile_method(c.method, AggregatorPattern(
+                nprocs=nprocs, cb_nodes=c.cb_nodes,
+                data_size=max(data_size, 1), proc_node=proc_node,
+                comm_size=c.comm_size, placement=c.agg_type))
+        return backend.measure_trial_samples(
+            schedules[cid], iters_small=iters_small, iters_big=iters_big,
+            trials=batch_trials, windows=windows)
+
+    return sampler
+
+
+def make_pallas_fused_sampler(*, nprocs: int, data_size: int,
+                              proc_node: int, iters_small: int = 50,
+                              iters_big: int = 1050, batch_trials: int = 3,
+                              windows: int = 1):
+    """``sampler(cid, batch) -> list[float]`` over the fused-kernel
+    backend — the same chained differenced scaffold as the jax_sim
+    sampler (PallasFusedBackend subclasses it), so the tuner can race
+    fused vs fenced under one measurement discipline. An unfusable
+    candidate raises its NAMED refusal out of the race rather than
+    returning fabricated samples."""
+    from tpu_aggcomm.backends.pallas_fused import PallasFusedBackend
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+    from tpu_aggcomm.tune.space import parse_cid
+
+    record_device_facts()
+    backend = PallasFusedBackend()
     schedules: dict[str, object] = {}
 
     def sampler(cid: str, batch: int) -> list[float]:
