@@ -6,7 +6,11 @@ definitions ``bench.py --check-regression`` consumes), plus every
 or stale tune entry must fail validation here instead of silently
 steering ``--auto`` runs — and every ``TRAFFIC_*.json`` static traffic
 audit (obs/traffic.py, traffic-v1): a committed audit whose verdict its
-own numbers contradict must fail too.
+own numbers contradict must fail too — and every ``PREDICT_*.json``
+cost-model artifact (model/artifact.py, predict-v1) and
+``COMPARE_*.json`` trace delta (obs/compare.py, compare-v1), under the
+same rule: an explain verdict its own recorded deviation contradicts
+fails here.
 
 Usage: ``python scripts/check_bench_schema.py [root]`` (default: repo
 root). Prints one line per artifact, exits nonzero if any artifact is
@@ -23,7 +27,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tpu_aggcomm.obs.history import load_history
 from tpu_aggcomm.obs.regress import (parsed_schema_version, validate_bench,
-                                     validate_multichip, validate_traffic,
+                                     validate_compare, validate_multichip,
+                                     validate_predict, validate_traffic,
                                      validate_tune)
 
 
@@ -33,6 +38,30 @@ def check(root: str) -> int:
     n_errors = 0
     n_tune = 0
     n_traffic = 0
+    n_model = 0
+    # PREDICT_*.json cost-model artifacts (model/artifact.py) and
+    # COMPARE_*.json trace deltas (obs/compare.py): absence is fine,
+    # a present-but-broken one is not — same rule as the tune cache
+    for pattern, validate in (("PREDICT_*.json", validate_predict),
+                              ("COMPARE_*.json", validate_compare)):
+        for path in sorted(glob.glob(os.path.join(root, pattern))):
+            n_files += 1
+            n_model += 1
+            name = os.path.basename(path)
+            try:
+                with open(path) as fh:
+                    blob = json.load(fh)
+            except (OSError, ValueError) as e:
+                n_errors += 1
+                print(f"FAIL {name}: unparsable JSON ({e})")
+                continue
+            errors = validate(blob, name)
+            if errors:
+                n_errors += len(errors)
+                for e in errors:
+                    print(f"FAIL {e}")
+            else:
+                print(f"ok   {name} ({blob.get('schema', '?')})")
     # TRAFFIC_*.json static-audit artifacts (obs/traffic.py): like the
     # tune cache, absence is fine, a present-but-broken one is not
     for path in sorted(glob.glob(os.path.join(root, "TRAFFIC_*.json"))):
@@ -107,8 +136,8 @@ def check(root: str) -> int:
         # an absent tune cache is fine; an absent bench history is not
         print(f"FAIL no BENCH_r*/MULTICHIP_r*.json found under {root}")
         return 1
-    print(f"{n_files} artifact(s) ({n_tune} tune, {n_traffic} traffic), "
-          f"{n_errors} schema error(s)")
+    print(f"{n_files} artifact(s) ({n_tune} tune, {n_traffic} traffic, "
+          f"{n_model} model/compare), {n_errors} schema error(s)")
     return 1 if n_errors else 0
 
 

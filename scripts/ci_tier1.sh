@@ -83,6 +83,16 @@ for f in TUNE_*.json; do
   [ -e "$f" ] || continue
   python -m tpu_aggcomm.cli tune --replay "$f" || post_rc=1
 done
+# cost-model replay gate (tpu_aggcomm/model/, jax-free): every
+# committed PREDICT_*.json must rebuild byte-for-byte (minus its
+# timestamp) from its recorded inputs and seed — calibration, grid
+# validation, crossover claim, and every explain verdict re-derived
+# REPRODUCED, the same discipline as tune --replay. An explain
+# artifact that cannot reproduce its verdicts must not be cited.
+for f in PREDICT_*.json; do
+  [ -e "$f" ] || continue
+  python -m tpu_aggcomm.cli inspect explain --replay "$f" || post_rc=1
+done
 # live-telemetry gate (obs/export.py + obs/history.py, jax-free):
 # render OpenMetrics from every committed trace and validate it with
 # the parser in obs/regress.py (format drift fails HERE, not in a

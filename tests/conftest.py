@@ -30,6 +30,13 @@ if not _TPU_OPT_IN:
     jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` gate; still runs "
+        "in the plain full-suite invocation")
+
+
 def pytest_collection_modifyitems(config, items):
     if not _TPU_OPT_IN:
         return

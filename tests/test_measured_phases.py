@@ -177,7 +177,8 @@ def test_round_splits_guards(backend):
                 compile_method(bad, AggregatorPattern(**README)))
 
 
-def test_run_measured_phases_row(backend, tmp_path):
+@pytest.mark.slow  # ~100 s; the single-round fallback test drives the
+def test_run_measured_phases_row(backend, tmp_path):  # same CLI path
     from tpu_aggcomm.harness.report import provenance_path
 
     cfg = ExperimentConfig(
@@ -212,6 +213,7 @@ def test_single_round_falls_back_to_measured_split(backend, tmp_path):
         "measured-split(post,deliver)+attributed(waits)"
 
 
+@pytest.mark.slow  # ~110 s: a full measured-rounds ladder for one column
 def test_m2_send_wait_column_is_measured(backend):
     """m=2 charges each round's Waitall to send_wait (mpi_test.c:
     1909-1918): under measured-rounds those column entries come from

@@ -245,7 +245,8 @@ class TestShardedTwoLevel:
             else:
                 np.testing.assert_array_equal(recv[r], oracle[r])
 
-    @pytest.mark.parametrize("method", [15, 16])
+    @pytest.mark.slow  # ~2 min for the pair; the ragged flagship cell
+    @pytest.mark.parametrize("method", [15, 16])  # below stays in tier-1
     def test_flagship_16384_ranks_on_8_devices(self, method):
         """The reference's defining TAM configuration — 16,384 ranks on
         256 nodes x 64 ranks (script_theta_all_to_many_256.sh:3,11) —

@@ -284,6 +284,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "'100,m3*0.5' makes m=3 the 2x-faster oracle); "
                          "jax-free, deterministic — the artifact it "
                          "writes replays like a measured one")
+    tn.add_argument("--model-prune", nargs="?", const=1.5, type=float,
+                    default=None, metavar="MARGIN",
+                    help="multi-fidelity prune: before racing, price "
+                         "every candidate with the newest committed "
+                         "PREDICT_*.json cost model (jax-free, static "
+                         "features only) and drop those predicted worse "
+                         "than MARGIN x the best prediction (default "
+                         "1.5). Advisory-by-margin, never alone: the "
+                         "survivors are still RACED on fresh samples, "
+                         "candidates the model cannot price are kept, "
+                         "and the whole prune (artifact, params, "
+                         "predictions, margin) is recorded in "
+                         "TUNE_*.json and re-derived by --replay")
     tn.add_argument("--replay", metavar="TUNE_JSON", default=None,
                     help="re-derive the elimination order and winner "
                          "from a TUNE_*.json's recorded samples (no "
@@ -309,7 +322,8 @@ def build_parser() -> argparse.ArgumentParser:
     ins.add_argument("what", nargs="?", choices=["trace", "compare",
                                                  "report", "ledger",
                                                  "traffic", "check",
-                                                 "live", "history"],
+                                                 "live", "history",
+                                                 "explain"],
                      default=None,
                      help="'trace' to summarize *.trace.jsonl files, "
                           "'compare' to diff two of them, 'report' for "
@@ -328,7 +342,11 @@ def build_parser() -> argparse.ArgumentParser:
                           "(tails the crash-safe journal + trace JSONL, "
                           "jax-free), 'history' for the longitudinal "
                           "artifact index + seeded multi-round trend "
-                          "gate — instead of a compiled schedule")
+                          "gate, 'explain' for the analytic cost model "
+                          "(tpu_aggcomm/model/, jax-free): "
+                          "predicted-vs-measured round walls with NAMED "
+                          "divergence verdicts over flight-recorder "
+                          "traces — instead of a compiled schedule")
     ins.add_argument("trace_file", nargs="*", default=[],
                      help="trace files: one or more to summarize "
                           "('trace'), exactly two files or directories to "
@@ -397,7 +415,17 @@ def build_parser() -> argparse.ArgumentParser:
                           "schema-checked by scripts/check_bench_schema."
                           "py); 'check': write the check-v1 report; "
                           "'history': also write the longitudinal "
-                          "history-v1 index (atomic_write)")
+                          "history-v1 index (atomic_write); 'explain': "
+                          "write the calibrated predict-v1 artifact "
+                          "(PREDICT_*.json); 'compare': write the "
+                          "machine-readable compare-v1 delta")
+    ins.add_argument("--replay", metavar="PREDICT_JSON", default=None,
+                     help="'explain' only: re-derive the committed "
+                          "predict-v1 artifact from its recorded inputs "
+                          "+ seed and byte-compare (REPRODUCED or "
+                          "MISMATCH naming the divergent keys — the "
+                          "same contract as tune --replay; ci_tier1.sh "
+                          "gates every committed PREDICT_*.json)")
     ins.add_argument("--results-csv", default="results.csv",
                      help="'live' only: the running sweep's results CSV "
                           "— its crash-safe journal "
@@ -897,6 +925,69 @@ def _ints(csv_text: str) -> list[int]:
     return vals
 
 
+def _model_prune(args, cands):
+    """The ``tune --model-prune`` block: price every candidate with the
+    newest committed PREDICT_*.json and split the grid into kept/pruned
+    at ``margin x best``. Returns the JSON-able record ``{"artifact",
+    "platform", "params", "margin", "predictions", "best", "pruned",
+    "kept"}`` (recorded verbatim in TUNE_*.json so ``--replay`` can
+    re-derive the split), or None with a stderr warning when no usable
+    artifact exists — a missing model must degrade to the full race,
+    never block tuning."""
+    import os
+
+    from tpu_aggcomm.model.artifact import load_artifact
+    from tpu_aggcomm.model.predict import (newest_predict_path,
+                                           predict_candidates)
+    from tpu_aggcomm.obs.ledger import manifest
+
+    margin = float(args.model_prune)
+    if margin < 1.0:
+        raise SystemExit(f"tune --model-prune: margin must be >= 1.0 "
+                         f"(got {margin:g}) — a margin below 1 would "
+                         f"prune the predicted best itself")
+    path = newest_predict_path(args.tune_root)
+    if path is None and os.path.abspath(args.tune_root) \
+            != os.path.abspath("."):
+        path = newest_predict_path(".")
+    if path is None:
+        print("tune --model-prune: no committed PREDICT_*.json found — "
+              "racing the full space", file=sys.stderr)
+        return None
+    try:
+        art = load_artifact(path)
+    except (OSError, ValueError) as e:
+        print(f"tune --model-prune: unreadable {path}: {e} — racing "
+              f"the full space", file=sys.stderr)
+        return None
+    env = (manifest().get("env") or {})
+    platform = "tpu" if env.get("tunnel_armed") \
+        and env.get("jax_platforms") != "cpu" else "cpu"
+    block = (art.get("platforms") or {}).get(platform)
+    if not block:
+        print(f"tune --model-prune: {os.path.basename(path)} has no "
+              f"{platform!r} calibration — racing the full space",
+              file=sys.stderr)
+        return None
+    preds = predict_candidates(cands, block["params"],
+                               nprocs=args.nprocs,
+                               data_size=args.data_size,
+                               proc_node=args.proc_node)
+    priced = {cid: s for cid, s in preds.items() if s is not None}
+    if not priced:
+        print(f"tune --model-prune: no candidate is priceable by the "
+              f"model — racing the full space", file=sys.stderr)
+        return None
+    best = min(priced, key=lambda cid: (priced[cid], cid))
+    cut = priced[best] * margin
+    pruned = sorted(cid for cid, s in priced.items() if s > cut)
+    kept = [c.cid for c in cands if c.cid not in set(pruned)]
+    return {"artifact": os.path.basename(path), "platform": platform,
+            "params": dict(block["params"]), "margin": margin,
+            "predictions": preds, "best": best,
+            "pruned": pruned, "kept": kept}
+
+
 def _run_tune(args) -> int:
     """The autotuner: racing search (measured or synthetic) persisting a
     TUNE_*.json, or --replay re-deriving a stored verdict jax-free."""
@@ -933,6 +1024,25 @@ def _run_tune(args) -> int:
         same = (res.winner == rec.get("winner")
                 and json.loads(json.dumps(res.eliminations))
                 == rec.get("eliminations"))
+        mp = entry.get("model_prune")
+        if mp is not None:
+            # re-derive the --model-prune split from the recorded
+            # predictions + margin alone (no model import, no PREDICT
+            # artifact): same cut rule as cli._model_prune, and the
+            # raced order must be exactly the kept list
+            priced = {cid: s for cid, s in mp["predictions"].items()
+                      if s is not None}
+            best = min(priced, key=lambda cid: (priced[cid], cid))
+            cut = priced[best] * float(mp["margin"])
+            pruned = sorted(cid for cid, s in priced.items() if s > cut)
+            mp_same = (best == mp.get("best")
+                       and pruned == mp.get("pruned")
+                       and rec.get("order") == mp.get("kept"))
+            print(f"  model-prune: {len(pruned)} pruned by "
+                  f"{mp.get('artifact')} [{mp.get('platform')}] at "
+                  f"margin {mp.get('margin'):g} -> "
+                  f"{'REPRODUCED' if mp_same else 'MISMATCH vs stored record'}")
+            same = same and mp_same
         print(f"replay {os.path.basename(args.replay)}: winner "
               f"{res.winner} after {len(res.eliminations)} "
               f"elimination(s) over {res.batches_run} batch(es) -> "
@@ -954,6 +1064,26 @@ def _run_tune(args) -> int:
     except space_mod.SpaceError as e:
         raise SystemExit(f"tune: {e}")
     cids = [c.cid for c in cands]
+
+    # --model-prune: multi-fidelity gate — price the grid with the
+    # committed cost model (static features, jax-free) and skip racing
+    # candidates predicted hopeless by a wide margin. The model never
+    # decides alone: survivors are raced on fresh samples, unpriceable
+    # candidates are kept, and the full prune is recorded so --replay
+    # re-derives it from the artifact with no model import.
+    prune_rec = None
+    if args.model_prune is not None:
+        prune_rec = _model_prune(args, cands)
+        if prune_rec is not None and prune_rec["pruned"]:
+            kept = set(prune_rec["kept"])
+            cands = [c for c in cands if c.cid in kept]
+            cids = [c.cid for c in cands]
+            print(f"tune --model-prune: {len(prune_rec['pruned'])} "
+                  f"candidate(s) predicted worse than "
+                  f"{prune_rec['margin']:g}x the best "
+                  f"({prune_rec['best']}) by {prune_rec['artifact']} "
+                  f"[{prune_rec['platform']}] — racing "
+                  f"{len(cids)} survivor(s)")
 
     if args.synthetic:
         try:
@@ -1012,7 +1142,7 @@ def _run_tune(args) -> int:
         race=race_rec,
         winner={"method": win.method, "cb_nodes": win.cb_nodes,
                 "comm_size": win.comm_size, "agg_type": win.agg_type},
-        synthetic=bool(args.synthetic))
+        synthetic=bool(args.synthetic), model_prune=prune_rec)
 
     meds = res.medians()
     for e in res.eliminations:
@@ -1245,6 +1375,89 @@ def _run_inspect_check(args) -> int:
     return rc
 
 
+def _run_inspect_explain(args) -> int:
+    """The analytic cost model (tpu_aggcomm/model/, jax-free).
+
+    Three modes: ``--replay PREDICT_*.json`` re-derives a committed
+    artifact to REPRODUCED/MISMATCH (the ci_tier1.sh gate);
+    ``explain TRACE...`` prints predicted-vs-measured round walls with
+    named divergence verdicts (preferring the committed artifact's
+    calibration, else calibrating fresh); bare ``explain`` calibrates,
+    validates rank-order on the committed grids, and prints the
+    summary (``--json PATH`` writes the predict-v1 artifact).
+
+    Verdicts are advisory: the model names suspects, measured walls
+    stay the source of truth — predictions never gate alone."""
+    from tpu_aggcomm.model import (ModelError, build_artifact,
+                                   explain_trace, load_artifact,
+                                   render_explain, replay_artifact)
+    from tpu_aggcomm.model.predict import newest_predict_path
+
+    if args.replay:
+        try:
+            same, diffs = replay_artifact(args.replay)
+        except (ModelError, OSError, ValueError, KeyError) as e:
+            raise SystemExit(f"inspect explain --replay: {e}")
+        if same:
+            print(f"explain replay: REPRODUCED ({args.replay})")
+            return 0
+        print(f"explain replay: MISMATCH vs {args.replay} "
+              f"(divergent keys: {', '.join(diffs)})")
+        return 1
+
+    try:
+        newest = newest_predict_path(".")
+        if newest is not None:
+            art = load_artifact(newest)
+            src = newest
+        else:
+            art = build_artifact(".")
+            src = "fresh calibration (no committed PREDICT_*.json)"
+    except (ModelError, OSError, ValueError, KeyError) as e:
+        raise SystemExit(f"inspect explain: cannot calibrate: {e}")
+
+    if args.trace_file:
+        rc = 0
+        for path in args.trace_file:
+            try:
+                print(render_explain(
+                    explain_trace(path, art["platforms"])))
+            except (ModelError, OSError, ValueError, KeyError) as e:
+                print(f"inspect explain: {path}: {e}")
+                rc = 1
+        print(f"[calibration: {src}]")
+        return rc
+
+    # bare: calibration + validation summary
+    print(f"cost model [{src}]")
+    for plat, block in sorted(art["platforms"].items()):
+        params = ", ".join(f"{k}={v * 1e6:.4g}us"
+                           for k, v in block["params"].items())
+        print(f"  {plat} ({block['granularity']}-fit, "
+              f"{block['observations']} obs, "
+              f"tol=±{block['tolerance_rel']:.0%}): {params}")
+    for name, v in sorted(art["validation"].items()):
+        t1 = v["top1"]
+        tau = "n/a" if v["tau_b"] is None else f"{v['tau_b']:.3f}"
+        held = " HELD-OUT" if v["held_out"] else ""
+        print(f"  {name}{held}: tau_b={tau} over {v['cells']} cells; "
+              f"top-1 {'AGREES' if t1['agree'] else 'disagrees'} "
+              f"(measured best m={t1['measured_best']['method']} "
+              f"c={t1['measured_best']['comm']}, predicted class of "
+              f"{len(t1['predicted_class'])})")
+    cx = art.get("crossover") or {}
+    if "crossover_max_comm" in cx:
+        print(f"  fused-vs-fenced crossover ({cx['grid']}, noise floor "
+              f"{cx['noise_floor_rel']:.0%}): "
+              f"{cx['crossover_max_comm']}")
+    if args.json:
+        from tpu_aggcomm.model import save_artifact
+        save_artifact(args.json, art if newest is None
+                      else build_artifact("."))
+        print(f"predict artifact written: {args.json}")
+    return 0
+
+
 def _run_inspect(args) -> int:
     """Schedule-shape report: what the -c/-m/-t choices actually compile
     to. This is the question the per-phase timers approximate at runtime,
@@ -1275,7 +1488,8 @@ def _run_inspect(args) -> int:
             raise SystemExit("inspect compare: need exactly two trace "
                              "files (or two sweep-trace directories)")
         from tpu_aggcomm.obs.compare import (TraceCompareError,
-                                             compare_paths, render_compare)
+                                             compare_paths, render_compare,
+                                             save_compare)
         try:
             res = compare_paths(args.trace_file[0], args.trace_file[1],
                                 by=args.by,
@@ -1285,7 +1499,12 @@ def _run_inspect(args) -> int:
         except (OSError, ValueError, KeyError) as e:
             raise SystemExit(f"inspect compare: unreadable trace file: {e}")
         print(render_compare(res), end="")
+        if args.json:
+            path = save_compare(args.json, res)
+            print(f"compare artifact written: {path}")
         return 0
+    if args.what == "explain":
+        return _run_inspect_explain(args)
     if args.what == "traffic":
         return _run_inspect_traffic(args)
     if args.what == "check":

@@ -1,0 +1,59 @@
+"""Analytic cost model: what a schedule SHOULD cost, before running it.
+
+ROADMAP item 4's missing layer, in the spirit of HiCCL's
+decomposition-based cost analysis (arxiv 2408.05962) and the
+closed-form per-round bytes x incast x latency expressions of arxiv
+2006.13112: the traffic auditor (obs/traffic.py) already derives every
+static feature of a compiled schedule — bytes per round, per-rank
+bottleneck traffic, incast depth, detour inflation under a fault spec —
+and this package turns those features into **predicted round walls**
+through a 5-parameter linear model per platform::
+
+    round_wall = fence_s
+               + bytes_kb      * bytes_s_per_kb        (aggregate payload)
+               + bottleneck_kb * bottleneck_s_per_kb   (hottest rank's in+out)
+               + spill_kb      * spill_s_per_kb        (incast beyond the
+                                                        256 KB landing zone)
+    rep_total  = rpc_s + sum(round_walls)
+
+Parameters are calibrated by a seeded, relative-error-weighted
+non-negative least-squares fit (model/fit.py) over COMMITTED artifacts
+only — the RESULTS_TPU.md quiet-chip grids for the TPU platform,
+per-round trace walls for the CPU platform — so the same artifacts in
+always produce the same parameters out (the tune --replay / regression
+gate seed discipline). Everything persists as ``PREDICT_*.json``
+(predict-v1, obs.atomic_write, validated by obs/regress.py), replayable
+byte-for-byte via ``cli inspect explain --replay``.
+
+Predictions NEVER gate alone: they explain and prune (``inspect
+explain`` verdicts, ``tune --model-prune``), while measured verdicts
+stay the source of truth.
+
+jax-free by contract (analysis/lint.py PURE_PACKAGES): the model must
+price schedules precisely where a wedged tunnel hangs ``import jax`` —
+the live-ETA floor (obs/live.py), the replay gate, and the tuner's
+jax-free pruning path all depend on it.
+"""
+
+from tpu_aggcomm.model.artifact import (PREDICT_SCHEMA, build_artifact,
+                                        load_artifact, newest_artifact,
+                                        replay_artifact, save_artifact)
+from tpu_aggcomm.model.calibrate import (ModelError, calibrate_cpu,
+                                         calibrate_tpu, parse_results_grids)
+from tpu_aggcomm.model.explain import explain_trace, render_explain
+from tpu_aggcomm.model.features import (PARAM_NAMES, SPILL_THRESHOLD_BYTES,
+                                        round_features, schedule_features)
+from tpu_aggcomm.model.fit import kendall_tau_b, nnls
+from tpu_aggcomm.model.predict import (floor_from_round_traffic,
+                                       floor_from_trace_events,
+                                       predict_schedule)
+from tpu_aggcomm.model.validate import crossover_prediction, validate_grids
+
+__all__ = ["PREDICT_SCHEMA", "PARAM_NAMES", "SPILL_THRESHOLD_BYTES",
+           "ModelError", "build_artifact", "calibrate_cpu",
+           "calibrate_tpu", "crossover_prediction", "explain_trace",
+           "floor_from_round_traffic", "floor_from_trace_events",
+           "kendall_tau_b", "load_artifact", "newest_artifact", "nnls",
+           "parse_results_grids", "predict_schedule", "render_explain",
+           "replay_artifact", "round_features", "save_artifact",
+           "schedule_features", "validate_grids"]

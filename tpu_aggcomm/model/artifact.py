@@ -1,0 +1,143 @@
+"""PREDICT_*.json — the committed cost-model artifact (schema
+``predict-v1``).
+
+One artifact holds everything the model claims, with its evidence:
+
+- ``platforms`` — the calibrated parameter blocks (tpu: cell-level fit
+  on the held-out quiet-chip grids; cpu: round-level fit on the
+  committed FAULT traces), each with its seeded divergence tolerance
+  and residuals.
+- ``validation`` — the rank-order report per grid (tau-b, top-1
+  equivalence class, strict argmin + measured penalty).
+- ``crossover`` — the pre-registered fused-vs-fenced prediction.
+- ``explain`` — the committed FAULT traces explained by the cpu block
+  (the verdict taxonomy demonstrated on real data: detour rounds
+  attributed, slow rounds named, nothing silently UNEXPLAINED).
+- ``inputs`` — every file the build consumed (relative names) plus the
+  deliberate exclusions with reasons, so ``replay_artifact`` can
+  rebuild the whole thing from the committed tree alone.
+
+``created_unix`` is the ONLY volatile key: replay rebuilds from the
+recorded inputs with the recorded seed and compares everything else
+byte-for-byte (the same REPRODUCED/MISMATCH contract as ``tune
+--replay`` and ``replay_attempts``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["PREDICT_SCHEMA", "build_artifact", "save_artifact",
+           "load_artifact", "newest_artifact", "replay_artifact"]
+
+PREDICT_SCHEMA = "predict-v1"
+
+#: Headline artifacts deliberately NOT used for calibration, with the
+#: reason recorded in every built artifact.
+EXCLUDED_INPUTS = (
+    {"artifact": "BENCH_r*.json / MULTICHIP_r*.json",
+     "reason": "headline reps measure the dense pallas_local/CPU-"
+               "fallback path, not the round-structured jax_sim "
+               "programs the model prices; mixing backends into one "
+               "parameter set would blur both"},
+)
+
+
+def build_artifact(root: str = ".", *, seed: int = 0,
+                   results_path: str | None = None,
+                   trace_paths=None) -> dict:
+    """Calibrate + validate + explain over the committed tree under
+    ``root``. Deterministic: same tree + same seed => identical blob
+    up to ``created_unix``."""
+    import glob as _glob
+
+    from tpu_aggcomm.model.calibrate import (ModelError, calibrate_cpu,
+                                             calibrate_tpu,
+                                             parse_results_grids)
+    from tpu_aggcomm.model.explain import explain_trace
+    from tpu_aggcomm.model.validate import (crossover_prediction,
+                                            validate_grids)
+
+    if results_path is None:
+        results_path = os.path.join(root, "RESULTS_TPU.md")
+    if trace_paths is None:
+        trace_paths = sorted(
+            _glob.glob(os.path.join(root, "FAULT_*.trace.jsonl")))
+    if not trace_paths:
+        raise ModelError(f"no FAULT_*.trace.jsonl under {root!r} to "
+                         f"calibrate the cpu platform from")
+
+    grids = parse_results_grids(results_path)
+    tpu = calibrate_tpu(grids, seed=seed)
+    cpu = calibrate_cpu(trace_paths, seed=seed)
+    platforms = {"tpu": tpu, "cpu": cpu}
+
+    explained = []
+    for path in trace_paths:
+        exp = explain_trace(path, platforms)
+        exp["trace"] = os.path.basename(path)
+        explained.append(exp)
+
+    return {
+        "schema": PREDICT_SCHEMA,
+        "seed": int(seed),
+        "inputs": {
+            "results_md": os.path.basename(results_path),
+            "traces": [os.path.basename(p) for p in trace_paths],
+            "excluded": [dict(e) for e in EXCLUDED_INPUTS],
+        },
+        "platforms": platforms,
+        "validation": validate_grids(grids, tpu["params"]),
+        "crossover": crossover_prediction(grids, tpu["params"]),
+        "explain": explained,
+        "created_unix": time.time(),
+    }
+
+
+def save_artifact(path: str, artifact: dict) -> None:
+    from tpu_aggcomm.obs.atomic import atomic_write
+    with atomic_write(path) as fh:
+        json.dump(artifact, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def load_artifact(path: str) -> dict:
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def newest_artifact(root: str = ".") -> dict | None:
+    """Newest committed PREDICT_*.json under ``root``, loaded — or None
+    (callers that can live without a model must keep working)."""
+    from tpu_aggcomm.model.predict import newest_predict_path
+    path = newest_predict_path(root)
+    if path is None:
+        return None
+    try:
+        return load_artifact(path)
+    except (OSError, ValueError):
+        return None
+
+
+def replay_artifact(path: str) -> tuple[bool, list[str]]:
+    """Rebuild the artifact from its recorded inputs (resolved next to
+    ``path``) with its recorded seed and byte-compare every key except
+    ``created_unix``. Returns ``(reproduced, [divergent top-level
+    keys])``."""
+    rec = load_artifact(path)
+    root = os.path.dirname(os.path.abspath(path))
+    inputs = rec.get("inputs") or {}
+    rebuilt = build_artifact(
+        root, seed=int(rec.get("seed") or 0),
+        results_path=os.path.join(root, inputs.get("results_md")
+                                  or "RESULTS_TPU.md"),
+        trace_paths=[os.path.join(root, t)
+                     for t in inputs.get("traces") or []])
+    a = json.loads(json.dumps(rec, sort_keys=True))
+    b = json.loads(json.dumps(rebuilt, sort_keys=True))
+    a.pop("created_unix", None)
+    b.pop("created_unix", None)
+    diffs = sorted(k for k in set(a) | set(b) if a.get(k) != b.get(k))
+    return (not diffs), diffs

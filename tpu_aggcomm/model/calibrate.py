@@ -1,0 +1,286 @@
+"""Calibration: platform parameters from COMMITTED artifacts only.
+
+Two honest data sources, two granularities:
+
+- **TPU, cell granularity** — the quiet-chip n=32/256/1024 throttle
+  grids in RESULTS_TPU.md (repeatability 0-1%, measured by
+  scripts/tpu_sweeps.py with ``jax_sim --chained --verify`` on one
+  serial client). The markdown tables ARE the committed artifact; this
+  module parses them rather than requiring a chip. The fit is held-out
+  by default: parameters come from the n=256 + n=1024 grids and the
+  n=32 grid is reserved for rank-order validation (model/validate.py).
+- **CPU, round granularity** — per-round walls of the committed
+  FAULT_*.trace.jsonl flight-recorder traces (obs.metrics.round_stats
+  over the attribution cell stream), matched against the recompiled
+  schedule's static round features. Slow-injected rounds are EXCLUDED
+  from the fit (an injected multiplier is not a platform cost; it is
+  re-applied at predict time instead), and recorded as such in the
+  artifact.
+
+Deliberately NOT calibration inputs: BENCH_r*.json headline numbers —
+rounds 2-5 measured the dense ``pallas_local``/CPU-fallback path, not
+the round-structured jax_sim programs the model prices; mixing
+backends into one parameter set would blur both. The exclusion is
+recorded in the artifact's ``inputs.excluded`` so the choice is
+auditable.
+
+Determinism: parsing is pure, features are static, the NNLS is exact,
+and the tolerance bootstrap is seeded — ``build_artifact`` twice over
+the same tree produces byte-identical platform blocks.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from tpu_aggcomm.model.features import (PARAM_NAMES, cell_design,
+                                        round_design, round_features)
+from tpu_aggcomm.model.fit import FitError, bootstrap_upper, nnls
+
+__all__ = ["ModelError", "GRID_SECTION", "parse_results_grids",
+           "grid_cell_features", "calibrate_tpu", "calibrate_cpu",
+           "schedule_for_run", "slow_rounds", "MIN_TOLERANCE_REL"]
+
+#: The RESULTS_TPU.md heading whose tables are the TPU calibration set.
+GRID_SECTION = "## Theta-script throttle grids"
+
+#: Tolerance floor: a platform's fit can be tight (the TPU grids
+#: reproduce within 1%), but single-trace round walls jitter more than
+#: any fit residual shows — never call a divergence smaller than 10%
+#: UNEXPLAINED.
+MIN_TOLERANCE_REL = 0.10
+
+_INF_COMM = 999_999_999
+
+
+class ModelError(ValueError):
+    """Unusable calibration input (missing grid section, malformed
+    table, traces with no attributed rounds). Always names the input."""
+
+
+def parse_results_grids(path: str = "RESULTS_TPU.md") -> dict:
+    """The quiet-chip throttle grids out of the committed markdown.
+
+    Returns ``{"n32": {"nprocs", "cb_nodes", "data_size", "cells":
+    [{"method", "comm", "us"}, ...]}, ...}`` with cells in m-major,
+    c-ascending order (the deterministic tie-break order, same contract
+    as tune/race.py). The ``∞`` row parses to comm_size 999_999_999 —
+    the same sentinel scripts/tpu_sweeps.py sweeps."""
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError as e:
+        raise ModelError(f"cannot read grid tables: {e}")
+    start = text.find(GRID_SECTION)
+    if start < 0:
+        raise ModelError(
+            f"{path}: no {GRID_SECTION!r} section — the TPU calibration "
+            f"grids are gone")
+    end = text.find("\n## ", start + 1)
+    section = text[start:end if end > 0 else len(text)]
+    m_d = re.search(r"\bd=(\d+)\b", section)
+    data_size = int(m_d.group(1)) if m_d else 2048
+
+    grids: dict = {}
+    current = None
+    for line in section.splitlines():
+        head = re.match(r"n=(\d+), a=(\d+):", line.strip())
+        if head:
+            n, a = int(head.group(1)), int(head.group(2))
+            current = {"nprocs": n, "cb_nodes": a, "data_size": data_size,
+                       "rows": []}
+            grids[f"n{n}"] = current
+            continue
+        row = re.match(
+            r"\|\s*([0-9]+|∞)\s*\|\s*([0-9.]+)\s*\|\s*([0-9.]+)\s*\|\s*$",
+            line.strip())
+        if row and current is not None:
+            comm = _INF_COMM if row.group(1) == "∞" else int(row.group(1))
+            current["rows"].append(
+                (comm, float(row.group(2)), float(row.group(3))))
+    for name, g in grids.items():
+        if not g["rows"]:
+            raise ModelError(f"{path}: grid {name} has no table rows")
+        cells = []
+        for mcol, method in ((1, 1), (2, 2)):
+            for comm, us1, us2 in g["rows"]:
+                cells.append({"method": method, "comm": comm,
+                              "us": us1 if mcol == 1 else us2})
+        g["cells"] = cells
+        del g["rows"]
+    if not grids:
+        raise ModelError(f"{path}: {GRID_SECTION!r} section holds no "
+                         f"'n=NN, a=AA:' grid tables")
+    return grids
+
+
+def grid_cell_features(grid: dict) -> list[dict]:
+    """Compile every grid cell's schedule (jax-free) and attach its
+    static features: ``cells`` + ``{"features", "design"}``."""
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+    from tpu_aggcomm.model.features import schedule_features
+
+    out = []
+    for cell in grid["cells"]:
+        p = AggregatorPattern(nprocs=grid["nprocs"],
+                              cb_nodes=grid["cb_nodes"],
+                              data_size=grid["data_size"],
+                              comm_size=cell["comm"])
+        feats = schedule_features(compile_method(cell["method"], p))
+        out.append(dict(cell, features={
+            "rounds": feats["rounds"], "bytes": feats["bytes"],
+            "bottleneck": feats["bottleneck"], "spill": feats["spill"]},
+            design=cell_design(feats)))
+    return out
+
+
+def _fit_block(rows, y_s, *, seed: int, granularity: str) -> dict:
+    from tpu_aggcomm.obs.metrics import percentile
+
+    weights = [1.0 / yi for yi in y_s]
+    coef = nnls(rows, y_s, weights)
+    params = {name: coef[i] for i, name in enumerate(PARAM_NAMES)}
+    resid = []
+    for r, yi in zip(rows, y_s):
+        pred = sum(a * b for a, b in zip(r, coef))
+        resid.append(abs(pred - yi) / yi if yi else 0.0)
+    tol = max(MIN_TOLERANCE_REL,
+              bootstrap_upper(resid, seed=seed))
+    return {"params": params, "granularity": granularity,
+            "observations": len(rows), "seed": int(seed),
+            "residual_rel": resid,
+            "residual_rel_p95": percentile(resid, 95),
+            "tolerance_rel": tol}
+
+
+def calibrate_tpu(grids: dict, *, fit_grids=("n256", "n1024"),
+                  seed: int = 0) -> dict:
+    """TPU platform parameters from the quiet-chip grid cells of
+    ``fit_grids`` (held-out by default: n=32 stays for validation).
+    Observation = one cell's µs/rep; design = the cell's static
+    features; weighting = 1/y (relative error); coefficients clamped
+    non-negative."""
+    rows, y_s = [], []
+    for name in fit_grids:
+        if name not in grids:
+            raise ModelError(f"fit grid {name!r} not in the parsed "
+                             f"tables ({sorted(grids)})")
+        for cell in grid_cell_features(grids[name]):
+            rows.append(cell["design"])
+            y_s.append(cell["us"] / 1e6)
+    try:
+        block = _fit_block(rows, y_s, seed=seed, granularity="cell")
+    except FitError as e:
+        raise ModelError(f"TPU calibration failed: {e}")
+    block["fit_grids"] = list(fit_grids)
+    return block
+
+
+def schedule_for_run(run: dict):
+    """Recompile the schedule a trace run record executed — including
+    the fault repair when the run carried a spec (the repaired program
+    is what ran, so its detour rounds are what the model must price).
+    Returns ``(schedule, FaultSpec)``. jax-free throughout
+    (core + faults are PURE_PACKAGES)."""
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+    from tpu_aggcomm.faults.repair import repair_schedule
+    from tpu_aggcomm.faults.spec import parse_fault
+
+    p = AggregatorPattern(
+        nprocs=int(run["nprocs"]), cb_nodes=int(run["cb_nodes"]),
+        data_size=int(run["data_size"]),
+        comm_size=int(run["comm_size"]),
+        proc_node=int(run.get("proc_node") or 1),
+        placement=int(run.get("agg_type") or 1))
+    sched = compile_method(int(run["method"]), p)
+    spec = parse_fault(run.get("fault") or None)
+    if not spec.empty:
+        sched = repair_schedule(sched, spec)
+    return sched, spec
+
+
+def slow_rounds(per_round: list[dict], spec) -> set[int]:
+    """Rounds where any slow-injected rank moves payload — their
+    measured walls carry the injected multiplier most directly, so they
+    are excluded from the FIT. Other rounds of a slow run stay in: on
+    an attributed trace they carry a proportional share of the smeared
+    per-rep delay too, which the fit absorbs as platform noise — the
+    cpu block's wide ``tolerance_rel`` states that honestly, and the
+    explain-time slow envelope covers every round of a slow run
+    (model/predict.py)."""
+    factors = spec.slow_factors()
+    if not factors:
+        return set()
+    return {rf["round"] for rf in per_round
+            if any(rf["io"].get(r, 0) > 0 for r in factors)}
+
+
+def trace_round_observations(path: str) -> tuple[list, list, list]:
+    """Per-round (design, wall_s) observations from one committed trace,
+    plus the excluded (slow-injected) rounds and per-run notes."""
+    from tpu_aggcomm.obs.metrics import round_stats
+    from tpu_aggcomm.obs.trace import load_events
+
+    events = load_events(path)
+    runs = [e for e in events if e.get("ev") == "run"]
+    if not runs:
+        raise ModelError(f"{path}: no run records to calibrate from")
+    obs, excluded, notes = [], [], []
+    base = os.path.basename(path)
+    for run in runs:
+        sched, spec = schedule_for_run(run)
+        per_round = round_features(sched)
+        by_round = {rf["round"]: rf for rf in per_round}
+        skip = slow_rounds(per_round, spec)
+        stats = {s["round"]: s for s in round_stats(events, run["id"])
+                 if isinstance(s["round"], int) and s["round"] >= 0}
+        used = 0
+        for rnd, rf in sorted(by_round.items()):
+            st = stats.get(rnd)
+            if st is None or not st["wall"]:
+                continue
+            if rnd in skip:
+                excluded.append({
+                    "trace": base, "run": run["id"], "round": rnd,
+                    "reason": f"slow-injected "
+                              f"({spec.canonical()}): measured wall "
+                              f"carries the fault multiplier, not "
+                              f"platform cost"})
+                continue
+            obs.append((round_design(rf), st["wall"]))
+            used += 1
+        notes.append({"trace": base, "run": run["id"],
+                      "method": run["method"],
+                      "fault": run.get("fault") or None,
+                      "rounds_used": used})
+    return obs, excluded, notes
+
+
+def calibrate_cpu(trace_paths, *, seed: int = 0) -> dict:
+    """CPU platform parameters at round granularity from committed
+    traces. The rpc column is all-zero at this granularity (the
+    dispatch tax is per rep) so it stays clamped at 0 — honest: these
+    traces cannot identify it."""
+    rows, y_s = [], []
+    excluded_all, notes_all = [], []
+    for path in trace_paths:
+        obs, excluded, notes = trace_round_observations(path)
+        for design, wall in obs:
+            rows.append(design)
+            y_s.append(wall)
+        excluded_all.extend(excluded)
+        notes_all.extend(notes)
+    if not rows:
+        raise ModelError(
+            f"no usable round observations in {list(trace_paths)} "
+            f"(every round slow-injected or unattributed?)")
+    try:
+        block = _fit_block(rows, y_s, seed=seed, granularity="round")
+    except FitError as e:
+        raise ModelError(f"CPU calibration failed: {e}")
+    block["traces"] = notes_all
+    block["excluded_rounds"] = excluded_all
+    return block

@@ -1,0 +1,143 @@
+"""Static cost features of a compiled schedule — the model's inputs.
+
+Everything here is derived from ``obs/traffic.py`` round matrices (op
+programs, never measured callbacks), so a repaired schedule's detour
+rounds and a throttled schedule's extra fence rounds show up in the
+features exactly as they show up in the traffic audit. jax-free.
+
+The design vector is deliberately tiny — five physically-named terms —
+because the committed calibration data is tiny (two quiet-chip grids,
+two CPU traces) and a model with more knobs than honest observations
+would fit noise and transfer nothing:
+
+- **rpc** (cell-level only): one per-dispatch constant — the tunnel's
+  RPC tax on TPU, ~0 on CPU.
+- **rounds**: each data-edge round pays a fence/launch constant
+  (``lax.optimization_barrier`` + per-round dispatch bookkeeping).
+- **bytes_kb**: aggregate payload the round moves (KB) — the shared
+  bandwidth term.
+- **bottleneck_kb**: the hottest rank's in+out KB — the serialization
+  term the reference's MAX-reduce timing actually measures.
+- **spill_kb**: incoming KB beyond :data:`SPILL_THRESHOLD_BYTES` at the
+  hottest destination — deep incast past the VMEM-scale landing zone
+  costs disproportionally (the n>=256 m=1 funnel), while shallow
+  fan-in is already priced by the bottleneck term. The threshold is a
+  fixed structural constant, NOT a fitted parameter: fitting it would
+  let the model memorize the grids it must predict.
+"""
+
+from __future__ import annotations
+
+__all__ = ["PARAM_NAMES", "SPILL_THRESHOLD_BYTES", "round_features",
+           "schedule_features", "cell_design", "round_design",
+           "features_from_round_traffic"]
+
+#: Incoming bytes at one destination rank in one round beyond which the
+#: incast is "deep": 256 KB, the VMEM-scale landing-zone size (one v5e
+#: core's VMEM is ~128 KB/lane x 8 sublanes; a funnel wider than this
+#: cannot stay on-chip between DMAs). Fixed by hardware shape, not fit.
+SPILL_THRESHOLD_BYTES = 262144
+
+#: The five calibrated parameters, in design-vector order. All seconds
+#: (per dispatch / per round / per KB).
+PARAM_NAMES = ("rpc_s", "fence_s", "bytes_s_per_kb",
+               "bottleneck_s_per_kb", "spill_s_per_kb")
+
+
+def round_features(schedule) -> list[dict]:
+    """Per-data-round features of one compiled schedule, round-sorted.
+
+    Returns one dict per round: ``{"round", "bytes", "bottleneck",
+    "spill", "io": {rank: bytes}, "in_bytes": {dst: bytes},
+    "hot_dst"}``. ``io`` charges each payload edge's bytes to BOTH
+    endpoints (src writes, dst reads — the same accounting the
+    roofline's bytes-touched model uses); ``bottleneck`` is its max;
+    ``spill`` is ``max(0, in_bytes[hot_dst] - SPILL_THRESHOLD_BYTES)``.
+    Copies and 0-byte signals are free at this granularity: they never
+    cross the wire / the fence constant already prices the handshake.
+
+    Raises ``obs.traffic.TrafficError`` for schedules with no rank op
+    programs (the TAM relay) — the same refusal as the traffic audit.
+    """
+    from tpu_aggcomm.obs.traffic import round_edges
+
+    by_round = round_edges(schedule)
+    out = []
+    for rnd in sorted(by_round):
+        edges = by_round[rnd]["edges"]
+        io: dict[int, int] = {}
+        in_bytes: dict[int, int] = {}
+        for (src, dst), b in edges.items():
+            io[src] = io.get(src, 0) + b
+            io[dst] = io.get(dst, 0) + b
+            in_bytes[dst] = in_bytes.get(dst, 0) + b
+        hot_dst = max(in_bytes, key=lambda d: (in_bytes[d], -d)) \
+            if in_bytes else None
+        hot = in_bytes.get(hot_dst, 0)
+        out.append({
+            "round": rnd,
+            "bytes": sum(edges.values()),
+            "bottleneck": max(io.values()) if io else 0,
+            "spill": max(0, hot - SPILL_THRESHOLD_BYTES),
+            "io": io, "in_bytes": in_bytes, "hot_dst": hot_dst})
+    return out
+
+
+def schedule_features(schedule) -> dict:
+    """Whole-cell features: the per-round list plus its sums — exactly
+    the quantities the cell-level design vector consumes, so a cell
+    prediction always equals the sum of its round predictions (plus
+    rpc)."""
+    per_round = round_features(schedule)
+    return {
+        "rounds": len(per_round),
+        "bytes": sum(r["bytes"] for r in per_round),
+        "bottleneck": sum(r["bottleneck"] for r in per_round),
+        "spill": sum(r["spill"] for r in per_round),
+        "per_round": per_round}
+
+
+def cell_design(feats: dict) -> list[float]:
+    """Design row for one whole cell (one rep): ``[1, R, bytes_kb,
+    bottleneck_kb, spill_kb]`` — the rpc column is 1 (one dispatch)."""
+    return [1.0, float(feats["rounds"]), feats["bytes"] / 1e3,
+            feats["bottleneck"] / 1e3, feats["spill"] / 1e3]
+
+
+def round_design(rf: dict) -> list[float]:
+    """Design row for ONE round: the rpc column is 0 (the dispatch tax
+    is paid once per rep, not per round) and the fence column is 1."""
+    return [0.0, 1.0, rf["bytes"] / 1e3, rf["bottleneck"] / 1e3,
+            rf["spill"] / 1e3]
+
+
+def features_from_round_traffic(round_traffic: dict) -> dict:
+    """Partial features from a trace run record's ``round_traffic``
+    summary (``{str(round): {"msgs", "bytes", "max_incast"}}``) — the
+    jax-free path ``inspect live`` uses when no schedule object exists.
+
+    The summary has no per-rank split, so the bottleneck term is
+    estimated as ``max_incast * (bytes / msgs)`` — the hottest
+    destination's incoming bytes, exact for this benchmark's uniform
+    slabs (span=1: every payload edge carries ``data_size`` bytes) and
+    an estimate otherwise; ``spill`` derives from the same proxy.
+    Predictions from these features are FLOORS, not walls."""
+    per_round = []
+    for key in sorted(round_traffic, key=lambda k: int(k)):
+        cell = round_traffic[key] or {}
+        bts = int(cell.get("bytes") or 0)
+        msgs = int(cell.get("msgs") or 0)
+        incast = int(cell.get("max_incast") or 0)
+        hot_in = int(incast * (bts / msgs)) if msgs else 0
+        per_round.append({
+            "round": int(key),
+            "bytes": bts,
+            "bottleneck": hot_in,
+            "spill": max(0, hot_in - SPILL_THRESHOLD_BYTES),
+            "io": {}, "in_bytes": {}, "hot_dst": None})
+    return {
+        "rounds": len(per_round),
+        "bytes": sum(r["bytes"] for r in per_round),
+        "bottleneck": sum(r["bottleneck"] for r in per_round),
+        "spill": sum(r["spill"] for r in per_round),
+        "per_round": per_round}

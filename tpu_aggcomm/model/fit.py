@@ -1,0 +1,157 @@
+"""Seeded fitting machinery: weighted non-negative least squares and
+rank-order statistics — pure python, jax-free, numpy-free.
+
+The systems here are tiny (5 parameters, tens of observations), so
+normal equations + Gaussian elimination with partial pivoting are exact
+enough and keep the replay path dependency-free. Determinism contract:
+every function is a pure function of its inputs (the bootstrap takes an
+explicit seed), so ``same artifacts in => same parameters out`` — the
+same discipline as the regression gate's seeded bootstrap and ``tune
+--replay``.
+
+Two fitting choices matter and are deliberate:
+
+- **1/y relative-error weighting**: the calibration data spans 37 µs
+  (n=32) to 16.5 ms (n=1024) cells; unweighted squared error would let
+  the big grid drown the small one and produce parameters that rank
+  n=32 backwards (observed: tau flipped to -0.9 unweighted).
+- **non-negativity (active-set clamping)**: every parameter is a
+  physical cost (a latency, an inverse bandwidth); a negative fitted
+  coefficient is collinearity noise, not physics, and extrapolates
+  catastrophically. Negative coordinates are clamped to zero and the
+  remaining active set is refit — the classic NNLS outer loop,
+  sufficient at this scale.
+"""
+
+from __future__ import annotations
+
+import random
+
+__all__ = ["FitError", "solve_normal", "nnls", "kendall_tau_b",
+           "bootstrap_upper"]
+
+
+class FitError(ValueError):
+    """Unfittable system (no observations, all-zero design, singular
+    active set). Always names what was missing."""
+
+
+def solve_normal(rows: list[list[float]], y: list[float]) -> list[float]:
+    """Least-squares solve of ``rows @ x ~ y`` via normal equations
+    (Gauss with partial pivoting). ``rows`` must have full column rank
+    over its columns; callers drop all-zero columns first."""
+    if not rows:
+        raise FitError("no observations to fit")
+    k = len(rows[0])
+    # A^T A and A^T y
+    ata = [[sum(r[i] * r[j] for r in rows) for j in range(k)]
+           for i in range(k)]
+    aty = [sum(r[i] * yi for r, yi in zip(rows, y)) for i in range(k)]
+    # Gaussian elimination, partial pivot
+    m = [ata[i] + [aty[i]] for i in range(k)]
+    for col in range(k):
+        piv = max(range(col, k), key=lambda r: abs(m[r][col]))
+        if abs(m[piv][col]) < 1e-30:
+            raise FitError(
+                f"singular normal equations at column {col} "
+                f"(collinear or all-zero design)")
+        m[col], m[piv] = m[piv], m[col]
+        inv = 1.0 / m[col][col]
+        for r in range(k):
+            if r == col:
+                continue
+            f = m[r][col] * inv
+            if f:
+                for c in range(col, k + 1):
+                    m[r][c] -= f * m[col][c]
+    return [m[i][k] / m[i][i] for i in range(k)]
+
+
+def nnls(rows: list[list[float]], y: list[float],
+         weights: list[float] | None = None) -> list[float]:
+    """Non-negative weighted least squares over ``rows @ x ~ y``.
+
+    ``weights`` scales each observation's residual (the calibration
+    passes ``1/y`` for relative error). Columns that are zero in every
+    observation stay zero (they are unidentifiable here, e.g. the rpc
+    column of a round-granularity fit). Returns the full-length
+    coefficient vector with clamped coordinates at exactly 0.0."""
+    if not rows:
+        raise FitError("no observations to fit")
+    k = len(rows[0])
+    if weights is None:
+        weights = [1.0] * len(rows)
+    wrows = [[v * w for v in r] for r, w in zip(rows, weights)]
+    wy = [yi * w for yi, w in zip(y, weights)]
+    active = [j for j in range(k) if any(r[j] for r in wrows)]
+    if not active:
+        raise FitError("all-zero design matrix")
+    while active:
+        sub = [[r[j] for j in active] for r in wrows]
+        try:
+            sol = solve_normal(sub, wy)
+        except FitError:
+            # collinear active set: drop the last-added column and retry
+            active = active[:-1]
+            continue
+        x = [0.0] * k
+        for j, v in zip(active, sol):
+            x[j] = v
+        neg = [j for j in active if x[j] < 0.0]
+        if not neg:
+            return x
+        active = [j for j in active if j not in neg]
+    return [0.0] * k
+
+
+def kendall_tau_b(pairs: list[tuple[float, float]]) -> float | None:
+    """Kendall's tau-b over ``(predicted, measured)`` pairs — the
+    tie-aware variant: tied predictions (schedules with identical
+    static features are common) reduce the denominator instead of being
+    silently skipped, so a model that predicts everything equal scores
+    0, not 1. None with fewer than 2 pairs or all-tied input."""
+    n = len(pairs)
+    if n < 2:
+        return None
+    conc = disc = ties_p = ties_m = 0
+    for i in range(n):
+        for j in range(i + 1, n):
+            dp = pairs[i][0] - pairs[j][0]
+            dm = pairs[i][1] - pairs[j][1]
+            if dp == 0 and dm == 0:
+                ties_p += 1
+                ties_m += 1
+            elif dp == 0:
+                ties_p += 1
+            elif dm == 0:
+                ties_m += 1
+            elif (dp > 0) == (dm > 0):
+                conc += 1
+            else:
+                disc += 1
+    n0 = n * (n - 1) // 2
+    den = ((n0 - ties_p) * (n0 - ties_m)) ** 0.5
+    if den == 0:
+        return None
+    return (conc - disc) / den
+
+
+def bootstrap_upper(values: list[float], *, q: float = 95.0,
+                    seed: int = 0, n_boot: int = 2000,
+                    upper: float = 97.5) -> float:
+    """Seeded bootstrap upper confidence bound on the ``q``-th
+    percentile of ``values`` — the divergence tolerance derivation:
+    resample the calibration's |relative residuals|, take each
+    resample's p95, report the 97.5th percentile of those. Same seed +
+    same values => same bound, byte-for-byte."""
+    from tpu_aggcomm.obs.metrics import percentile
+
+    if not values:
+        raise FitError("no residuals to bootstrap a tolerance from")
+    rng = random.Random(seed)
+    n = len(values)
+    stats = []
+    for _ in range(int(n_boot)):
+        stats.append(percentile(
+            [values[rng.randrange(n)] for _ in range(n)], q))
+    return percentile(stats, upper)

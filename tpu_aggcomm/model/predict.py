@@ -1,0 +1,195 @@
+"""Prediction: price a schedule (or a trace's traffic summary) with
+calibrated platform parameters — jax-free, backend-free.
+
+The round wall is the calibration form exactly (so a cell prediction
+is always the sum of its round predictions plus the rpc constant, and
+``predict -> sum`` reproduces the fitted design row float-for-float)::
+
+    wall_r = fence_s + bytes_kb_r * bytes_s_per_kb
+                     + bottleneck_kb_r * bottleneck_s_per_kb
+                     + spill_kb_r * spill_s_per_kb
+
+Per-rank rows are the advisory decomposition (who the model thinks the
+critical rank is): every rank pays the fence and the aggregate
+bandwidth term, its own in+out bytes at the bottleneck rate, and the
+spill premium if it is the round's hottest destination.
+
+Slow-rank fault clauses change no program (faults/repair.py), so they
+are applied HERE: under a slow spec every round's prediction becomes a
+[base, ceiling] range — the healthy wall and the wall times the largest
+injected multiplier — and the explain verdict checks the measured wall
+against that range instead of a point (model/explain.py). The envelope
+is deliberately whole-round and whole-run: jax_sim injects the delay as
+ONE per-rep loop after the rounds, and on an ``attributed`` trace the
+recorder's round walls are structural shares of the measured total, so
+the delay smears proportionally across EVERY round — pinning the
+envelope to only the rounds the slow rank touches would call the smear
+UNEXPLAINED when it is in fact the injected fault.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+__all__ = ["predict_schedule", "predict_rounds", "floor_from_features",
+           "floor_from_round_traffic", "floor_from_trace_events",
+           "newest_predict_path", "predict_candidates"]
+
+
+def _coef(params: dict) -> tuple[float, float, float, float, float]:
+    from tpu_aggcomm.model.features import PARAM_NAMES
+    return tuple(float(params.get(k) or 0.0) for k in PARAM_NAMES)
+
+
+def predict_rounds(per_round: list[dict], params: dict,
+                   slow_factors: dict | None = None) -> list[dict]:
+    """Per-round predictions over ``model.features.round_features``
+    output. Each entry::
+
+        {"round", "wall_s", "components": {"fence", "bytes",
+         "bottleneck", "spill"}, "critical_rank", "per_rank_s",
+         "slow_wall_s"}
+
+    ``slow_wall_s`` is None when no slow clause is injected, else the
+    smear ceiling ``wall * max(multipliers)`` — see the module
+    docstring for why the envelope covers every round."""
+    _rpc, fence, by_kb, bot_kb, sp_kb = _coef(params)
+    slow_factors = slow_factors or {}
+    max_factor = max(slow_factors.values()) if slow_factors else None
+    out = []
+    for rf in per_round:
+        comp = {"fence": fence,
+                "bytes": rf["bytes"] / 1e3 * by_kb,
+                "bottleneck": rf["bottleneck"] / 1e3 * bot_kb,
+                "spill": rf["spill"] / 1e3 * sp_kb}
+        wall = comp["fence"] + comp["bytes"] + comp["bottleneck"] \
+            + comp["spill"]
+        shared = comp["fence"] + comp["bytes"]
+        per_rank = {}
+        for rank, io in rf["io"].items():
+            own = io / 1e3 * bot_kb
+            if rank == rf["hot_dst"]:
+                own += rf["spill"] / 1e3 * sp_kb
+            per_rank[rank] = shared + own
+        critical = max(per_rank, key=lambda r: (per_rank[r], -r)) \
+            if per_rank else None
+        slow_wall = wall * max_factor if max_factor is not None else None
+        out.append({"round": rf["round"], "wall_s": wall,
+                    "components": comp, "critical_rank": critical,
+                    "per_rank_s": per_rank, "slow_wall_s": slow_wall})
+    return out
+
+
+def predict_schedule(schedule, params: dict, *, fault=None) -> dict:
+    """Predicted cost of one compiled schedule under one platform's
+    parameters: ``{"rounds": [...], "total_s", "rpc_s", "fault"}``.
+
+    ``fault`` (a spec string or FaultSpec) contributes its slow
+    multipliers; dead links / dead aggregators must already be in the
+    schedule (pass the REPAIRED schedule — the detour rounds are then
+    priced like any other rounds, which is the whole point: detour
+    inflation is attributed, not mysterious)."""
+    from tpu_aggcomm.faults.spec import parse_fault
+    from tpu_aggcomm.model.features import round_features
+
+    spec = parse_fault(fault) if isinstance(fault, (str, type(None))) \
+        else fault
+    rounds = predict_rounds(round_features(schedule), params,
+                            spec.slow_factors() if spec else None)
+    rpc = _coef(params)[0]
+    return {"rounds": rounds, "rpc_s": rpc,
+            "total_s": rpc + sum(r["wall_s"] for r in rounds),
+            "fault": spec.canonical() if spec and not spec.empty
+            else None}
+
+
+def floor_from_features(feats: dict, params: dict) -> float:
+    """Lower-bound seconds for one rep from (possibly partial)
+    features: rpc + per-round fence + aggregate bandwidth. Bottleneck
+    and spill terms are included when the features carry them, so full
+    features give the full prediction and ``round_traffic``-derived
+    features give an honest floor."""
+    rpc, fence, by_kb, bot_kb, sp_kb = _coef(params)
+    total = rpc
+    for rf in feats["per_round"]:
+        total += fence + rf["bytes"] / 1e3 * by_kb \
+            + rf["bottleneck"] / 1e3 * bot_kb + rf["spill"] / 1e3 * sp_kb
+    return total
+
+
+def floor_from_round_traffic(round_traffic: dict, params: dict) -> float:
+    """The jax-free floor from a trace run record's ``round_traffic``
+    summary — what ``inspect live`` can compute with no schedule object
+    and no jax import."""
+    from tpu_aggcomm.model.features import features_from_round_traffic
+    return floor_from_features(
+        features_from_round_traffic(round_traffic), params)
+
+
+def predict_candidates(cands, params: dict, *, nprocs: int,
+                       data_size: int, proc_node: int = 1) -> dict:
+    """Predicted seconds/rep for each tune candidate (tune/space.py
+    ``Candidate`` objects) from static features alone — the
+    multi-fidelity estimate ``tune --model-prune`` races against.
+    Pattern construction mirrors ``tune/measure.py`` exactly, so the
+    model prices the very schedule the sampler would measure.
+
+    Returns ``{cid: predicted_s | None}``; a candidate whose schedule
+    refuses feature extraction (the TAM relay's ``TrafficError``) maps
+    to None — the tuner must RACE what the model cannot price, never
+    silently drop it."""
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+    from tpu_aggcomm.model.features import schedule_features
+    from tpu_aggcomm.obs.traffic import TrafficError
+
+    out = {}
+    for c in cands:
+        pattern = AggregatorPattern(
+            nprocs=nprocs, cb_nodes=c.cb_nodes,
+            data_size=max(int(data_size), 1), proc_node=proc_node,
+            comm_size=c.comm_size, placement=c.agg_type)
+        try:
+            feats = schedule_features(compile_method(c.method, pattern))
+        except TrafficError:
+            out[c.cid] = None
+            continue
+        out[c.cid] = floor_from_features(feats, params)
+    return out
+
+
+def newest_predict_path(root: str = ".") -> str | None:
+    """Newest committed ``PREDICT_*.json`` under ``root`` (sorted by
+    name — the r-number convention — so the answer is deterministic
+    across filesystems, like every artifact scan)."""
+    paths = sorted(glob.glob(os.path.join(root, "PREDICT_*.json")))
+    return paths[-1] if paths else None
+
+
+def floor_from_trace_events(events: list[dict], params_by_platform: dict,
+                            ) -> tuple[float | None, int]:
+    """(floor seconds per rep, ntimes) for the LAST run record in a live
+    trace tail, using the platform the trace's ledger manifest names
+    (falling back to 'cpu'). None when the tail has no run record with
+    traffic, or the artifact lacks that platform — the caller keeps the
+    walls-only deadline model, never crashes a live board."""
+    run = next((e for e in reversed(events) if e.get("ev") == "run"
+                and e.get("round_traffic")), None)
+    if run is None:
+        return None, 1
+    platform = "cpu"
+    for e in reversed(events):
+        if e.get("ev") == "ledger":
+            platform = ((e.get("manifest") or {}).get("platform")
+                        or platform)
+            break
+    block = params_by_platform.get(platform)
+    if not block:
+        return None, 1
+    params = block.get("params") if "params" in block else block
+    try:
+        floor = floor_from_round_traffic(run["round_traffic"], params)
+    except (KeyError, TypeError, ValueError):
+        return None, 1
+    return (floor if floor > 0 else None), int(run.get("ntimes") or 1)

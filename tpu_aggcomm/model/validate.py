@@ -1,0 +1,126 @@
+"""Transfer validation: can static features alone rank the committed
+quiet-chip grids, and what crossover does the model pre-register?
+
+Rank-order (not absolute error) is the claim that matters for tuning:
+a model that ranks cells correctly prunes racing grids correctly even
+when its absolute scale is off. Two honest subtleties, reported rather
+than hidden:
+
+- **Kendall tau-b**, the tie-aware variant: cells whose schedules
+  compile to byte-identical static features (e.g. n=32 c=32 vs c=∞ —
+  both one unthrottled round) get identical predictions, and tau-b
+  counts those tied pairs against the score instead of skipping them.
+- **top-1 as an equivalence class**: the predicted-best "cell" is the
+  SET of cells tied at the minimum predicted value (float-exact tie —
+  identical features, not approximate closeness). ``agree`` means the
+  measured-best cell is in that set; the strict argmin (deterministic
+  m-asc, c-asc tie-break, the tune/race.py input-order contract) and
+  its measured penalty vs the true best are reported alongside, so a
+  reader sees exactly what the model can and cannot separate.
+
+The **fused-vs-fenced crossover** is the pre-registered prediction the
+ROADMAP asks for: the fused backend's in-kernel semaphore waits remove
+the per-round host fence constant, so the model predicts a relative
+speedup of ``R(c) * fence_s / total(c)`` per cell — committed BEFORE
+the tunnel returns, to be confirmed or refuted by
+``scripts/tpu_sweeps.py --fused-only``.
+"""
+
+from __future__ import annotations
+
+from tpu_aggcomm.model.calibrate import grid_cell_features
+from tpu_aggcomm.model.fit import kendall_tau_b
+
+__all__ = ["validate_grids", "crossover_prediction",
+           "FUSED_NOISE_FLOOR_REL"]
+
+#: Cell-to-cell repeatability of the quiet-chip grids (RESULTS_TPU.md:
+#: fresh re-measurements reproduce within 0-1%, so >2% is signal) — a
+#: predicted fused speedup below this would be unconfirmable.
+FUSED_NOISE_FLOOR_REL = 0.02
+
+
+def _predict_cell(cell: dict, params: dict) -> float:
+    return sum(a * b for a, b in zip(
+        cell["design"], (params[k] for k in (
+            "rpc_s", "fence_s", "bytes_s_per_kb", "bottleneck_s_per_kb",
+            "spill_s_per_kb"))))
+
+
+def validate_grids(grids: dict, params: dict, *,
+                   fit_grids=("n256", "n1024")) -> dict:
+    """Per-grid rank-order report: ``{"tau_b", "cells", "held_out",
+    "top1": {"measured_best", "predicted_class", "agree",
+    "strict_argmin", "strict_measured_penalty_rel"}}`` keyed by grid
+    name. Predictions use ONLY static features + the calibrated
+    parameters — no measurement enters."""
+    out = {}
+    for name, grid in grids.items():
+        cells = grid_cell_features(grid)
+        preds = [_predict_cell(c, params) for c in cells]
+        meas = [c["us"] / 1e6 for c in cells]
+        tau = kendall_tau_b(list(zip(preds, meas)))
+        bi_meas = min(range(len(cells)), key=lambda i: (meas[i], i))
+        pmin = min(preds)
+        klass = [i for i in range(len(cells)) if preds[i] == pmin]
+        bi_strict = klass[0]
+        penalty = (meas[bi_strict] - meas[bi_meas]) / meas[bi_meas] \
+            if meas[bi_meas] else None
+
+        def _cid(i):
+            return {"method": cells[i]["method"],
+                    "comm": cells[i]["comm"]}
+
+        out[name] = {
+            "cells": len(cells),
+            "held_out": name not in fit_grids,
+            "tau_b": tau,
+            "top1": {
+                "measured_best": _cid(bi_meas),
+                "predicted_class": [_cid(i) for i in klass],
+                "agree": bi_meas in klass,
+                "strict_argmin": _cid(bi_strict),
+                "strict_measured_penalty_rel": penalty}}
+    return out
+
+
+def crossover_prediction(grids: dict, params: dict, *,
+                         grid_name: str = "n32",
+                         noise_floor_rel: float = FUSED_NOISE_FLOOR_REL,
+                         ) -> dict:
+    """The pre-registered fused-vs-fenced shape for one grid: per cell
+    the predicted fenced total, the predicted fused total (fence
+    constant removed, everything else unchanged), and the relative
+    speedup; plus, per method, the largest -c at which the predicted
+    speedup still clears the grid's noise floor — the crossover point
+    the chip must confirm."""
+    if grid_name not in grids:
+        return {"grid": grid_name, "error": "grid not in parsed tables"}
+    fence = params["fence_s"]
+    cells = []
+    crossover: dict = {}
+    for cell in grid_cell_features(grids[grid_name]):
+        total = _predict_cell(cell, params)
+        saved = cell["features"]["rounds"] * fence
+        rel = saved / total if total else 0.0
+        cells.append({
+            "method": cell["method"], "comm": cell["comm"],
+            "rounds": cell["features"]["rounds"],
+            "predicted_fenced_s": total,
+            "predicted_fused_s": total - saved,
+            "predicted_speedup_rel": rel,
+            "clears_noise_floor": rel > noise_floor_rel})
+        if rel > noise_floor_rel:
+            key = f"m{cell['method']}"
+            prev = crossover.get(key)
+            if prev is None or cell["comm"] > prev:
+                crossover[key] = cell["comm"]
+    return {"grid": grid_name,
+            "noise_floor_rel": noise_floor_rel,
+            "fence_s": fence,
+            "cells": cells,
+            "crossover_max_comm": crossover,
+            "claim": "pallas_fused removes the per-round host fence; "
+                     "cells at or below each method's crossover_max_comm "
+                     "should show a fused speedup above the noise floor "
+                     "when scripts/tpu_sweeps.py --fused-only runs"}

@@ -39,9 +39,13 @@ from tpu_aggcomm.obs.metrics import (bootstrap_delta_ci, bucket_cells,
 from tpu_aggcomm.obs.trace import aggregate_run, load_events, round_key
 
 __all__ = ["TraceCompareError", "compare_traces", "compare_paths",
-           "render_compare", "BY_CHOICES"]
+           "render_compare", "save_compare", "BY_CHOICES",
+           "COMPARE_SCHEMA"]
 
 BY_CHOICES = ("rank", "round", "phase")
+
+#: Schema tag of the machine-readable ``inspect compare --json`` export.
+COMPARE_SCHEMA = "compare-v1"
 
 
 class TraceCompareError(ValueError):
@@ -344,6 +348,25 @@ def _render_one(res: dict, by: str, lines: list) -> None:
             lines.append(
                 f"    {key!s:>14}: A {row['a_s']:.6f}  "
                 f"B {row['b_s']:.6f}  {pct}{sig}")
+
+
+def save_compare(path: str, res: dict) -> str:
+    """Write a :func:`compare_paths` result as a ``compare-v1`` JSON
+    artifact (atomic_write; validated by ``obs.regress.validate_compare``
+    and scripts/check_bench_schema.py). The payload is the result dict
+    VERBATIM under ``"result"`` — the numbers ``render_compare`` prints
+    and the export must never diverge."""
+    import json
+    import time
+
+    from tpu_aggcomm.obs.atomic import atomic_write
+
+    blob = {"schema": COMPARE_SCHEMA, "result": res,
+            "created_unix": time.time()}
+    with atomic_write(path) as fh:
+        json.dump(blob, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return path
 
 
 def render_compare(res: dict) -> str:

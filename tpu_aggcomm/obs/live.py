@@ -76,9 +76,11 @@ def sweep_status(results_csv: str, *, comm_sizes=None,
     custom ``--comm-sizes`` to the sweep passes the same list here).
     ``eta`` carries the watchdog-model estimate: per-cell point
     estimate (median prior wall), soft budget
-    (:func:`derive_deadline` over the prior walls), and the total for
-    what remains. ``activity`` is the tail of the newest trace stream,
-    if any."""
+    (:func:`derive_deadline` over the prior walls plus the cost
+    model's jax-free per-rep floor when a committed PREDICT_*.json and
+    a traffic-bearing trace tail exist — ``model_floor_s``), and the
+    total for what remains. ``activity`` is the tail of the newest
+    trace stream, if any."""
     from tpu_aggcomm.resilience.journal import RunJournal
     from tpu_aggcomm.resilience.watchdog import derive_deadline
 
@@ -101,26 +103,8 @@ def sweep_status(results_csv: str, *, comm_sizes=None,
     remaining = [{"fault": f, "comm": c}
                  for f in faults for c in grid if (f, c) not in done]
 
-    walls = [c["wall_s"] for c in cells
-             if c["status"] == "done"
-             and isinstance(c.get("wall_s"), (int, float))]
-    eta = {"per_cell_s": None, "soft_budget_s": None, "total_s": None,
-           "basis": len(walls)}
-    if walls:
-        ordered = sorted(walls)
-        mid = len(ordered) // 2
-        per_cell = (ordered[mid] if len(ordered) % 2
-                    else 0.5 * (ordered[mid - 1] + ordered[mid]))
-        eta["per_cell_s"] = per_cell
-        # the watchdog's deadline model over the same prior walls: the
-        # "nothing is wrong" upper bound per cell (floor_s stays None —
-        # the roofline path imports the jax lowerings, and live must
-        # run where import jax hangs)
-        eta["soft_budget_s"] = derive_deadline(floor_s=None,
-                                               prior_walls=walls)
-        eta["total_s"] = per_cell * len(remaining)
-
     activity = None
+    act_events: list = []
     newest = None
     for p in trace_paths:
         try:
@@ -130,18 +114,54 @@ def sweep_status(results_csv: str, *, comm_sizes=None,
         if newest is None or mt > newest[0]:
             newest = (mt, p)
     if newest is not None:
-        events = tail_events(newest[1])
-        if events:
-            last = events[-1]
-            run = next((e for e in reversed(events)
+        act_events = tail_events(newest[1])
+        if act_events:
+            last = act_events[-1]
+            run = next((e for e in reversed(act_events)
                         if e.get("ev") == "run"), None)
             activity = {
-                "trace": newest[1], "events": len(events),
+                "trace": newest[1], "events": len(act_events),
                 "age_s": max(0.0, time.time() - newest[0]),
                 "last_ev": last.get("ev"),
                 "last_name": last.get("name"),
                 "run": (run or {}).get("name"),
                 "backend": (run or {}).get("backend")}
+
+    # the analytic cost model's floor (tpu_aggcomm/model/, jax-free by
+    # the same contract as this module — it must import with a wedged
+    # tunnel): armed only when a committed PREDICT_*.json AND a trace
+    # tail with a round_traffic run record exist; with neither, the
+    # walls-only deadline model below keeps working unchanged
+    floor_s, floor_ntimes = None, 1
+    if act_events:
+        from tpu_aggcomm.model.artifact import newest_artifact
+        from tpu_aggcomm.model.predict import floor_from_trace_events
+        root = os.path.dirname(os.path.abspath(results_csv))
+        art = newest_artifact(root)
+        if art is None and os.path.abspath(root) != os.path.abspath("."):
+            art = newest_artifact(".")
+        if art is not None:
+            floor_s, floor_ntimes = floor_from_trace_events(
+                act_events, art.get("platforms") or {})
+
+    walls = [c["wall_s"] for c in cells
+             if c["status"] == "done"
+             and isinstance(c.get("wall_s"), (int, float))]
+    eta = {"per_cell_s": None, "soft_budget_s": None, "total_s": None,
+           "model_floor_s": floor_s, "basis": len(walls)}
+    if walls or floor_s is not None:
+        # the watchdog's deadline model: prior walls, plus the cost
+        # model's per-rep floor when one is derivable — this is how a
+        # first cell (no prior walls) gets a budget at all
+        eta["soft_budget_s"] = derive_deadline(
+            floor_s=floor_s, ntimes=floor_ntimes, prior_walls=walls)
+    if walls:
+        ordered = sorted(walls)
+        mid = len(ordered) // 2
+        per_cell = (ordered[mid] if len(ordered) % 2
+                    else 0.5 * (ordered[mid - 1] + ordered[mid]))
+        eta["per_cell_s"] = per_cell
+        eta["total_s"] = per_cell * len(remaining)
     return {"journal": journal_path, "cells": cells,
             "remaining": remaining, "eta": eta, "activity": activity}
 
@@ -175,15 +195,23 @@ def render_live(status: dict) -> str:
                     + (f" [fault {rem[0]['fault']}]"
                        if rem[0]["fault"] else "")
                     if rem else ""))
+    floor = eta.get("model_floor_s")
+    floor_txt = (f"; cost-model floor {floor * 1e6:.1f}us/rep"
+                 if floor is not None else "")
     if eta["per_cell_s"] is not None:
         lines.append(
             f"eta: ~{_fmt_s(eta['per_cell_s'])}/cell (median of "
             f"{eta['basis']} prior wall(s)) -> ~{_fmt_s(eta['total_s'])} "
             f"total; watchdog soft budget "
-            f"{_fmt_s(eta['soft_budget_s'])}/cell")
+            f"{_fmt_s(eta['soft_budget_s'])}/cell{floor_txt}")
+    elif eta["soft_budget_s"] is not None:
+        lines.append(
+            f"eta: no completed cells yet; watchdog soft budget "
+            f"{_fmt_s(eta['soft_budget_s'])}/cell from the cost-model "
+            f"floor{floor_txt}")
     else:
-        lines.append("eta: no completed cells yet (no prior walls to "
-                     "model from)")
+        lines.append("eta: no completed cells yet (no prior walls or "
+                     "cost-model floor to model from)")
     act = status["activity"]
     if act is not None:
         lines.append(

@@ -71,7 +71,8 @@ __all__ = ["validate_bench", "validate_multichip", "validate_tune",
            "parse_openmetrics", "validate_openmetrics",
            "parsed_schema_version", "DEFAULT_TOLERANCE",
            "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE", "TUNE_SCHEMAS",
-           "TRAFFIC_SCHEMAS"]
+           "TRAFFIC_SCHEMAS", "PREDICT_SCHEMAS", "COMPARE_SCHEMAS",
+           "validate_predict", "validate_compare"]
 
 #: Relative slowdown vs the best prior same-platform round that counts as
 #: a regression. Differenced-chain numbers jitter a few percent
@@ -321,6 +322,49 @@ def validate_tune(obj, where: str = "TUNE") -> list[str]:
                               f"with race.winner {race['winner']!r}")
     if "synthetic" in obj and not isinstance(obj["synthetic"], bool):
         errors.append(f"{where}: 'synthetic' must be a bool")
+    mp = obj.get("model_prune")
+    if mp is not None:
+        # optional --model-prune record (cli._model_prune): the split
+        # must be internally consistent — raced order == kept, pruned
+        # candidates priced, nothing both kept and pruned — because
+        # tune --replay re-derives it from these fields alone
+        w = f"{where}.model_prune"
+        if not isinstance(mp, dict):
+            errors.append(f"{w}: must be an object")
+        else:
+            for k, types in (("artifact", str), ("platform", str),
+                             ("margin", (int, float)), ("best", str)):
+                _require(mp, k, types, errors, w)
+            preds = mp.get("predictions")
+            if not isinstance(preds, dict) or not preds or not all(
+                    v is None or _is_num(v) for v in preds.values()):
+                errors.append(f"{w}: 'predictions' must be a non-empty "
+                              f"object (cid -> seconds or null)")
+                preds = {}
+            kept, pruned = mp.get("kept"), mp.get("pruned")
+            if not isinstance(kept, list) or not isinstance(pruned, list):
+                errors.append(f"{w}: 'kept' and 'pruned' must be lists")
+            else:
+                if set(kept) & set(pruned):
+                    errors.append(f"{w}: candidates both kept and "
+                                  f"pruned: "
+                                  f"{sorted(set(kept) & set(pruned))}")
+                if preds and sorted(set(kept) | set(pruned)) \
+                        != sorted(preds):
+                    errors.append(f"{w}: kept+pruned must partition "
+                                  f"the predicted candidates")
+                if isinstance(race.get("order"), list) \
+                        and race["order"] != kept:
+                    errors.append(f"{w}: race.order must be exactly "
+                                  f"the kept list — the race must run "
+                                  f"precisely the survivors the prune "
+                                  f"recorded")
+                for cid in pruned:
+                    if preds and not _is_num(preds.get(cid)):
+                        errors.append(f"{w}: pruned candidate {cid!r} "
+                                      f"has no recorded prediction — "
+                                      f"an unpriced candidate must be "
+                                      f"raced, never pruned")
     return errors
 
 
@@ -747,3 +791,252 @@ def check_regression(root: str = ".",
     verdict["manifest_drift"] = diff_manifests(
         manifests.get(best["round"]), manifests.get(cur["round"]))
     return verdict
+
+
+PREDICT_SCHEMAS = ("predict-v1",)
+COMPARE_SCHEMAS = ("compare-v1",)
+
+
+def validate_predict(obj, where: str = "PREDICT") -> list[str]:
+    """Schema errors (empty list = valid) for one ``PREDICT_*.json``
+    cost-model artifact (model/artifact.py). Beyond shape, this checks
+    the artifact against ITSELF: every explain run's tolerance must be
+    its platform block's tolerance verbatim, and an UNEXPLAINED round
+    verdict whose own recorded deviation sits inside that tolerance is
+    a contradiction — an artifact whose verdicts its own numbers
+    contradict must fail, the same discipline as validate_traffic."""
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    schema = obj.get("schema")
+    if schema not in PREDICT_SCHEMAS:
+        errors.append(f"{where}: unknown schema tag {schema!r} "
+                      f"(expected one of {list(PREDICT_SCHEMAS)})")
+        return errors
+    _require(obj, "seed", int, errors, where)
+    _require(obj, "created_unix", (int, float), errors, where)
+
+    inputs = obj.get("inputs")
+    if not isinstance(inputs, dict):
+        errors.append(f"{where}: missing/invalid 'inputs' object")
+    else:
+        _require(inputs, "results_md", str, errors, f"{where}.inputs")
+        traces = inputs.get("traces")
+        if not isinstance(traces, list) or not traces \
+                or not all(isinstance(t, str) for t in traces):
+            errors.append(f"{where}.inputs: 'traces' must be a "
+                          f"non-empty list of file names")
+        excl = inputs.get("excluded")
+        if not isinstance(excl, list) or not all(
+                isinstance(e, dict) and isinstance(e.get("artifact"), str)
+                and isinstance(e.get("reason"), str) for e in excl):
+            errors.append(f"{where}.inputs: 'excluded' must be a list "
+                          f"of {{artifact, reason}} records — every "
+                          f"deliberate calibration exclusion must name "
+                          f"its reason")
+
+    from tpu_aggcomm.model.features import PARAM_NAMES
+    platforms = obj.get("platforms")
+    tol_by_platform: dict = {}
+    if not isinstance(platforms, dict) or not platforms:
+        errors.append(f"{where}: 'platforms' must be a non-empty "
+                      f"object of calibrated blocks")
+        platforms = {}
+    for plat, block in platforms.items():
+        w = f"{where}.platforms[{plat!r}]"
+        if not isinstance(block, dict):
+            errors.append(f"{w}: must be an object")
+            continue
+        for k, types in (("granularity", str), ("observations", int),
+                         ("seed", int)):
+            _require(block, k, types, errors, w)
+        if block.get("granularity") not in ("cell", "round", None):
+            errors.append(f"{w}: granularity must be 'cell' or "
+                          f"'round', got {block.get('granularity')!r}")
+        params = block.get("params")
+        if not isinstance(params, dict):
+            errors.append(f"{w}: missing/invalid 'params' object")
+        else:
+            for name in PARAM_NAMES:
+                v = params.get(name)
+                if not _is_num(v) or v < 0:
+                    errors.append(f"{w}.params: {name!r} must be a "
+                                  f"non-negative number (a fitted cost "
+                                  f"is physics, not noise), got {v!r}")
+        tol = block.get("tolerance_rel")
+        if not _is_num(tol) or tol <= 0:
+            errors.append(f"{w}: 'tolerance_rel' must be a positive "
+                          f"number, got {tol!r}")
+        else:
+            tol_by_platform[plat] = float(tol)
+        resid = block.get("residual_rel")
+        if not isinstance(resid, list) or not all(
+                _is_num(x) for x in resid):
+            errors.append(f"{w}: 'residual_rel' must be a list of "
+                          f"numbers")
+        elif isinstance(block.get("observations"), int) \
+                and len(resid) != block["observations"]:
+            errors.append(f"{w}: {len(resid)} residuals recorded for "
+                          f"{block['observations']} observations — the "
+                          f"fit evidence must match the fit")
+
+    val = obj.get("validation")
+    if not isinstance(val, dict) or not val:
+        errors.append(f"{where}: 'validation' must be a non-empty "
+                      f"object (one rank-order report per grid)")
+        val = {}
+    for name, v in val.items():
+        w = f"{where}.validation[{name!r}]"
+        if not isinstance(v, dict):
+            errors.append(f"{w}: must be an object")
+            continue
+        _require(v, "cells", int, errors, w)
+        _require(v, "held_out", bool, errors, w)
+        if "tau_b" not in v or (v["tau_b"] is not None
+                                and not _is_num(v["tau_b"])):
+            errors.append(f"{w}: 'tau_b' must be a number or null")
+        t1 = v.get("top1")
+        if not isinstance(t1, dict) \
+                or not isinstance(t1.get("agree"), bool) \
+                or not isinstance(t1.get("predicted_class"), list) \
+                or not t1.get("predicted_class"):
+            errors.append(f"{w}: 'top1' must carry bool 'agree' and a "
+                          f"non-empty 'predicted_class'")
+
+    expl = obj.get("explain")
+    if not isinstance(expl, list) or not expl:
+        errors.append(f"{where}: 'explain' must be a non-empty list "
+                      f"(the verdict taxonomy demonstrated on the "
+                      f"committed traces)")
+        expl = []
+    for i, exp in enumerate(expl):
+        w = f"{where}.explain[{i}]"
+        if not isinstance(exp, dict):
+            errors.append(f"{w}: must be an object")
+            continue
+        _require(exp, "trace", str, errors, w)
+        plat = exp.get("platform")
+        if plat not in platforms:
+            errors.append(f"{w}: platform {plat!r} has no calibrated "
+                          f"block in 'platforms'")
+        runs = exp.get("runs")
+        if not isinstance(runs, list) or not runs:
+            errors.append(f"{w}: 'runs' must be a non-empty list")
+            continue
+        for j, run in enumerate(runs):
+            rw = f"{w}.runs[{j}]"
+            if not isinstance(run, dict):
+                errors.append(f"{rw}: must be an object")
+                continue
+            tol = run.get("tolerance_rel")
+            want = tol_by_platform.get(plat)
+            if want is not None and tol != want:
+                errors.append(f"{rw}: tolerance_rel {tol!r} is not the "
+                              f"{plat} block's {want!r} — verdicts must "
+                              f"be judged at the calibrated tolerance")
+            rounds = run.get("rounds")
+            if not isinstance(rounds, list) or not rounds:
+                errors.append(f"{rw}: 'rounds' must be a non-empty "
+                              f"list")
+                rounds = []
+            for row in rounds:
+                if not isinstance(row, dict) \
+                        or not isinstance(row.get("verdict"), str) \
+                        or not _is_num(row.get("predicted_s")):
+                    errors.append(f"{rw}: every round row needs a "
+                                  f"string 'verdict' and numeric "
+                                  f"'predicted_s'")
+                    continue
+                dev = row.get("deviation_rel")
+                if row["verdict"].startswith("UNEXPLAINED") \
+                        and _is_num(dev) and _is_num(tol) \
+                        and abs(dev) <= tol:
+                    errors.append(
+                        f"{rw} round {row.get('round')}: verdict says "
+                        f"UNEXPLAINED but its own deviation "
+                        f"{dev:+.3f} sits inside tolerance {tol:.3f} — "
+                        f"the verdict contradicts its numbers")
+            total = run.get("total")
+            if not isinstance(total, dict) \
+                    or not isinstance(total.get("verdict"), str) \
+                    or not _is_num(total.get("predicted_s")):
+                errors.append(f"{rw}: 'total' must carry a string "
+                              f"'verdict' and numeric 'predicted_s'")
+    return errors
+
+
+def validate_compare(obj, where: str = "COMPARE") -> list[str]:
+    """Schema errors (empty list = valid) for one ``compare-v1``
+    artifact (``inspect compare --json``, obs/compare.py). The payload
+    is the compare result verbatim; this pins the shape downstream
+    tooling may rely on: every run delta names both sides' totals, and
+    a grid export lists its unmatched cells instead of dropping them."""
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    schema = obj.get("schema")
+    if schema not in COMPARE_SCHEMAS:
+        errors.append(f"{where}: unknown schema tag {schema!r} "
+                      f"(expected one of {list(COMPARE_SCHEMAS)})")
+        return errors
+    _require(obj, "created_unix", (int, float), errors, where)
+    res = obj.get("result")
+    if not isinstance(res, dict):
+        errors.append(f"{where}: missing/invalid 'result' object")
+        return errors
+    if res.get("by") not in ("rank", "round", "phase"):
+        errors.append(f"{where}.result: 'by' must be rank/round/phase, "
+                      f"got {res.get('by')!r}")
+
+    def _check_runs(runs, w):
+        if not isinstance(runs, list) or not runs:
+            errors.append(f"{w}: 'runs' must be a non-empty list")
+            return
+        for j, run in enumerate(runs):
+            rw = f"{w}.runs[{j}]"
+            if not isinstance(run, dict):
+                errors.append(f"{rw}: must be an object")
+                continue
+            for k in ("total_a_s", "total_b_s", "total_delta_pct"):
+                if not _is_num(run.get(k)):
+                    errors.append(f"{rw}: {k!r} must be a number")
+            if not isinstance(run.get("method"), int):
+                errors.append(f"{rw}: 'method' must be an int")
+            table = run.get("table")
+            if not isinstance(table, list):
+                errors.append(f"{rw}: 'table' must be a list")
+                continue
+            for row in table:
+                if not isinstance(row, dict) \
+                        or not _is_num(row.get("a_s")) \
+                        or not _is_num(row.get("b_s")):
+                    errors.append(f"{rw}: every table row needs "
+                                  f"numeric 'a_s' and 'b_s'")
+                    break
+            dom = run.get("dominant")
+            if dom is not None and (not isinstance(dom, dict)
+                                    or not _is_num(dom.get("delta_s"))):
+                errors.append(f"{rw}: 'dominant' must be null or an "
+                              f"object with numeric 'delta_s'")
+
+    if "grid" in res:
+        grid = res.get("grid")
+        if not isinstance(grid, list):
+            errors.append(f"{where}.result: 'grid' must be a list")
+            grid = []
+        for cell in grid:
+            if not isinstance(cell, dict) \
+                    or not isinstance(cell.get("cell"), str):
+                errors.append(f"{where}.result.grid: every cell must "
+                              f"name its trace basename")
+                continue
+            _check_runs(cell.get("runs"), f"{where}.result."
+                        f"grid[{cell['cell']!r}]")
+        for k in ("only_a", "only_b"):
+            if not isinstance(res.get(k), list):
+                errors.append(f"{where}.result: {k!r} must be a list "
+                              f"(unmatched cells are reported, never "
+                              f"dropped)")
+    else:
+        _check_runs(res.get("runs"), f"{where}.result")
+    return errors

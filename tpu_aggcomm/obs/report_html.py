@@ -278,6 +278,33 @@ def _tune_rows(root: str) -> list[dict]:
     return rows
 
 
+def _explain_rows(root: str) -> dict | None:
+    """Cost-model pane data from the newest committed ``PREDICT_*.json``
+    (model/artifact.py) — jax-free. None when no artifact exists (the
+    pane says so); a schema-invalid artifact becomes an error payload,
+    never a crash — and never a silently trusted number."""
+    from tpu_aggcomm.model.predict import newest_predict_path
+    from tpu_aggcomm.obs.regress import validate_predict
+
+    path = newest_predict_path(root)
+    if path is None:
+        return None
+    name = os.path.basename(path)
+    try:
+        with open(path) as fh:
+            blob = json.load(fh)
+    except (OSError, ValueError) as e:
+        return {"file": name, "error": f"unparsable JSON ({e})"}
+    errors = validate_predict(blob, name)
+    if errors:
+        return {"file": name, "error": errors[0]}
+    return {"file": name, "error": None, "seed": blob.get("seed"),
+            "platforms": blob["platforms"],
+            "validation": blob["validation"],
+            "crossover": blob.get("crossover"),
+            "explain": blob["explain"]}
+
+
 def build_payload(history_root: str = ".",
                   trace_paths: list[str] | None = None) -> dict:
     """The dashboard's inlined data: bench/multichip history + tuner
@@ -290,6 +317,7 @@ def build_payload(history_root: str = ".",
             "tune": _tune_rows(history_root),
             "runs": runs,
             "degradation": _degradation_rows(runs),
+            "explain": _explain_rows(history_root),
             "trend": check_trends(history_root),
             "errors": errors}
 
@@ -334,6 +362,8 @@ time; lower is better everywhere (seconds per rep).</p>
 <div id="traffic"></div>
 <h2>Fault degradation (recovery deltas)</h2>
 <div id="degradation"></div>
+<h2>Cost model (predicted vs measured, named verdicts)</h2>
+<div id="explain"></div>
 <script id="data" type="application/json">{payload}</script>
 <script>
 "use strict";
@@ -822,6 +852,89 @@ function fmtS(v) {{
       "recovery delta = faulted critical-path seconds minus the first " +
       "healthy run of the same (method, n, data size) — the measured " +
       "cost of surviving the fault, not a regression"));
+}})();
+
+(function explainPane() {{
+  var host = document.getElementById("explain");
+  var ex = DATA.explain;
+  if (!ex) {{
+    host.appendChild(el("p", {{class: "note"}},
+        "no PREDICT_*.json under the history root (run `cli inspect " +
+        "explain --json PREDICT_rNN.json` to calibrate the cost model)"));
+    return;
+  }}
+  if (ex.error) {{
+    host.appendChild(el("p", {{class: "err"}},
+        "cost-model artifact error: " + ex.error));
+    return;
+  }}
+  var head = el("p", {{}});
+  head.appendChild(el("b", {{}}, ex.file));
+  var plats = [];
+  for (var p in (ex.platforms || {{}})) {{
+    var b = ex.platforms[p];
+    plats.push(p + ": " + b.granularity + "-fit over " + b.observations +
+        " obs, tol ±" + (b.tolerance_rel * 100).toFixed(1) + "%");
+  }}
+  head.appendChild(document.createTextNode(
+      " (seed " + ex.seed + ") — " + plats.join("; ")));
+  host.appendChild(head);
+  var vlines = [];
+  for (var g in (ex.validation || {{}})) {{
+    var v = ex.validation[g];
+    vlines.push(g + ": tau_b " +
+        (v.tau_b === null ? "-" : v.tau_b.toFixed(3)) +
+        (v.held_out ? " (HELD-OUT)" : "") + ", top-1 " +
+        (v.top1 && v.top1.agree ? "agrees" : "DISAGREES"));
+  }}
+  if (vlines.length)
+    host.appendChild(el("p", {{class: "note"}},
+        "rank-order validation — " + vlines.join("; ")));
+  (ex.explain || []).forEach(function (t) {{
+    (t.runs || []).forEach(function (r) {{
+      var cap = el("p", {{}});
+      cap.appendChild(el("b", {{}}, t.trace));
+      cap.appendChild(document.createTextNode(
+          " — run #" + r.run + ": m" + r.method + " n=" + r.nprocs +
+          " c=" + r.comm_size +
+          (r.fault ? " [fault " + r.fault + "]" : "") +
+          " (" + t.platform + ")"));
+      host.appendChild(cap);
+      var tbl = el("table");
+      var hr = el("tr");
+      ["round", "predicted", "measured", "deviation", "verdict"]
+        .forEach(function (h, i) {{
+          hr.appendChild(el("th", i === 0 || i === 4 ?
+              {{class: "l"}} : {{}}, h)); }});
+      tbl.appendChild(hr);
+      var rows = (r.rounds || []).concat(
+          r.total ? [Object.assign({{round: "total"}}, r.total)] : []);
+      rows.forEach(function (row) {{
+        var tr = el("tr");
+        tr.appendChild(el("td", {{class: "l"}}, String(row.round)));
+        tr.appendChild(el("td", {{}}, fmtS(row.predicted_s)));
+        tr.appendChild(el("td", {{}},
+            row.measured_s === null || row.measured_s === undefined ?
+            "-" : fmtS(row.measured_s)));
+        tr.appendChild(el("td", {{}},
+            row.deviation_rel === null ||
+            row.deviation_rel === undefined ? "-" :
+            (row.deviation_rel >= 0 ? "+" : "") +
+            (row.deviation_rel * 100).toFixed(1) + "%"));
+        var vd = el("td", {{class: "l"}}, row.verdict);
+        if (row.verdict && row.verdict.indexOf("UNEXPLAINED") === 0)
+          vd.className = "l err";
+        tr.appendChild(vd);
+        tbl.appendChild(tr);
+      }});
+      host.appendChild(tbl);
+    }});
+  }});
+  host.appendChild(el("p", {{class: "note"}},
+      "predictions come from static op-program features alone " +
+      "(tpu_aggcomm/model/, jax-free); verdicts name the dominant " +
+      "modeled cost within the calibrated tolerance — advisory only, " +
+      "measured rounds stay the source of truth"));
 }})();
 </script></body></html>
 """

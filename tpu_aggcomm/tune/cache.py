@@ -92,12 +92,19 @@ def tune_paths(root: str) -> list[str]:
 
 def save_tune(root: str, *, key: dict, manifest: dict | None,
               space: dict, race: dict, winner: dict,
-              synthetic: bool = False) -> str:
+              synthetic: bool = False,
+              model_prune: dict | None = None) -> str:
     blob = {"schema": TUNE_SCHEMA, "key": dict(key),
             "manifest": manifest, "space": dict(space),
             "race": dict(race), "winner": dict(winner),
             "synthetic": bool(synthetic),
             "created_unix": time.time()}
+    if model_prune is not None:
+        # the --model-prune record (cli._model_prune): which committed
+        # PREDICT artifact priced the grid, at what margin, and the
+        # resulting kept/pruned split — enough for --replay to re-derive
+        # the split with no model import
+        blob["model_prune"] = dict(model_prune)
     path = artifact_path(root, key)
     from tpu_aggcomm.obs.atomic import atomic_write
     with atomic_write(path) as fh:
