@@ -28,8 +28,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tpu_aggcomm.obs.history import load_history
 from tpu_aggcomm.obs.regress import (parsed_schema_version, validate_bench,
                                      validate_compare, validate_multichip,
-                                     validate_predict, validate_traffic,
-                                     validate_tune)
+                                     validate_predict, validate_serve,
+                                     validate_traffic, validate_tune)
 
 
 def check(root: str) -> int:
@@ -83,6 +83,30 @@ def check(root: str) -> int:
         else:
             verdict = blob.get("conformance", {}).get("verdict", "?")
             print(f"ok   {name} ({blob.get('schema', '?')}, {verdict})")
+    # SERVE_r*.json load-generator artifacts (scripts/serve_loadgen.py,
+    # serve-v1): discovered through load_history like the bench rounds
+    # so this check and `inspect history` can never see different files;
+    # absence is fine (serving is optional), a broken one is not
+    n_serve = 0
+    serve_errors: list[str] = []
+    for rnd, path, blob in load_history(root, "SERVE",
+                                        errors=serve_errors):
+        n_files += 1
+        n_serve += 1
+        errors = validate_serve(blob, os.path.basename(path))
+        if errors:
+            n_errors += len(errors)
+            for e in errors:
+                print(f"FAIL {e}")
+        else:
+            print(f"ok   {os.path.basename(path)} "
+                  f"({blob.get('schema', '?')}, "
+                  f"{blob.get('completed', '?')} requests)")
+    for e in serve_errors:
+        n_files += 1
+        n_serve += 1
+        n_errors += 1
+        print(f"FAIL {e}")
     from tpu_aggcomm.tune.cache import tune_paths
     for path in tune_paths(root):
         n_files += 1
@@ -137,7 +161,8 @@ def check(root: str) -> int:
         print(f"FAIL no BENCH_r*/MULTICHIP_r*.json found under {root}")
         return 1
     print(f"{n_files} artifact(s) ({n_tune} tune, {n_traffic} traffic, "
-          f"{n_model} model/compare), {n_errors} schema error(s)")
+          f"{n_model} model/compare, {n_serve} serve), "
+          f"{n_errors} schema error(s)")
     return 1 if n_errors else 0
 
 

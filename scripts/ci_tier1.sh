@@ -110,5 +110,12 @@ python -m tpu_aggcomm.cli inspect history > /dev/null || post_rc=1
 # one-JSON-line contract, and leave artifacts whose attempt timeline
 # replays REPRODUCED jax-free (scripts/chaos_smoke.py).
 python scripts/chaos_smoke.py || post_rc=1
+# serve smoke (tpu_aggcomm/serve/): a CPU jax_sim schedule server must
+# complete 32 mixed-shape load-generator requests with every batched
+# result verified byte-exact, warm-cache hits skipping compilation
+# (exactly 4 compiles for 4 distinct shapes), warm p50 >= 10x below
+# cold p50, exactly ONE summary JSON line, and an emitted SERVE_*.json
+# that passes obs/regress.validate_serve (scripts/serve_smoke.py).
+python scripts/serve_smoke.py || post_rc=1
 if [ "$rc" -eq 0 ]; then rc=$post_rc; fi
 exit $rc

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the aggregation server (jax-free client).
+
+Drives ``python -m tpu_aggcomm.cli serve`` with bursts of mixed-shape
+requests on an open-loop arrival schedule (arrival times are fixed up
+front — a slow server eats queueing delay in its latency numbers, it
+does not slow the offered load), then reports sustained requests/s and
+latency quantiles. Bursts are same-shape ON PURPOSE: that is the
+batching opportunity the server's leading request axis exists for.
+
+Prints exactly ONE summary JSON line on stdout (stderr carries detail),
+and with ``--out``/``--round`` writes the ``SERVE_r*.json`` (serve-v1)
+artifact via ``obs.atomic_write`` — validated by
+``obs/regress.validate_serve``, discovered by ``obs/history``
+(``inspect history``), trend-gated like every other bench series.
+Latency quantiles in both outputs are ``obs.metrics.percentile``
+arithmetic over the recorded per-request samples, so a validator can
+re-derive them float-exactly.
+
+Usage::
+
+    # spawn a CPU jax_sim server, 32 requests, write the artifact
+    python scripts/serve_loadgen.py --spawn --requests 32 --verify \
+        --out SERVE_r01.json
+
+    # attach to a running server instead
+    python scripts/serve_loadgen.py --port 43210 --requests 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_aggcomm.obs.metrics import percentile
+from tpu_aggcomm.serve.protocol import ServeClient
+
+SERVE_SCHEMA = "serve-v1"
+
+#: Default mixed-shape request menu (small CPU-smoke shapes; override
+#: with --shapes). Letters mirror the CLI bench flags.
+DEFAULT_SHAPES = ("m1 n8 a2 c4 d64", "m3 n8 a2 c4 d64",
+                  "m4 n16 a4 c2 d64", "m11 n8 a2 c8 d64")
+
+_LETTER = {"m": "method", "n": "nprocs", "a": "cb_nodes",
+           "c": "comm_size", "d": "data_size", "p": "proc_node",
+           "t": "agg_type", "b": "barrier_type"}
+
+
+def parse_shape(spec: str) -> dict:
+    """One shape spec ("m3 n8 a2 c4 d64 [fault=...]") -> request fields."""
+    out: dict = {}
+    for tok in spec.split():
+        if tok.startswith("fault="):
+            out["fault"] = tok[len("fault="):]
+            continue
+        if tok[:1] in _LETTER and tok[1:].lstrip("-").isdigit():
+            out[_LETTER[tok[:1]]] = int(tok[1:])
+            continue
+        raise SystemExit(f"serve_loadgen: bad shape token {tok!r} in "
+                         f"{spec!r} (letters: {sorted(_LETTER)}, or "
+                         f"fault=SPEC)")
+    for req in ("method", "nprocs", "cb_nodes", "comm_size"):
+        if req not in out:
+            raise SystemExit(f"serve_loadgen: shape {spec!r} is missing "
+                             f"{req!r} (token letter "
+                             f"{ {v: k for k, v in _LETTER.items()}[req] })")
+    return out
+
+
+def _quant(samples: list[float]) -> dict | None:
+    if not samples:
+        return None
+    return {"p50": percentile(samples, 50.0),
+            "p95": percentile(samples, 95.0),
+            "p99": percentile(samples, 99.0)}
+
+
+def spawn_server(args) -> tuple[subprocess.Popen, int]:
+    """Start ``cli serve`` as a child and parse its ready line."""
+    cmd = [sys.executable, "-m", "tpu_aggcomm.cli", "serve",
+           "--backend", args.backend, "--port", "0",
+           "--max-batch", str(args.max_batch),
+           "--batch-window-ms", str(args.batch_window_ms)]
+    if args.journal:
+        cmd += ["--journal", args.journal]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=sys.stderr, text=True)
+    line = proc.stdout.readline()
+    try:
+        ready = json.loads(line)
+        assert ready.get("serve") == "ready"
+    except (ValueError, AssertionError):
+        proc.kill()
+        raise SystemExit(f"serve_loadgen: server did not print a ready "
+                         f"line (got {line!r})")
+    print(f"serve_loadgen: spawned server pid {proc.pid} on port "
+          f"{ready['port']}", file=sys.stderr)
+    return proc, int(ready["port"])
+
+
+def run_load(args, port: int) -> dict:
+    """Fire the open-loop schedule; returns the summary record."""
+    shapes = [parse_shape(s) for s in args.shapes]
+    burst = max(1, args.burst)
+    gap_s = args.gap_ms / 1e3
+    n = args.requests
+    t_start = time.monotonic()
+    arrivals = [t_start + (i // burst) * gap_s for i in range(n)]
+    records: list[dict | None] = [None] * n
+
+    def fire(i: int) -> None:
+        shape = shapes[(i // burst) % len(shapes)]
+        delay = arrivals[i] - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        t0 = time.monotonic()
+        try:
+            with ServeClient(port, timeout=args.timeout) as c:
+                resp = c.run(**dict(shape, iter=i, verify=args.verify))
+        except Exception as e:  # lint: broad-ok (a dead request is a record, not a loadgen crash)
+            records[i] = {"ok": False, "error": f"{type(e).__name__}: {e}",
+                          "latency_s": time.monotonic() - t0,
+                          "cache": None}
+            return
+        resp["latency_s"] = time.monotonic() - t0   # client-side wall
+        records[i] = resp
+
+    threads = [threading.Thread(target=fire, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    duration = time.monotonic() - t_start
+
+    with ServeClient(port, timeout=args.timeout) as c:
+        stats = c.stats()
+
+    done = [r for r in records if r and r.get("ok")]
+    errs = [r for r in records if not (r and r.get("ok"))]
+    warm = [r["latency_s"] for r in done if r.get("cache") == "hit"]
+    cold = [r["latency_s"] for r in done if r.get("cache") != "hit"]
+    samples = [r["latency_s"] for r in done]
+    verified = sum(1 for r in done if r.get("verified"))
+    for r in errs:
+        print(f"serve_loadgen: request error: "
+              f"{(r or {}).get('error')}", file=sys.stderr)
+    return {
+        "backend": args.backend, "requests": n, "completed": len(done),
+        "errors": len(errs), "verified": verified,
+        "duration_s": duration,
+        "rps": len(done) / duration if duration > 0 else 0.0,
+        "samples": samples, "latency_s": _quant(samples),
+        "warm": {"n": len(warm), "samples": warm, "p50":
+                 percentile(warm, 50.0) if warm else None},
+        "cold": {"n": len(cold), "samples": cold, "p50":
+                 percentile(cold, 50.0) if cold else None},
+        "cache": stats["cache"], "batch": stats["batch"],
+        "shapes": list(args.shapes)}
+
+
+def write_artifact(path: str, summary: dict) -> str:
+    from tpu_aggcomm.obs.atomic import atomic_write
+    from tpu_aggcomm.obs.ledger import manifest
+    blob = dict(summary, schema=SERVE_SCHEMA,
+                manifest=manifest(), created_unix=time.time())
+    with atomic_write(path) as fh:
+        json.dump(blob, fh, indent=1)
+        fh.write("\n")
+    return path
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    tgt = ap.add_mutually_exclusive_group()
+    tgt.add_argument("--port", type=int, default=None,
+                     help="attach to a running server on this port")
+    tgt.add_argument("--spawn", action="store_true",
+                     help="spawn 'cli serve' for the duration of the run "
+                          "(default when no --port is given)")
+    ap.add_argument("--backend", default="jax_sim",
+                    choices=("jax_sim", "pallas_fused"))
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--burst", type=int, default=8,
+                    help="same-shape requests per open-loop arrival burst "
+                         "(default 8 — the batching opportunity)")
+    ap.add_argument("--gap-ms", type=float, default=30.0,
+                    help="open-loop gap between bursts (default 30 ms)")
+    ap.add_argument("--shapes", nargs="+", default=list(DEFAULT_SHAPES),
+                    metavar="SPEC",
+                    help='request shapes, e.g. "m3 n8 a2 c4 d64" '
+                         "(bursts cycle through them)")
+    ap.add_argument("--verify", action="store_true",
+                    help="ask the server to verify every request "
+                         "byte-exact against the deterministic oracle")
+    ap.add_argument("--max-batch", type=int, default=8,
+                    help="(spawn mode) server --max-batch")
+    ap.add_argument("--batch-window-ms", type=float, default=5.0,
+                    help="(spawn mode) server --batch-window-ms")
+    ap.add_argument("--journal", default=None,
+                    help="(spawn mode) server --journal PATH")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-request client timeout (default 600 s)")
+    out = ap.add_mutually_exclusive_group()
+    out.add_argument("--out", metavar="SERVE_rNN.json", default=None,
+                     help="write the serve-v1 artifact here")
+    out.add_argument("--round", type=int, default=None, metavar="NN",
+                     help="write ./SERVE_rNN.json")
+    args = ap.parse_args(argv)
+
+    proc = None
+    if args.port is None:
+        proc, port = spawn_server(args)
+    else:
+        port = args.port
+    try:
+        summary = run_load(args, port)
+    finally:
+        if proc is not None:
+            try:
+                with ServeClient(port, timeout=30.0) as c:
+                    c.shutdown()
+            except Exception as e:  # lint: broad-ok (best-effort shutdown; the wait below reaps)
+                print(f"serve_loadgen: shutdown request failed: {e}",
+                      file=sys.stderr)
+                proc.terminate()
+            proc.wait(timeout=60)
+
+    path = args.out if args.out is not None else (
+        f"SERVE_r{args.round:02d}.json" if args.round is not None
+        else None)
+    summary["artifact"] = None
+    if path is not None:
+        summary["artifact"] = write_artifact(path, summary)
+        print(f"serve_loadgen: wrote {path}", file=sys.stderr)
+
+    line = {k: v for k, v in summary.items()
+            if k not in ("samples",)}      # the one-line summary stays short
+    line["warm"] = {"n": summary["warm"]["n"], "p50": summary["warm"]["p50"]}
+    line["cold"] = {"n": summary["cold"]["n"], "p50": summary["cold"]["p50"]}
+    print(json.dumps({"serve_loadgen": "v1", **line}))
+    bad = summary["errors"] > 0 or summary["completed"] == 0
+    if args.verify and summary["verified"] != summary["completed"]:
+        bad = True
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,145 @@
+"""Serve smoke gate (ci_tier1.sh): the aggregation server must amortize
+compiles and batch correctly, CPU-only, auditable from its artifact.
+
+One subprocess drive of the real entry points (``cli serve`` spawned by
+``scripts/serve_loadgen.py``), then assertions over the ONE summary
+JSON line and the emitted ``SERVE_*.json``:
+
+1. **32 mixed-shape requests complete and verify byte-exact** — every
+   request carries ``--verify``, so each batched result was checked
+   in-process against the deterministic-fill oracle; any mismatch
+   fails the run.
+2. **Warm hits skip compilation** — bursts cycle 4 distinct shapes
+   twice, so exactly 4 compiles must serve all 32 requests
+   (``cache.compiles == misses == 4``, zero evictions) and the warm
+   hits must exist.
+3. **The cache is worth having** — warm p50 request latency must be at
+   least 10x below cold p50 (cold pays schedule build + jit + warmup;
+   warm is dispatch-only: the whole point of a persistent server).
+4. **Contract**: the load generator printed exactly ONE JSON line on
+   stdout, and the artifact passes ``obs/regress.validate_serve``
+   (what check_bench_schema.py enforces on committed history).
+
+Exit 0 only when all hold.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WARM_SPEEDUP = 10.0
+
+
+def cpu_env(**extra) -> dict:
+    """The CLAUDE.md CPU recipe: disarm the tunnel, force cpu."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def fail(msg: str) -> int:
+    print(f"serve-smoke: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="serve_smoke_")
+    out_path = os.path.join(tmp, "SERVE_smoke.json")
+
+    # burst 4 over 4 default shapes: bursts 5-8 re-hit shapes 1-4, so
+    # half the load MUST land warm on the compiled-chain cache. The
+    # burst gap clears each compile before the next burst arrives —
+    # warm latency then measures the dispatch path, not time spent
+    # queued behind another shape's cold compile (the 10x criterion
+    # compares the paths, not the backlog)
+    r = subprocess.run(
+        [sys.executable, "scripts/serve_loadgen.py", "--spawn",
+         "--requests", "32", "--burst", "4", "--gap-ms", "2500",
+         "--max-batch", "4", "--batch-window-ms", "50", "--verify",
+         "--journal", os.path.join(tmp, "serve.journal.jsonl"),
+         "--out", out_path],
+        cwd=REPO, capture_output=True, text=True, env=cpu_env())
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-2000:])
+        return fail(f"load generator exited {r.returncode}")
+
+    # -- contract: exactly ONE JSON line on stdout -------------------------
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    if len(lines) != 1:
+        return fail(f"expected exactly 1 stdout line, got {len(lines)}: "
+                    f"{lines[:3]}")
+    try:
+        summary = json.loads(lines[0])
+    except ValueError as e:
+        return fail(f"summary line is not JSON ({e}): {lines[0]!r}")
+    if summary.get("serve_loadgen") != "v1":
+        return fail(f"summary line missing the serve_loadgen tag: "
+                    f"{lines[0]!r}")
+
+    # -- 1: all 32 requests completed and verified byte-exact --------------
+    if summary["requests"] != 32 or summary["completed"] != 32 \
+            or summary["errors"] != 0:
+        return fail(f"request accounting off: {summary['completed']}/32 "
+                    f"completed, {summary['errors']} errors")
+    if summary["verified"] != 32:
+        return fail(f"only {summary['verified']}/32 requests verified "
+                    f"byte-exact against the oracle")
+
+    # -- 2: warm hits skipped compilation ----------------------------------
+    cache = summary["cache"]
+    if cache["compiles"] != 4 or cache["misses"] != 4 \
+            or cache["evictions"] != 0:
+        return fail(f"4 distinct shapes must mean exactly 4 compiles "
+                    f"(got {cache}) — a warm hit that recompiles "
+                    f"defeats the cache")
+    if cache["hits"] < 1 or summary["warm"]["n"] < 1:
+        return fail(f"no warm hits recorded ({cache}, warm "
+                    f"{summary['warm']}) — the re-hit bursts must land "
+                    f"on the compiled chains")
+    if summary["batch"]["batched_requests"] < 8:
+        return fail(f"batching never engaged: {summary['batch']} — "
+                    f"same-shape bursts of 4 must form real batches")
+
+    # -- 3: the warm path must beat the cold path by >= 10x -----------------
+    warm_p50, cold_p50 = summary["warm"]["p50"], summary["cold"]["p50"]
+    if not (isinstance(warm_p50, float) and isinstance(cold_p50, float)):
+        return fail(f"missing warm/cold p50: {warm_p50!r}, {cold_p50!r}")
+    if warm_p50 * WARM_SPEEDUP > cold_p50:
+        return fail(f"warm p50 {warm_p50:.4f}s is not {WARM_SPEEDUP:g}x "
+                    f"below cold p50 {cold_p50:.4f}s — the compiled-"
+                    f"chain cache is not amortizing the cold path")
+
+    # -- 4: the artifact validates like committed history -------------------
+    from tpu_aggcomm.obs.regress import validate_serve
+    try:
+        with open(out_path) as fh:
+            blob = json.load(fh)
+    except (OSError, ValueError) as e:
+        return fail(f"artifact unreadable: {e}")
+    errors = validate_serve(blob, os.path.basename(out_path))
+    if errors:
+        return fail("artifact failed validate_serve:\n  "
+                    + "\n  ".join(errors))
+    if len(blob.get("samples") or []) < 3:
+        return fail(f"artifact carries {len(blob.get('samples') or [])} "
+                    f"samples; >= 3 required for the trend gate")
+
+    print(f"serve-smoke: PASS — 32/32 verified, {cache['compiles']} "
+          f"compiles, {cache['hits']} warm hits, warm p50 "
+          f"{warm_p50 * 1e3:.1f} ms vs cold p50 {cold_p50 * 1e3:.1f} ms "
+          f"({cold_p50 / warm_p50:.0f}x), artifact valid",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,7 @@ spawned.
 """
 
 import os
+import re
 import subprocess
 import sys
 import time
@@ -191,6 +192,20 @@ def main() -> int:
                   [sys.executable, "scripts/tpu_sweeps.py", "--fused-only"]
                   + (["--resume"] if RESUME else []),
                   artifacts=["sweeps_fused.journal.jsonl"])
+        # aggregation-as-a-service benchmark: spawn the persistent
+        # schedule server and drive the open-loop load generator
+        # through mixed-shape bursts — warm-vs-cold request latency +
+        # sustained req/s land in the next SERVE_r*.json round (the
+        # serve-v1 history the trend gate watches). Resumable via this
+        # stage's journal entry under the same manifest fingerprint.
+        serve_rounds = [int(m.group(1)) for f in os.listdir(REPO)
+                        if (m := re.match(r"SERVE_r(\d+)\.json$", f))]
+        serve_out = (f"SERVE_r{max(serve_rounds) + 1 if serve_rounds else 1:02d}"
+                     f".json")
+        run_stage("serve-bench",
+                  [sys.executable, "scripts/serve_loadgen.py", "--spawn",
+                   "--requests", "32", "--verify", "--out", serve_out],
+                  artifacts=[serve_out])
         # run ledger over everything the session just wrote (plus the
         # committed history): environment manifests, compile seconds,
         # HBM peaks, and drift between consecutive rounds — jax-free,

@@ -317,10 +317,12 @@ def test_lint_clean_on_tree():
 
 def test_pure_packages_cover_the_declared_set():
     assert set(PURE_PACKAGES) == {"core", "obs", "faults", "resilience",
-                                  "analysis", "tune", "native", "model"}
+                                  "analysis", "tune", "native", "model",
+                                  "serve"}
     mods = pure_modules()
     assert "tpu_aggcomm.analysis.lint" in mods      # enforces itself
     assert "tpu_aggcomm.tune.measure" not in mods   # THE jax importer
+    assert "tpu_aggcomm.serve.executor" not in mods  # the serve jax door
 
 
 def _seed_tree(root, pure_src, script_src):

@@ -1,0 +1,509 @@
+"""Aggregation-as-a-service tests (tpu_aggcomm/serve/).
+
+The pins that define the subsystem:
+
+- **Batching never bends bytes**: the vmap-batched jax_sim path must be
+  byte-exact vs the sequential single-rep path AND the local oracle for
+  every fusable method (rounds stay fenced; batching adds an axis, it
+  never re-schedules).
+- **Drift evicts by NAME**: a manifest-fingerprint change must evict
+  the compiled-chain entry with the divergent key named (the same
+  ``diff_manifests`` lens as ``sweep --resume`` and the tune cache)
+  and the next request must recompile.
+- **The control plane is jax-free**: protocol/cache/server must import
+  (and a server must refuse/answer) where ``import jax`` raises —
+  poisoned-jax subprocess pin, parameterized from the purity contract.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import _jaxfree
+
+REPO = _jaxfree.REPO
+
+from tpu_aggcomm.core.methods import METHODS, compile_method
+from tpu_aggcomm.core.pattern import AggregatorPattern
+from tpu_aggcomm.serve.cache import CompiledChainCache
+from tpu_aggcomm.serve.protocol import (ProtocolError, ServeClient,
+                                        parse_request, request_schedule)
+from tpu_aggcomm.serve.server import SERVE_BACKENDS, ScheduleServer
+
+
+def _pattern(method, nprocs=8, cb_nodes=2, comm_size=2, data_size=64):
+    return AggregatorPattern(nprocs=nprocs, cb_nodes=cb_nodes,
+                             data_size=data_size, placement=0,
+                             proc_node=1, comm_size=comm_size)
+
+
+def _fusable_methods():
+    out = []
+    for m in sorted(METHODS):
+        if METHODS[m].tam:
+            continue
+        sched = compile_method(m, _pattern(m))
+        if getattr(sched, "collective", False):
+            continue
+        out.append(m)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Protocol
+
+
+def test_parse_request_defaults_and_validation():
+    req = parse_request({"method": 3, "nprocs": 8, "cb_nodes": 2,
+                         "comm_size": 4})
+    assert req.data_size == 2048 and req.iter_ == 0 and req.fault is None
+    req2 = parse_request({"method": 3, "nprocs": 8, "cb_nodes": 2,
+                          "comm_size": 4, "iter": 7, "verify": True})
+    assert req2.iter_ == 7 and req2.verify is True
+    with pytest.raises(ProtocolError):
+        parse_request({"method": 3, "nprocs": 8, "cb_nodes": 2})
+    with pytest.raises(ProtocolError):
+        parse_request({"method": True, "nprocs": 8, "cb_nodes": 2,
+                       "comm_size": 4})   # bool is not an int here
+    with pytest.raises(ProtocolError):
+        parse_request({"method": 99, "nprocs": 8, "cb_nodes": 2,
+                       "comm_size": 4, "verify": "yes"})
+
+
+def test_request_schedule_unknown_method_and_fault():
+    with pytest.raises(ProtocolError):
+        request_schedule(parse_request(
+            {"method": 999, "nprocs": 8, "cb_nodes": 2, "comm_size": 4}))
+    sched = request_schedule(parse_request(
+        {"method": 3, "nprocs": 32, "cb_nodes": 8, "comm_size": 4,
+         "data_size": 64, "fault": "deadlink:5>3"}))
+    from tpu_aggcomm.core.schedule import schedule_shape_key
+    assert schedule_shape_key(sched)[-1] == "deadlink:5>3"
+
+
+# ---------------------------------------------------------------------------
+# Cache drift (satellite: eviction NAMED, same diff_manifests semantics)
+
+
+def _man(jax_ver):
+    return {"versions": {"jax": jax_ver, "numpy": "2.0"},
+            "platform": "cpu"}
+
+
+def test_cache_drift_evicts_with_divergent_key_named():
+    from tpu_aggcomm.tune.cache import manifest_fingerprint
+    m1, m2 = _man("0.4.37"), _man("0.5.0")
+    fp1, fp2 = manifest_fingerprint(m1), manifest_fingerprint(m2)
+    assert fp1 != fp2
+    cache = CompiledChainCache()
+    key = ("pat", 3, False, (), "", None)
+
+    entry, reason = cache.lookup(key, "jax_sim", fingerprint=fp1,
+                                 manifest=m1)
+    assert entry is None and "compiling" in reason
+    cache.put(key, "jax_sim", fingerprint=fp1, manifest=m1,
+              chain=object(), compile_s=0.1)
+    entry, reason = cache.lookup(key, "jax_sim", fingerprint=fp1,
+                                 manifest=m1)
+    assert entry is not None and reason is None
+
+    # fingerprint change ⟹ eviction naming the drifted key — the very
+    # key diff_manifests reports, so this cache and sweep --resume can
+    # never disagree about what drift means
+    from tpu_aggcomm.obs.ledger import diff_manifests
+    drifted = [d["key"] for d in diff_manifests(m1, m2)]
+    assert "versions.jax" in drifted
+    entry, reason = cache.lookup(key, "jax_sim", fingerprint=fp2,
+                                 manifest=m2)
+    assert entry is None
+    assert reason.startswith("manifest drift")
+    assert "versions.jax" in reason and "evicted" in reason
+    assert cache.stats()["evictions"] == 1 and len(cache) == 0
+
+    # recompile path: a fresh put under the new fingerprint hits again
+    cache.put(key, "jax_sim", fingerprint=fp2, manifest=m2,
+              chain=object(), compile_s=0.1)
+    entry, reason = cache.lookup(key, "jax_sim", fingerprint=fp2,
+                                 manifest=m2)
+    assert entry is not None and reason is None
+
+
+def test_cache_ignores_drift_exempt_keys():
+    # keys under DRIFT_IGNORE (timestamps, rpc probe) change the
+    # manifest but not the fingerprint: no eviction — exactly the
+    # resume-journal semantics (no drift ⟺ same fingerprint)
+    from tpu_aggcomm.tune.cache import manifest_fingerprint
+    m1 = _man("0.4.37")
+    m2 = dict(m1, created_unix=12345.0, git_sha="deadbeef")
+    assert manifest_fingerprint(m1) == manifest_fingerprint(m2)
+
+
+# ---------------------------------------------------------------------------
+# Batched-vs-sequential byte-exactness (the tentpole's hard line)
+
+
+def _assert_same_bufs(a, b, ctx=""):
+    assert len(a) == len(b), ctx
+    for r, (x, y) in enumerate(zip(a, b)):
+        if x is None or y is None:
+            assert x is None and y is None, f"{ctx} rank {r}"
+        else:
+            assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                f"{ctx} rank {r} differs"
+
+
+def _pin_batched_vs_sequential(method, iters=(0, 1, 2)):
+    from tpu_aggcomm.backends.local import LocalBackend
+    from tpu_aggcomm.serve import executor
+
+    sched = compile_method(method, _pattern(method))
+    chain, compile_s = executor.build_chain(sched, "jax_sim")
+    assert compile_s > 0
+    batched = executor.batched_recv_bytes(chain, list(iters))
+    for k, it in enumerate(iters):
+        seq = executor.recv_bytes(chain, it)
+        _assert_same_bufs(batched[k], seq,
+                          f"m={method} iter={it} batched-vs-seq")
+        oracle, _ = LocalBackend().run(sched, ntimes=1, iter_=it,
+                                       verify=True)
+        _assert_same_bufs(batched[k], oracle,
+                          f"m={method} iter={it} batched-vs-oracle")
+
+
+def test_batched_matches_sequential_and_oracle_representative():
+    # one per structural family: fenced throttle (1), balanced (3),
+    # many_to_all (11) — the full fusable sweep runs full-suite only
+    for m in (1, 3, 11):
+        _pin_batched_vs_sequential(m)
+
+
+@pytest.mark.slow
+def test_batched_matches_sequential_every_fusable_method():
+    for m in _fusable_methods():
+        _pin_batched_vs_sequential(m, iters=(0, 1))
+
+
+def test_batching_preserves_round_fences():
+    # the batched program must contain exactly the sequential program's
+    # optimization_barrier fences (per round), not fewer — vmap adds an
+    # axis, it must never let XLA fuse the fenced rounds away
+    import jax
+    from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+    from tpu_aggcomm.serve import executor
+
+    sched = compile_method(1, _pattern(1))
+    backend = JaxSimBackend()
+    rep = backend.one_rep(sched)
+    executor._ensure_barrier_batching_rule()
+    send = backend._global_send(sched.pattern, 0)
+
+    def count_barriers(fn, arg):
+        txt = jax.make_jaxpr(fn)(arg).pretty_print()
+        return txt.count("optimization_barrier")
+
+    n_seq = count_barriers(rep, send)
+    n_bat = count_barriers(jax.vmap(rep), np.stack([send, send]))
+    assert n_seq > 0
+    assert n_bat == n_seq
+
+
+def test_pad_to_powers_of_two():
+    from tpu_aggcomm.serve.executor import _pad_to
+    assert [_pad_to(n) for n in (1, 2, 3, 4, 5, 7, 8, 9)] == \
+        [1, 2, 4, 4, 8, 8, 8, 16]
+
+
+def test_fused_chain_refuses_batching(monkeypatch):
+    monkeypatch.setenv("TPU_AGGCOMM_FUSED_INTERPRET", "1")
+    from tpu_aggcomm.serve import executor
+    sched = compile_method(1, _pattern(1))
+    chain, _ = executor.build_chain(sched, "pallas_fused")
+    assert chain.batched is None
+    with pytest.raises(ValueError, match="does not batch"):
+        executor.batched_recv_bytes(chain, [0, 1])
+    # per-request execution still verifies byte-exact (interpret mode)
+    req = parse_request({"method": 1, "nprocs": 8, "cb_nodes": 2,
+                         "comm_size": 2, "data_size": 64, "iter": 2,
+                         "verify": True})
+    res = executor.execute_batch(chain, [req])
+    assert res[0]["verified"] is True and res[0]["error"] is None
+
+
+# ---------------------------------------------------------------------------
+# The server end-to-end (in-process, CPU jax_sim)
+
+
+def _run_many(port, payloads):
+    out = [None] * len(payloads)
+
+    def fire(i):
+        with ServeClient(port, timeout=300.0) as c:
+            out[i] = c.run(**payloads[i])
+
+    ts = [threading.Thread(target=fire, args=(i,))
+          for i in range(len(payloads))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return out
+
+
+def test_server_roundtrip_batches_caches_and_evicts(tmp_path):
+    journal = tmp_path / "serve.journal.jsonl"
+    srv = ScheduleServer(backend="jax_sim", port=0, max_batch=4,
+                         batch_window_s=0.25,
+                         journal_path=str(journal))
+    srv.start()
+    try:
+        shape = {"method": 3, "nprocs": 8, "cb_nodes": 2,
+                 "comm_size": 2, "data_size": 64, "verify": True}
+        # burst of 4 same-shape requests: one compile, one batch
+        resps = _run_many(srv.port, [dict(shape, iter=i)
+                                     for i in range(4)])
+        assert all(r["ok"] and r["verified"] for r in resps)
+        assert {r["batch_n"] for r in resps} == {4}
+        assert sum(1 for r in resps if r["cache"] == "miss") == 4
+
+        # the same shape again: warm hit, no recompile, and the warm
+        # latency must beat the cold (compile-bearing) one
+        warm = _run_many(srv.port, [dict(shape, iter=9)])[0]
+        assert warm["ok"] and warm["cache"] == "hit"
+        assert warm["compile_s"] is None
+        assert warm["latency_s"] < min(r["latency_s"] for r in resps)
+
+        # manifest drift ⟹ the next request evicts + recompiles
+        from tpu_aggcomm.tune.cache import manifest_fingerprint
+        drifted = json.loads(json.dumps(srv._man))
+        drifted.setdefault("versions", {})["jax"] = "drifted-for-test"
+        srv._man, srv._fp = drifted, manifest_fingerprint(drifted)
+        evicted = _run_many(srv.port, [dict(shape, iter=10)])[0]
+        assert evicted["ok"] and evicted["cache"] == "evict"
+        assert evicted["compile_s"] is not None
+
+        # an invalid request errors without killing the server
+        with ServeClient(srv.port, timeout=60.0) as c:
+            bad = c.run(method=999, nprocs=8, cb_nodes=2, comm_size=2)
+        assert not bad["ok"] and "999" in bad["error"]
+
+        st = srv.stats()
+        assert st["completed"] == 6 and st["errors"] == 1
+        assert st["cache"]["compiles"] == 2
+        assert st["cache"]["evictions"] == 1
+        assert st["batch"]["max_batch"] == 4
+        assert st["warm"]["n"] == 1 and st["cold"]["n"] == 5
+        with ServeClient(srv.port, timeout=60.0) as c:
+            assert c.shutdown()["stopping"] is True
+        srv.join(timeout=60.0)
+    finally:
+        srv.stop()
+        srv.close()
+
+    # per-request accounting survived in the crash-safe journal
+    recs = [json.loads(line) for line in journal.read_text().splitlines()
+            if line.strip()]
+    reqs = [r for r in recs if "request" in json.dumps(r.get("key", ""))
+            or (isinstance(r.get("key"), dict) and "request" in r["key"])]
+    assert len(reqs) == 6
+    assert {r["key"]["request"] for r in reqs} == {1, 2, 3, 4, 5, 6}
+    assert all(r["fingerprint"] for r in reqs)
+    caches = [r.get("cache") for r in reqs]
+    assert caches.count("hit") == 1 and caches.count("evict") == 1
+
+
+def test_server_refuses_non_loopback_host():
+    with pytest.raises(ValueError, match="127.0.0.1 only"):
+        ScheduleServer(host="0.0.0.0")
+    with pytest.raises(ValueError, match="unknown backend"):
+        ScheduleServer(backend="jax_shard")
+    assert set(SERVE_BACKENDS) == {"jax_sim", "pallas_fused"}
+
+
+def test_server_metrics_endpoint_opt_in(tmp_path):
+    # OFF by default: no registry, no export import cost
+    srv = ScheduleServer(port=0)
+    try:
+        assert srv._metrics is None and "metrics_url" not in srv.ready_info()
+    finally:
+        srv.close()
+    # armed with port 0: ephemeral bind, URL in ready line and stats
+    srv = ScheduleServer(port=0, metrics_port=0)
+    srv.start()
+    try:
+        url = srv.ready_info()["metrics_url"]
+        assert url.startswith("http://127.0.0.1:")
+        _run_many(srv.port, [{"method": 3, "nprocs": 8, "cb_nodes": 2,
+                              "comm_size": 2, "data_size": 64}])
+        import urllib.request
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert "tpu_aggcomm_serve_request_seconds" in body
+        assert "tpu_aggcomm_serve_requests" in body
+        assert "tpu_aggcomm_serve_queue_depth" in body
+    finally:
+        srv.stop()
+        srv.close()
+
+
+def test_metrics_port0_announced_and_in_ledger(capsys):
+    # satellite: ephemeral /metrics port printed to stderr + recorded
+    # in the ledger BY NAME (the port number only — never an address
+    # beyond loopback, never an env value)
+    from tpu_aggcomm.obs import ledger
+    from tpu_aggcomm.obs.export import MetricsRegistry, serve_from_env
+    reg = MetricsRegistry()
+    srv = serve_from_env(reg.render, port=0)
+    try:
+        err = capsys.readouterr().err
+        assert f"ephemeral port {srv.port}" in err
+        recs = [r for r in ledger.resilience_records()
+                if r.get("site") == "metrics.endpoint"]
+        assert recs and recs[-1]["kind"] == "bind"
+        assert recs[-1]["port"] == srv.port
+        assert set(recs[-1]) == {"site", "kind", "port"}
+        # a bind record must never confuse the attempt replayer
+        from tpu_aggcomm.resilience.policy import replay_attempts
+        replay_attempts([r for r in ledger.resilience_records()])
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# The jax-free control plane (poisoned-jax subprocess pins)
+
+
+def test_serve_control_plane_is_jaxfree(tmp_path):
+    code = _jaxfree.pure_import_code("tpu_aggcomm.serve")
+    subprocess.run(
+        [sys.executable, "-c", code], check=True, cwd=REPO,
+        env=_jaxfree.poisoned_env(
+            tmp_path, reason="serve control plane must not import jax"))
+
+
+def test_server_answers_stats_under_poisoned_jax(tmp_path):
+    # an operator must be able to start, query, and stop a server whose
+    # tunnel has wedged jax imports — only a run request needs the door
+    code = """
+import sys
+from tpu_aggcomm.serve.server import ScheduleServer
+from tpu_aggcomm.serve.protocol import ServeClient
+srv = ScheduleServer(port=0)
+srv.start()
+with ServeClient(srv.port, timeout=30.0) as c:
+    st = c.stats()
+    assert st["ok"] and st["completed"] == 0
+    assert c.shutdown()["stopping"] is True
+srv.join(timeout=30.0)
+srv.stop(); srv.close()
+assert "jax" not in sys.modules
+print("STATS-OK")
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code], check=True, cwd=REPO,
+        env=_jaxfree.poisoned_env(
+            tmp_path, reason="serve control plane must not import jax"),
+        capture_output=True, text=True)
+    assert "STATS-OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# Artifact schema + history discovery + trend gate
+
+
+def _serve_blob(warm_p50, rnd, backend="jax_sim"):
+    from tpu_aggcomm.obs.metrics import percentile
+    warm = [warm_p50 * f for f in (0.9, 1.0, 1.1)]
+    cold = [warm_p50 * 30.0]
+    samples = warm + cold
+    return {
+        "schema": "serve-v1", "created_unix": 1700000000 + rnd,
+        "backend": backend, "requests": 4, "completed": 4, "errors": 0,
+        "verified": 4, "duration_s": 2.0, "rps": 4 / 2.0,
+        "samples": samples,
+        "latency_s": {"p50": percentile(samples, 50.0),
+                      "p95": percentile(samples, 95.0),
+                      "p99": percentile(samples, 99.0)},
+        "warm": {"n": 3, "samples": warm,
+                 "p50": percentile(warm, 50.0)},
+        "cold": {"n": 1, "samples": cold,
+                 "p50": percentile(cold, 50.0)},
+        "cache": {"entries": 1, "hits": 3, "misses": 1, "evictions": 0,
+                  "compiles": 1},
+        "batch": {"batches": 2, "max_batch": 2, "batched_requests": 4},
+        "shapes": ["m3 n8 a2 c2 d64"], "manifest": None}
+
+
+def test_validate_serve_accepts_and_rejects():
+    from tpu_aggcomm.obs.regress import validate_serve
+    blob = _serve_blob(0.01, 1)
+    assert validate_serve(blob) == []
+    assert validate_serve([]) == ["SERVE: top level must be an object"]
+    assert any("schema tag" in e for e in
+               validate_serve(dict(blob, schema="serve-v9")))
+    # a quantile its own samples contradict is schema-invalid
+    bad = dict(blob, latency_s=dict(blob["latency_s"],
+                                    p50=blob["latency_s"]["p50"] * 2))
+    assert any("re-derivable" in e for e in validate_serve(bad))
+    # broken request accounting
+    assert any("accounted" in e for e in
+               validate_serve(dict(blob, errors=1)))
+    # warm/cold must partition the samples
+    bad_warm = dict(blob, warm=dict(blob["warm"], n=2,
+                                    samples=blob["warm"]["samples"][:2]))
+    assert any("partition" in e for e in validate_serve(bad_warm))
+    # rps must be completed/duration
+    assert any("rps" in e for e in validate_serve(dict(blob, rps=99.0)))
+
+
+def test_serve_history_discovery_and_trend_gate(tmp_path):
+    from tpu_aggcomm.obs.history import (build_index, check_trends,
+                                         render_history, serve_series)
+    # warm p50 strongly increasing round over round ⟹ drifting-up
+    for rnd in range(1, 6):
+        blob = _serve_blob(0.01 * (1.6 ** rnd), rnd)
+        (tmp_path / f"SERVE_r{rnd:02d}.json").write_text(
+            json.dumps(blob))
+    series = serve_series(str(tmp_path))
+    key = "serve warm p50 | jax_sim"
+    assert key in series and len(series[key]) == 5
+    assert [r["round"] for r in series[key]] == [1, 2, 3, 4, 5]
+
+    index = build_index(str(tmp_path))
+    assert key in index["serve"]
+
+    trends = check_trends(str(tmp_path))
+    assert trends["series"][key]["verdict"] == "drifting-up"
+    assert trends["ok"] is False
+    # seeded: the same artifacts give the same verdict byte-for-byte
+    assert check_trends(str(tmp_path)) == trends
+
+    text = render_history(str(tmp_path))
+    assert key in text and "DRIFTING-UP" in text
+
+
+def test_check_bench_schema_validates_serve(tmp_path):
+    # a broken committed SERVE artifact must fail the schema gate
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "x", "rc": 0, "tail": "", "parsed": None}))
+    (tmp_path / "SERVE_r01.json").write_text(json.dumps(
+        _serve_blob(0.01, 1)))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    ok = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_bench_schema.py"),
+         str(tmp_path)], capture_output=True, text=True, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "SERVE_r01.json (serve-v1" in ok.stdout
+    bad_blob = dict(_serve_blob(0.01, 2), rps=1234.5)
+    (tmp_path / "SERVE_r02.json").write_text(json.dumps(bad_blob))
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_bench_schema.py"),
+         str(tmp_path)], capture_output=True, text=True, env=env)
+    assert bad.returncode == 1
+    assert "SERVE_r02.json: rps" in bad.stdout

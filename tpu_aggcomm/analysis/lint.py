@@ -67,6 +67,7 @@ PURE_PACKAGES: dict = {
     "tune": ("measure",),
     "native": (),
     "model": (),
+    "serve": ("executor",),
 }
 
 BROAD_OK_PRAGMA = "# lint: broad-ok"
@@ -77,7 +78,8 @@ _JAX_ROOTS = ("jax", "jaxlib")
 #: committed artifact globs (repo root) for rule 5
 _ARTIFACT_GLOBS = ("BENCH_r*.json", "MULTICHIP_r*.json", "TUNE_*.json",
                    "TRAFFIC_*.json", "PREDICT_*.json", "COMPARE_*.json",
-                   "*.trace.json", "*.trace.jsonl", "BASELINE.json")
+                   "SERVE_r*.json", "*.trace.json", "*.trace.jsonl",
+                   "BASELINE.json")
 
 _IPV4 = re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b")
 

@@ -304,6 +304,43 @@ def build_parser() -> argparse.ArgumentParser:
                          "re-derivation matches the stored record "
                          "byte-for-byte")
 
+    # serve — the persistent aggregation server (tpu_aggcomm/serve/)
+    sv = sub.add_parser(
+        "serve", help="aggregation-as-a-service: a long-lived server "
+                      "holding a compiled-chain cache (schedule_shape_key "
+                      "+ backend + manifest fingerprint; drift = named "
+                      "eviction + recompile) and batching same-shape "
+                      "requests onto a leading request axis (vmap; rounds "
+                      "stay fenced). Binds 127.0.0.1 only; prints ONE "
+                      "ready JSON line with the bound port, then serves "
+                      "until a shutdown request. Drive it with "
+                      "scripts/serve_loadgen.py")
+    sv.add_argument("--backend", default="jax_sim",
+                    choices=("jax_sim", "pallas_fused"),
+                    help="default chain backend for requests that do not "
+                         "name one (default: jax_sim; pallas_fused "
+                         "entries always execute per-request)")
+    sv.add_argument("--port", type=int, default=0,
+                    help="listen port (default 0 = ephemeral, read it "
+                         "from the ready line)")
+    sv.add_argument("--max-batch", type=int, default=8,
+                    help="max same-shape requests fused onto the leading "
+                         "request axis (default 8)")
+    sv.add_argument("--batch-window-ms", type=float, default=5.0,
+                    help="how long the executor waits for same-shape "
+                         "stragglers before dispatching a batch "
+                         "(default 5 ms)")
+    sv.add_argument("--journal", metavar="PATH", default=None,
+                    help="crash-safe per-request JSONL journal "
+                         "(resilience/journal.py discipline)")
+    sv.add_argument("--metrics-port", type=int, default=None,
+                    help="opt-in OpenMetrics /metrics endpoint "
+                         "(obs/export.py; 0 = ephemeral port, announced "
+                         "on stderr; also via TPU_AGGCOMM_METRICS_PORT)")
+    sv.add_argument("--trace", metavar="PREFIX", default=None,
+                    help="flight recorder: batch spans + resilience "
+                         "attempts to PREFIX.trace.jsonl")
+
     # inspect — print a compiled schedule's round structure
     ins = sub.add_parser(
         "inspect", help="show how a method compiles for a pattern: rounds, "
@@ -1191,6 +1228,8 @@ def _resolve_auto(args, nprocs: int, *, sweep: bool = False) -> None:
                          backend=args.backend, manifest=man)
     entry, note = cache.lookup(args.tune_root, key, manifest=man)
     if entry is None:
+        if not sweep and _auto_fault_advise(args, nprocs, note):
+            return
         print(f"auto: {note}; falling back to -m {args.method}",
               file=sys.stderr)
         return
@@ -1207,6 +1246,88 @@ def _resolve_auto(args, nprocs: int, *, sweep: bool = False) -> None:
         args.agg_type = int(win["agg_type"])
         print(f"auto: tuned -m {args.method} -a {args.cb_nodes} "
               f"-c {args.comm_size} -t {args.agg_type}{tag} from {src}")
+
+
+def _auto_fault_advise(args, nprocs: int, note: str) -> bool:
+    """Fault-aware --auto fallback: on a tune-cache miss UNDER AN
+    ACTIVE --fault spec, rank the repaired same-direction candidates
+    with the newest committed ``PREDICT_*.json`` and apply the model's
+    pick — an stderr ADVISORY, never a verdict: measured rounds stay
+    the source of truth, and a missing/unusable artifact falls back to
+    the explicit flags exactly like a plain cache miss. Returns True
+    iff a model pick was applied."""
+    fault = getattr(args, "fault", None)
+    if not isinstance(fault, str):
+        return False
+    from tpu_aggcomm.faults.spec import FaultSpecError, parse_fault
+    try:
+        spec = parse_fault(fault)
+    except FaultSpecError:
+        return False          # run() will surface the malformed spec
+    if spec.empty:
+        return False
+    from tpu_aggcomm.model.artifact import load_artifact
+    from tpu_aggcomm.model.predict import newest_predict_path
+    path = newest_predict_path(getattr(args, "tune_root", ".") or ".") \
+        or newest_predict_path(".")
+    if path is None:
+        print(f"auto: no PREDICT_*.json to rank repaired candidates "
+              f"under --fault {spec.canonical()}; keeping explicit "
+              f"flags", file=sys.stderr)
+        return False
+    try:
+        art = load_artifact(path)
+    except (OSError, ValueError) as e:
+        print(f"auto: unreadable {path} ({e}); keeping explicit flags",
+              file=sys.stderr)
+        return False
+    from tpu_aggcomm.obs.ledger import manifest
+    env = manifest().get("env") or {}
+    platform = "tpu" if env.get("tunnel_armed") \
+        and env.get("jax_platforms") != "cpu" else "cpu"
+    block = (art.get("platforms") or {}).get(platform)
+    params = (block or {}).get("params") if isinstance(block, dict) \
+        else None
+    if not params:
+        print(f"auto: {path} has no calibrated {platform!r} "
+              f"parameters; keeping explicit flags", file=sys.stderr)
+        return False
+    from tpu_aggcomm.core.methods import METHODS, compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+    from tpu_aggcomm.faults.repair import repair_schedule
+    from tpu_aggcomm.model.predict import predict_schedule
+    direction = METHODS[args.method].direction
+    pattern = AggregatorPattern(
+        nprocs=nprocs, cb_nodes=args.cb_nodes,
+        data_size=args.data_size, placement=args.agg_type,
+        proc_node=args.proc_node, comm_size=args.comm_size)
+    best = None
+    for m, info in sorted(METHODS.items()):
+        if info.direction is not direction or info.tam:
+            continue
+        try:
+            sched = compile_method(m, pattern,
+                                   barrier_type=args.barrier_type)
+            repaired = repair_schedule(sched, spec,
+                                       barrier_type=args.barrier_type)
+            cost = predict_schedule(repaired, params,
+                                    fault=spec)["total_s"]
+        except Exception:  # lint: broad-ok (unrepairable/unpriceable candidates are skipped — the model advises, never strands)
+            continue
+        if best is None or cost < best[1]:
+            best = (m, cost)
+    if best is None:
+        print(f"auto: model could not price any repaired "
+              f"{direction.value} candidate under --fault "
+              f"{spec.canonical()}; keeping explicit flags",
+              file=sys.stderr)
+        return False
+    print(f"auto: {note}; under --fault {spec.canonical()} the model "
+          f"({path}, {platform}) ranks repaired -m {best[0]} best "
+          f"(predicted {best[1]:.6f} s/rep) — ADVISORY pick; measured "
+          f"rounds stay the source of truth", file=sys.stderr)
+    args.method = int(best[0])
+    return True
 
 
 def _fused_export_sweep(args) -> int:
@@ -1774,6 +1895,33 @@ def _run_analyze(args) -> int:
     return 0
 
 
+def _run_serve(args) -> int:
+    """``serve``: run the persistent aggregation server until a client
+    sends a shutdown op (or SIGINT). Prints exactly ONE ready JSON line
+    on stdout — the machine-readable attach point (port, pid, backend)
+    the load generator parses; everything else goes to stderr."""
+    import json as _json
+
+    from tpu_aggcomm.serve import ScheduleServer
+
+    srv = ScheduleServer(
+        backend=args.backend, port=args.port, max_batch=args.max_batch,
+        batch_window_s=args.batch_window_ms / 1e3,
+        journal_path=args.journal, metrics_port=args.metrics_port)
+    print(_json.dumps(srv.ready_info()), flush=True)
+    try:
+        with _tracing(args.trace):
+            srv.serve_forever()
+    except KeyboardInterrupt:
+        srv.stop()
+        srv.close()
+    st = srv.stats()
+    print(f"serve: stopped after {st['completed']} completed / "
+          f"{st['errors']} error(s); cache {st['cache']}; "
+          f"batch {st['batch']}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -1792,6 +1940,8 @@ def main(argv=None) -> int:
         return _run_analyze(args)
     if args.command == "tune":
         return _run_tune(args)
+    if args.command == "serve":
+        return _run_serve(args)
 
     from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
     nprocs = args.nprocs if args.nprocs is not None \

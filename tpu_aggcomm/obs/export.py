@@ -319,8 +319,11 @@ def serve_from_env(source, env=None, *,
 
     ``port`` (a CLI flag) wins; otherwise ``TPU_AGGCOMM_METRICS_PORT``
     in ``env`` (default ``os.environ``). Absent/empty/garbage = None —
-    no socket, no thread, nothing. Port 0 binds an ephemeral port
-    (read it back from ``.port``/``.url``)."""
+    no socket, no thread, nothing. Port 0 binds an ephemeral port:
+    the actual bound port is announced on stderr and recorded in the
+    ledger (the PORT NUMBER only — same by-name discipline as
+    env_summary) so ``inspect live`` and the serve load generator can
+    find the endpoint after the fact."""
     if port is None:
         import os
         raw = (env if env is not None else os.environ).get(
@@ -334,4 +337,14 @@ def serve_from_env(source, env=None, *,
             print(f"# telemetry: ignoring non-integer "
                   f"{METRICS_PORT_ENV}={raw!r}", file=sys.stderr)
             return None
-    return MetricsServer(source, port=port)
+    srv = MetricsServer(source, port=port)
+    if port == 0:
+        import sys
+
+        from tpu_aggcomm.obs import ledger
+        print(f"# telemetry: /metrics bound on ephemeral port "
+              f"{srv.port} ({srv.url})", file=sys.stderr)
+        # kind != "attempt", so replay_attempts ignores this record
+        ledger.record_resilience("metrics.endpoint", kind="bind",
+                                 port=srv.port)
+    return srv

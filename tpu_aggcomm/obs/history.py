@@ -124,6 +124,38 @@ def bench_series(root: str = ".", *,
     return series
 
 
+def serve_series(root: str = ".", *,
+                 errors: list[str] | None = None
+                 ) -> dict[str, list[dict]]:
+    """Per-backend serving time series from the committed
+    ``SERVE_r*.json`` history (scripts/serve_loadgen.py): the headline
+    is warm-cache p50 request latency (the compiled-chain cache's whole
+    point), falling back to the overall p50 when a round recorded no
+    warm hits. Keyed ``"serve warm p50 | <backend>"`` so the trend gate
+    treats each backend as its own series, exactly like the bench
+    metric/platform split."""
+    series: dict[str, list[dict]] = {}
+    for rnd, path, blob in load_history(root, "SERVE", errors=errors):
+        warm = blob.get("warm") if isinstance(blob.get("warm"), dict) \
+            else {}
+        lat = blob.get("latency_s") if isinstance(
+            blob.get("latency_s"), dict) else {}
+        value = warm.get("p50")
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            value = lat.get("p50")
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        key = f"serve warm p50 | {blob.get('backend', 'unknown')}"
+        s = blob.get("samples")
+        series.setdefault(key, []).append({
+            "round": rnd, "value": float(value), "unit": "s",
+            "samples_n": len(s) if isinstance(s, list) else 0,
+            "compile_seconds": None, "hbm_peak_bytes": None,
+            "rps": blob.get("rps"),
+            "file": os.path.basename(path)})
+    return series
+
+
 def _tail_jsonl(path: str) -> list[dict]:
     """Torn-line-tolerant JSONL read (a live trace may be mid-append)."""
     out: list[dict] = []
@@ -207,8 +239,8 @@ def build_index(root: str = ".") -> dict:
                         "bound": conf.get("bound")})
     return {"schema": HISTORY_SCHEMA, "root": os.path.abspath(root),
             "bench": bench, "multichip": multichip, "tune": tune,
-            "traffic": traffic, "traces": _trace_rows(root),
-            "errors": errors}
+            "traffic": traffic, "serve": serve_series(root, errors=errors),
+            "traces": _trace_rows(root), "errors": errors}
 
 
 def write_index(path: str, index: dict) -> str:
@@ -315,10 +347,14 @@ def trend_gate(points, *, tolerance: float = TREND_TOLERANCE,
 def check_trends(root: str = ".", *, tolerance: float = TREND_TOLERANCE,
                  seed: int = 0) -> dict:
     """The trend gate over every per-(metric, platform) bench series
-    under ``root``. ``ok`` is False only on a confirmed ``drifting-up``
-    verdict — improvement and insufficient history are not failures."""
+    AND every per-backend serve series under ``root``. ``ok`` is False
+    only on a confirmed ``drifting-up`` verdict — improvement and
+    insufficient history are not failures. (Key formats cannot collide:
+    bench keys are ``"<metric> | <platform>"``, serve keys
+    ``"serve warm p50 | <backend>"``.)"""
     errors: list[str] = []
-    series = bench_series(root, errors=errors)
+    series = dict(bench_series(root, errors=errors))
+    series.update(serve_series(root, errors=errors))
     gates = {key: trend_gate([(r["round"], r["value"]) for r in rows],
                              tolerance=tolerance, seed=seed)
              for key, rows in sorted(series.items())}
@@ -368,6 +404,31 @@ def render_history(root: str = ".") -> str:
             lines.append(f"  note: {gate['note']}")
     if not index["bench"]:
         lines.append("no measurable bench history")
+    for key, rows in sorted(index["serve"].items()):
+        gate = trends["series"].get(key, {})
+        lines.append(f"== {key} ({len(rows)} measurable rounds) ==")
+        for r in rows:
+            extras = []
+            if r["samples_n"]:
+                extras.append(f"{r['samples_n']} samples")
+            if isinstance(r.get("rps"), (int, float)):
+                extras.append(f"{r['rps']:.3g} req/s")
+            ex = f"  [{', '.join(extras)}]" if extras else ""
+            lines.append(f"  r{r['round']:02d}: "
+                         f"{_fmt_val(r['value'], r['unit'])}{ex}")
+        detail = []
+        if gate.get("slope_pct_per_round") is not None:
+            detail.append(f"slope {gate['slope_pct_per_round']:+.1f}%"
+                          f"/round")
+        if gate.get("ci_pct_per_round") is not None:
+            ci = gate["ci_pct_per_round"]
+            detail.append(f"95% CI [{ci[0]:+.1f}%, {ci[1]:+.1f}%]")
+        detail.append(f"tolerance {gate.get('tolerance_pct', 0):.0f}%"
+                      f"/round (seed {gate.get('seed')})")
+        lines.append(f"  trend: {gate.get('verdict', '?').upper()} — "
+                     + ", ".join(detail))
+        if gate.get("note"):
+            lines.append(f"  note: {gate['note']}")
     mc = index["multichip"]
     if mc:
         ok = sum(1 for m in mc if m.get("ok"))

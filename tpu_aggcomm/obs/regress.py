@@ -72,7 +72,8 @@ __all__ = ["validate_bench", "validate_multichip", "validate_tune",
            "parsed_schema_version", "DEFAULT_TOLERANCE",
            "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE", "TUNE_SCHEMAS",
            "TRAFFIC_SCHEMAS", "PREDICT_SCHEMAS", "COMPARE_SCHEMAS",
-           "validate_predict", "validate_compare"]
+           "SERVE_SCHEMAS", "validate_predict", "validate_compare",
+           "validate_serve"]
 
 #: Relative slowdown vs the best prior same-platform round that counts as
 #: a regression. Differenced-chain numbers jitter a few percent
@@ -1039,4 +1040,137 @@ def validate_compare(obj, where: str = "COMPARE") -> list[str]:
                               f"dropped)")
     else:
         _check_runs(res.get("runs"), f"{where}.result")
+    return errors
+
+
+SERVE_SCHEMAS = ("serve-v1",)
+
+
+def validate_serve(obj, where: str = "SERVE") -> list[str]:
+    """Schema errors (empty list = valid) for one ``SERVE_r*.json``
+    load-generator artifact (scripts/serve_loadgen.py). Beyond shape,
+    this checks the artifact against ITSELF, the validate_traffic /
+    validate_predict discipline: every latency quantile must be
+    ``obs.metrics.percentile`` over the recorded samples float-exactly,
+    the warm/cold split must partition the completed samples, and the
+    request accounting must add up — a summary its own samples
+    contradict is schema-invalid."""
+    from tpu_aggcomm.obs.metrics import percentile
+
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    schema = obj.get("schema")
+    if schema not in SERVE_SCHEMAS:
+        errors.append(f"{where}: unknown schema tag {schema!r} "
+                      f"(expected one of {list(SERVE_SCHEMAS)})")
+        return errors
+    _require(obj, "created_unix", (int, float), errors, where)
+    _require(obj, "backend", str, errors, where)
+    _require(obj, "duration_s", (int, float), errors, where)
+    for k in ("requests", "completed", "errors", "verified"):
+        _require(obj, k, int, errors, where)
+        v = obj.get(k)
+        if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+            errors.append(f"{where}: {k!r} must be non-negative, "
+                          f"got {v}")
+    man = obj.get("manifest")
+    if man is not None and not isinstance(man, dict):
+        errors.append(f"{where}: 'manifest' must be an object or null")
+    shapes = obj.get("shapes")
+    if not isinstance(shapes, list) or not shapes \
+            or not all(isinstance(s, str) for s in shapes):
+        errors.append(f"{where}: 'shapes' must be a non-empty list of "
+                      f"shape-spec strings")
+
+    req, comp, errs = obj.get("requests"), obj.get("completed"), \
+        obj.get("errors")
+    if isinstance(req, int) and isinstance(comp, int) \
+            and isinstance(errs, int) and comp + errs != req:
+        errors.append(f"{where}: completed {comp} + errors {errs} != "
+                      f"requests {req} — every request must be "
+                      f"accounted for")
+    if isinstance(comp, int) and isinstance(obj.get("verified"), int) \
+            and obj["verified"] > comp:
+        errors.append(f"{where}: verified {obj['verified']} > "
+                      f"completed {comp}")
+
+    samples = obj.get("samples")
+    if not isinstance(samples, list) or not samples \
+            or not all(_is_num(s) for s in samples):
+        errors.append(f"{where}: 'samples' must be a non-empty list of "
+                      f"per-request latency seconds")
+        samples = None
+    elif isinstance(comp, int) and len(samples) != comp:
+        errors.append(f"{where}: {len(samples)} samples recorded for "
+                      f"{comp} completed requests — the evidence must "
+                      f"match the count")
+
+    lat = obj.get("latency_s")
+    if not isinstance(lat, dict):
+        errors.append(f"{where}: 'latency_s' must be an object")
+    elif samples:
+        for qk, q in (("p50", 50.0), ("p95", 95.0), ("p99", 99.0)):
+            want = percentile(samples, q)
+            got = lat.get(qk)
+            if not _is_num(got) or got != want:
+                errors.append(f"{where}.latency_s: {qk} {got!r} is not "
+                              f"percentile(samples, {q:g}) == {want!r} "
+                              f"— quantiles must be re-derivable from "
+                              f"the samples float-exactly")
+
+    split_n = 0
+    for part in ("warm", "cold"):
+        blk = obj.get(part)
+        w = f"{where}.{part}"
+        if not isinstance(blk, dict):
+            errors.append(f"{w}: must be an object")
+            continue
+        _require(blk, "n", int, errors, w)
+        psamp = blk.get("samples")
+        if not isinstance(psamp, list) or not all(
+                _is_num(s) for s in psamp):
+            errors.append(f"{w}: 'samples' must be a list of numbers")
+            continue
+        if isinstance(blk.get("n"), int) and blk["n"] != len(psamp):
+            errors.append(f"{w}: n {blk['n']} != {len(psamp)} samples")
+        split_n += len(psamp)
+        p50 = blk.get("p50")
+        if psamp:
+            want = percentile(psamp, 50.0)
+            if not _is_num(p50) or p50 != want:
+                errors.append(f"{w}: p50 {p50!r} is not "
+                              f"percentile(samples, 50) == {want!r}")
+        elif p50 is not None:
+            errors.append(f"{w}: p50 must be null with no samples, "
+                          f"got {p50!r}")
+    if samples and isinstance(obj.get("warm"), dict) \
+            and isinstance(obj.get("cold"), dict) \
+            and split_n != len(samples):
+        errors.append(f"{where}: warm+cold split carries {split_n} "
+                      f"samples for {len(samples)} completed — the "
+                      f"split must partition the samples")
+
+    dur, rps = obj.get("duration_s"), obj.get("rps")
+    if _is_num(dur) and dur <= 0:
+        errors.append(f"{where}: duration_s must be positive, "
+                      f"got {dur!r}")
+    if _is_num(dur) and dur > 0 and isinstance(comp, int):
+        want = comp / dur
+        if not _is_num(rps) or abs(rps - want) > 1e-9 * max(1.0, want):
+            errors.append(f"{where}: rps {rps!r} != completed/"
+                          f"duration_s == {want!r}")
+
+    cache = obj.get("cache")
+    if not isinstance(cache, dict):
+        errors.append(f"{where}: 'cache' must be an object")
+    else:
+        for k in ("entries", "hits", "misses", "evictions", "compiles"):
+            _require(cache, k, int, errors, f"{where}.cache")
+    batch = obj.get("batch")
+    if not isinstance(batch, dict):
+        errors.append(f"{where}: 'batch' must be an object")
+    else:
+        for k in ("batches", "max_batch", "batched_requests"):
+            _require(batch, k, int, errors, f"{where}.batch")
     return errors

@@ -1,0 +1,24 @@
+"""Aggregation-as-a-service: persistent schedule server + compiled-chain
+cache + same-shape request batching.
+
+Package layout (the purity split is the point — see
+analysis/lint.PURE_PACKAGES):
+
+- ``protocol.py`` — JSON-lines wire protocol + client, jax-free.
+- ``cache.py`` — the compiled-chain cache with manifest-drift eviction
+  (tune-cache keying), jax-free.
+- ``server.py`` — the control plane: socket accept loop, batching
+  queue, journal, metrics, retry; jax-free.
+- ``executor.py`` — THE one jax door: compile chains, vmap-batch
+  same-shape requests (declared in PURE_PACKAGES like tune/measure.py).
+"""
+
+from tpu_aggcomm.serve.cache import CompiledChainCache
+from tpu_aggcomm.serve.protocol import (PROTOCOL, ProtocolError,
+                                        ServeClient, ServeRequest,
+                                        parse_request, request_schedule)
+from tpu_aggcomm.serve.server import SERVE_BACKENDS, ScheduleServer
+
+__all__ = ["PROTOCOL", "ProtocolError", "ServeClient", "ServeRequest",
+           "parse_request", "request_schedule", "CompiledChainCache",
+           "ScheduleServer", "SERVE_BACKENDS"]

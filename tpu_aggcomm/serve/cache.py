@@ -1,0 +1,92 @@
+"""The compiled-chain cache: build-once/execute-many behind the server.
+
+Entries are keyed ``(schedule_shape_key, backend)`` — the exact key
+every compiled-program cache in this repo uses (jax_sim._cache, the
+tune cache, the resume journals); the canonical fault spec rides inside
+``schedule_shape_key`` so a repaired program can never alias its
+healthy sibling. Each entry is additionally stamped with the manifest
+fingerprint of the environment that compiled it (tune/cache.py
+``manifest_fingerprint``: no drift ⟺ same fingerprint). A lookup under
+a drifted manifest EVICTS the entry and names the divergent keys via
+``diff_manifests`` — the same reason string discipline as
+``tune.cache.lookup`` and ``RunJournal.completed`` — because a chain
+compiled for a different jax/libtpu/device must recompile, never serve
+stale.
+
+jax-free: the cache stores the executor's compiled chains as opaque
+values and never looks inside them — eviction policy must keep working
+where ``import jax`` hangs.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tpu_aggcomm.obs.ledger import diff_manifests
+
+__all__ = ["CompiledChainCache"]
+
+
+class CompiledChainCache:
+    """In-process cache of compiled chained reps, drift-evicting."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[tuple, dict] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _key(shape_key, backend: str) -> tuple:
+        return (shape_key, str(backend))
+
+    def lookup(self, shape_key, backend: str, *, fingerprint: str,
+               manifest: dict | None = None
+               ) -> tuple[dict | None, str | None]:
+        """``(entry, None)`` on a fingerprint-valid hit; ``(None,
+        reason)`` on a miss — where a drift miss EVICTS the stale entry
+        and ``reason`` names the drifted manifest keys (tune-cache
+        semantics: the caller recompiles, the log says why)."""
+        key = self._key(shape_key, backend)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                return None, (f"no cached chain for {backend}:"
+                              f"{shape_key!r} — compiling")
+            if e["fingerprint"] != fingerprint:
+                del self._entries[key]
+                self.evictions += 1
+                self.misses += 1
+                drift = diff_manifests(e.get("manifest"), manifest)
+                keys = ", ".join(d["key"] for d in drift[:4]) or \
+                    f"fingerprint {e['fingerprint']} != {fingerprint}"
+                more = f" (+{len(drift) - 4} more)" if len(drift) > 4 \
+                    else ""
+                return None, (f"manifest drift vs cached chain "
+                              f"{backend}:{shape_key!r}: {keys}{more} "
+                              f"— evicted, recompiling")
+            self.hits += 1
+            e["hits"] += 1
+            return e, None
+
+    def put(self, shape_key, backend: str, *, fingerprint: str,
+            manifest: dict | None, chain, compile_s: float) -> dict:
+        """Install a freshly compiled chain (replaces any entry the
+        drift eviction left behind)."""
+        entry = {"chain": chain, "fingerprint": str(fingerprint),
+                 "manifest": manifest, "compile_s": float(compile_s),
+                 "hits": 0}
+        with self._lock:
+            self._entries[self._key(shape_key, backend)] = entry
+        return entry
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "misses": self.misses, "evictions": self.evictions}

@@ -1,0 +1,218 @@
+"""The serve data plane: compile chained reps, batch same-shape requests.
+
+THE one jax-importing module in ``tpu_aggcomm/serve`` (declared in
+``analysis/lint.PURE_PACKAGES`` exactly like ``tune/measure.py``): the
+control plane (protocol/cache/server) must keep running where a wedged
+tunnel hangs ``import jax``, so everything device-shaped funnels
+through here, lazily.
+
+Batching: same-shape requests are stacked onto a NEW LEADING request
+axis of the jax_sim program — ``jax.vmap`` over :meth:`one_rep`, so
+every throttle round keeps its ``lax.optimization_barrier`` fence (or
+its scan-carry step) per batch element exactly as in the sequential
+program; vmap adds an axis, it never re-schedules rounds — fusing
+rounds away would invalidate the ``-c`` semantics the whole benchmark
+studies, and the batched-vs-sequential byte-exactness pin in
+tests/test_serve.py holds the line. Batches are padded to the next
+power of two (replicating the tail request's payload) so the jit cache
+holds at most ``log2(max_batch)+1`` batched programs instead of one
+per observed batch size; padded lanes are sliced off before any result
+leaves this module.
+
+``pallas_fused`` chains are cached for compile amortization but always
+execute per-request: the fused kernel's in-kernel DMA semaphores are
+the round fence, and a vmap over remote-DMA pallas_calls is not a
+lowering this repo has validated — refusing to batch is the
+jax_shard/staged-schedule discipline, not a silent fallback.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["CompiledChain", "build_chain", "execute_batch",
+           "recv_bytes", "batched_recv_bytes"]
+
+#: Backends the server may compile chains for. jax_shard needs the
+#: multichip driver harness (__graft_entry__) and refuses staged
+#: schedules; it joins here the day the driver grows a serve entry.
+CHAIN_BACKENDS = ("jax_sim", "pallas_fused")
+
+
+class CompiledChain:
+    """One cached compiled rep family for a (schedule, backend)."""
+
+    def __init__(self, schedule, backend, backend_name: str, single,
+                 batched):
+        self.schedule = schedule
+        self.backend = backend
+        self.backend_name = backend_name
+        self.single = single          # jitted rep(send) -> recv
+        self.batched = batched        # jitted vmap(rep), or None
+        self.shape_key = backend._key(schedule)
+
+
+def _pad_to(n: int) -> int:
+    """Smallest power of two >= n (bounds the batched jit cache)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _ensure_barrier_batching_rule() -> None:
+    """Teach ``jax.vmap`` about ``lax.optimization_barrier``.
+
+    jax (0.4.x) ships no batching rule for the barrier primitive, so a
+    vmap over the fenced rep refuses outright. The rule is semantically
+    forced: the barrier is the identity on values — batching binds the
+    SAME primitive on the batched operands and passes the batch dims
+    through untouched. Crucially this keeps every round fence in the
+    batched program (one barrier per round over the whole request
+    slab): vmap adds an axis, the rounds stay distinct program steps —
+    the ``-c`` semantics survive batching by construction, pinned by
+    the batched-vs-sequential byte-exactness tests."""
+    from jax._src.lax import lax as _lax_internal
+    from jax.interpreters import batching
+
+    prim = getattr(_lax_internal, "optimization_barrier_p", None)
+    if prim is None or prim in batching.primitive_batchers:
+        return
+
+    def _barrier_batcher(args, dims):
+        return prim.bind(*args), dims
+
+    batching.primitive_batchers[prim] = _barrier_batcher
+
+
+def build_chain(schedule, backend_name: str) -> tuple[CompiledChain, float]:
+    """Compile the chain for ``schedule`` on ``backend_name``.
+
+    Returns ``(chain, compile_seconds)`` where the seconds are an
+    honest host wall around jit + first dispatch (the ledger
+    "compile+warmup" discipline — never ``.lower().compile()``, which
+    would not share the jit cache through the tunnel)."""
+    import jax
+
+    if backend_name not in CHAIN_BACKENDS:
+        raise ValueError(f"serve: unknown chain backend "
+                         f"{backend_name!r}; valid: {CHAIN_BACKENDS}")
+    t0 = time.perf_counter()
+    if backend_name == "pallas_fused":
+        from tpu_aggcomm.backends.pallas_fused import PallasFusedBackend
+        backend = PallasFusedBackend()
+        rep = backend.one_rep(schedule)   # named refusal if unfusable
+        single = jax.jit(rep)
+        batched = None
+    else:
+        from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+        backend = JaxSimBackend()
+        rep = backend.one_rep(schedule)
+        single = jax.jit(rep)
+        _ensure_barrier_batching_rule()
+        batched = jax.jit(jax.vmap(rep))
+    # warm the single-rep program now: the cold request pays compile
+    # exactly once, every warm hit is dispatch-only
+    p = schedule.pattern
+    send0 = jax.device_put(backend._global_send(p, 0), backend._dev())
+    single(send0).block_until_ready()
+    chain = CompiledChain(schedule, backend, backend_name, single, batched)
+    return chain, time.perf_counter() - t0
+
+
+def execute_batch(chain: CompiledChain, requests) -> list[dict]:
+    """Run one same-shape batch; one result dict per request, in order.
+
+    Each result: ``{"verified": bool | None, "error": str | None}`` —
+    recv buffers are verified in-process against the deterministic-fill
+    oracle (harness/verify.py) and never shipped over the wire (a
+    batched 16 MB slab is a benchmark payload, not a response body).
+    ``verified`` is None when the request did not ask for --verify.
+    """
+    import jax
+
+    schedule = chain.schedule
+    backend = chain.backend
+    p = schedule.pattern
+    dev = backend._dev()
+    n_req = len(requests)
+    sends = np.stack([backend._global_send(p, r.iter_)
+                      for r in requests])
+    if chain.batched is not None and n_req > 1:
+        padded = _pad_to(n_req)
+        if padded > n_req:
+            pad = np.broadcast_to(sends[-1], (padded - n_req,)
+                                  + sends.shape[1:])
+            sends = np.concatenate([sends, pad], axis=0)
+        out = chain.batched(jax.device_put(sends, dev))
+        out.block_until_ready()
+        recv_all = np.asarray(jax.device_get(out))[:n_req]
+    else:
+        outs = []
+        for i in range(n_req):
+            o = chain.single(jax.device_put(sends[i], dev))
+            o.block_until_ready()
+            outs.append(np.asarray(jax.device_get(o)))
+        recv_all = np.stack(outs)
+
+    _, n_recv_slots = backend._slots(p)
+    results = []
+    for i, req in enumerate(requests):
+        res = {"verified": None, "error": None}
+        if req.verify:
+            from tpu_aggcomm.harness.verify import (VerificationError,
+                                                    verify_recv)
+            recv_np = backend._to_bytes(p, recv_all[i][:, :n_recv_slots, :])
+            recv_bufs = backend._split_recv(p, recv_np)
+            try:
+                verify_recv(p, recv_bufs, req.iter_)
+                res["verified"] = True
+            except VerificationError as e:
+                res["verified"] = False
+                res["error"] = f"verify failed: {e}"
+        results.append(res)
+    return results
+
+
+def recv_bytes(chain: CompiledChain, iter_: int) -> list:
+    """One sequential rep's recv buffers in byte layout (test hook: the
+    batched-vs-sequential byte-exactness pin compares these against the
+    batched path slice-for-slice)."""
+    import jax
+
+    backend = chain.backend
+    p = chain.schedule.pattern
+    send = jax.device_put(backend._global_send(p, iter_), backend._dev())
+    out = chain.single(send)
+    out.block_until_ready()
+    _, n_recv_slots = backend._slots(p)
+    recv = np.asarray(jax.device_get(out))[:, :n_recv_slots, :]
+    return backend._split_recv(p, backend._to_bytes(p, recv))
+
+
+def batched_recv_bytes(chain: CompiledChain, iters) -> list[list]:
+    """The batched path's recv buffers, one byte-layout list per
+    request (same test hook; must equal :func:`recv_bytes` per iter)."""
+    import jax
+
+    if chain.batched is None:
+        raise ValueError(f"serve: backend {chain.backend_name!r} does "
+                         f"not batch (pallas_fused executes per-request)")
+    backend = chain.backend
+    p = chain.schedule.pattern
+    n_req = len(iters)
+    sends = np.stack([backend._global_send(p, it) for it in iters])
+    padded = _pad_to(n_req)
+    if padded > n_req:
+        pad = np.broadcast_to(sends[-1], (padded - n_req,)
+                              + sends.shape[1:])
+        sends = np.concatenate([sends, pad], axis=0)
+    out = chain.batched(jax.device_put(sends, backend._dev()))
+    out.block_until_ready()
+    recv_all = np.asarray(jax.device_get(out))[:n_req]
+    _, n_recv_slots = backend._slots(p)
+    return [backend._split_recv(
+                p, backend._to_bytes(p, recv_all[i][:, :n_recv_slots, :]))
+            for i in range(n_req)]

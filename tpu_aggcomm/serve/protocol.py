@@ -1,0 +1,210 @@
+"""The serve wire protocol: JSON lines over a loopback TCP socket.
+
+One request per line, one response per line, both plain JSON objects —
+the same torn-line-tolerant framing every journal in this repo uses, so
+a client killed mid-send costs the server one unparsable line, never a
+wedged connection state machine. Three ops:
+
+- ``{"op": "run", ...pattern fields...}`` — execute one rep of the
+  requested (method, shape, fault, backend) and answer with the request
+  latency, the cache disposition (hit/miss/evict) and the ``--verify``
+  verdict when asked for.
+- ``{"op": "stats"}`` — the server's counters (cache, batching, queue
+  depth, latency quantiles) as one JSON object.
+- ``{"op": "shutdown"}`` — drain and stop.
+
+Everything in this module is jax-free (stdlib + core + faults): the
+client side and the request -> Schedule compilation run precisely where
+a wedged axon tunnel hangs ``import jax`` — an operator must be able to
+ask a sick server for ``stats`` from a fresh process.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+from dataclasses import dataclass, field
+
+__all__ = ["PROTOCOL", "ProtocolError", "ServeRequest", "parse_request",
+           "request_schedule", "read_msg", "send_msg", "ServeClient"]
+
+#: Wire-protocol tag answered by the server's ready line and ``stats``.
+PROTOCOL = "serve-proto-v1"
+
+
+class ProtocolError(ValueError):
+    """A malformed request/response — named field, never a traceback."""
+
+
+#: ``run`` fields -> (required, default). Mirrors the CLI bench flags
+#: (cli.py build_parser) so a request is a one-shot invocation minus the
+#: process cold start.
+_FIELDS = {
+    "method": (True, None),
+    "nprocs": (True, None),
+    "cb_nodes": (True, None),
+    "comm_size": (True, None),
+    "data_size": (False, 2048),
+    "proc_node": (False, 1),
+    "agg_type": (False, 0),
+    "barrier_type": (False, 0),
+    "iter": (False, 0),
+}
+
+
+@dataclass(frozen=True)
+class ServeRequest:
+    """One validated ``run`` request."""
+
+    method: int
+    nprocs: int
+    cb_nodes: int
+    comm_size: int
+    data_size: int = 2048
+    proc_node: int = 1
+    agg_type: int = 0
+    barrier_type: int = 0
+    iter_: int = 0
+    verify: bool = False
+    fault: str | None = None
+    backend: str | None = None      # None = the server's default backend
+
+    #: Shape identity for batching/caching — everything that changes the
+    #: compiled program. ``iter_`` and ``verify`` deliberately excluded:
+    #: same program, different payload fill / post-processing.
+    shape_fields: tuple = field(default=("method", "nprocs", "cb_nodes",
+                                         "comm_size", "data_size",
+                                         "proc_node", "agg_type",
+                                         "barrier_type", "fault"),
+                                init=False, repr=False, compare=False)
+
+
+def parse_request(obj) -> ServeRequest:
+    """Validate one ``run`` request dict into a :class:`ServeRequest`."""
+    if not isinstance(obj, dict):
+        raise ProtocolError("request must be a JSON object")
+    vals = {}
+    for name, (required, default) in _FIELDS.items():
+        v = obj.get(name, default)
+        if v is None:
+            if required:
+                raise ProtocolError(f"run request missing required "
+                                    f"field {name!r}")
+            continue
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise ProtocolError(f"run request field {name!r} must be an "
+                                f"integer, got {v!r}")
+        vals["iter_" if name == "iter" else name] = int(v)
+    fault = obj.get("fault")
+    if fault is not None and not isinstance(fault, str):
+        raise ProtocolError(f"run request field 'fault' must be a spec "
+                            f"string, got {fault!r}")
+    backend = obj.get("backend")
+    if backend is not None and not isinstance(backend, str):
+        raise ProtocolError(f"run request field 'backend' must be a "
+                            f"string, got {backend!r}")
+    verify = obj.get("verify", False)
+    if not isinstance(verify, bool):
+        raise ProtocolError(f"run request field 'verify' must be a "
+                            f"bool, got {verify!r}")
+    return ServeRequest(verify=verify, fault=fault or None,
+                        backend=backend, **vals)
+
+
+def request_schedule(req: ServeRequest):
+    """Compile (and, under a fault spec, repair) the requested schedule.
+
+    jax-free — core/methods + faults/repair only, the same build path
+    ``harness/runner.py`` takes, so the server's compiled-chain cache is
+    keyed by exactly the ``schedule_shape_key`` every other cache uses.
+    Raises FaultSpecError/RepairError/ValueError with the runner's named
+    messages; the server turns those into ``{"ok": false}`` responses.
+    """
+    from tpu_aggcomm.core.methods import METHODS, compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+
+    if req.method not in METHODS:
+        raise ProtocolError(f"unknown method id {req.method}; valid ids: "
+                            f"{sorted(METHODS)}")
+    pattern = AggregatorPattern(
+        nprocs=req.nprocs, cb_nodes=req.cb_nodes, data_size=req.data_size,
+        placement=req.agg_type, proc_node=req.proc_node,
+        comm_size=req.comm_size)
+    schedule = compile_method(req.method, pattern,
+                              barrier_type=req.barrier_type)
+    if req.fault:
+        from tpu_aggcomm.faults import parse_fault, repair_schedule
+        fspec = parse_fault(req.fault)
+        if not fspec.empty:
+            schedule = repair_schedule(schedule, fspec,
+                                       barrier_type=req.barrier_type)
+    return schedule
+
+
+# ---------------------------------------------------------------------------
+# Framing.
+
+def send_msg(fh, obj: dict) -> None:
+    """One JSON object, one line, flushed — the journal discipline."""
+    fh.write(json.dumps(obj) + "\n")
+    fh.flush()
+
+
+def read_msg(fh) -> dict | None:
+    """The next parsable JSON object line, or None at EOF. Unparsable
+    lines are skipped (torn-line tolerance, resilience/journal.py)."""
+    for line in fh:
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            return rec
+    return None
+
+
+class ServeClient:
+    """A blocking client for one server connection (jax-free).
+
+    Usage::
+
+        with ServeClient(port) as c:
+            r = c.run(method=3, nprocs=32, cb_nodes=8, comm_size=4,
+                      verify=True)
+            assert r["ok"] and r["verified"]
+    """
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 timeout: float | None = 300.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._fh = self._sock.makefile("rw", encoding="utf-8")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _roundtrip(self, obj: dict) -> dict:
+        send_msg(self._fh, obj)
+        resp = read_msg(self._fh)
+        if resp is None:
+            raise ProtocolError("server closed the connection without "
+                                "a response")
+        return resp
+
+    def run(self, **fields) -> dict:
+        return self._roundtrip(dict(fields, op="run"))
+
+    def stats(self) -> dict:
+        return self._roundtrip({"op": "stats"})
+
+    def shutdown(self) -> dict:
+        return self._roundtrip({"op": "shutdown"})
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        finally:
+            self._sock.close()

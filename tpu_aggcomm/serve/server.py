@@ -1,0 +1,453 @@
+"""Aggregation-as-a-service: the persistent schedule server.
+
+A long-lived process that admits pattern requests over a loopback
+socket (serve/protocol.py), compiles each distinct schedule ONCE into a
+cached chained rep (serve/cache.py + serve/executor.py) and batches
+same-shape requests onto a new leading request axis — build-once/
+execute-many, the persistent-communication optimization the one-shot
+CLI cannot express (each invocation repays schedule build + jit +
+tunnel warmup before its first rep).
+
+Division of labor, enforced by the purity contract
+(analysis/lint.PURE_PACKAGES + the poisoned-jax pin in
+tests/test_serve.py): THIS module is control plane — sockets, queueing,
+batch formation, cache policy, journal, metrics, retry — and never
+imports jax; ``serve/executor.py`` is the one jax door. An operator
+must be able to query ``stats`` on (and cleanly stop) a server whose
+tunnel has wedged so badly that ``import jax`` hangs in fresh
+processes.
+
+Wired substrate, not regrown:
+
+- **Cache keying** — ``schedule_shape_key`` + backend + manifest
+  fingerprint (tune-cache lens); drift ⟹ named eviction + recompile.
+- **Resilience** — every compile/dispatch goes through
+  ``resilience.retry_call`` (unique site per batch), so tunnel-class
+  transients retry with the seeded backoff, every attempt lands in
+  trace + ledger, and ``replay_attempts`` reproduces the timeline.
+- **Journal** — per-request accounting through ``RunJournal`` (append
+  + fsync, torn-line-tolerant readers): a killed server loses at most
+  the record being written.
+- **Metrics** — the opt-in obs/export ``/metrics`` endpoint (OFF by
+  default; the import itself is gated) serves queue depth and request
+  latency histograms whose ``_exact`` summary quantiles use the same
+  ``obs.metrics.percentile`` arithmetic as every other exposition.
+
+The listener binds 127.0.0.1 ONLY — serving is for the operator's
+machine, not the network (the obs/export discipline); a non-loopback
+host refuses by name.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from tpu_aggcomm.faults import FaultSpecError, RepairError
+from tpu_aggcomm.obs import ledger, trace
+from tpu_aggcomm.obs.metrics import percentile
+from tpu_aggcomm.resilience.journal import RunJournal
+from tpu_aggcomm.resilience.policy import RetryPolicy, retry_call
+from tpu_aggcomm.serve.cache import CompiledChainCache
+from tpu_aggcomm.serve.protocol import (PROTOCOL, ProtocolError,
+                                        parse_request, read_msg,
+                                        request_schedule, send_msg)
+
+__all__ = ["ScheduleServer", "SERVE_BACKENDS"]
+
+#: Backends the server compiles chains for (mirrors
+#: serve/executor.CHAIN_BACKENDS without importing the jax module).
+SERVE_BACKENDS = ("jax_sim", "pallas_fused")
+
+_LOOPBACK = ("127.0.0.1", "localhost")
+
+
+class _Pending:
+    """One enqueued request awaiting its batch."""
+
+    __slots__ = ("req", "rid", "schedule", "shape_key", "backend_name",
+                 "t0", "event", "response")
+
+    def __init__(self, req, rid, schedule, shape_key, backend_name):
+        self.req = req
+        self.rid = rid
+        self.schedule = schedule
+        self.shape_key = shape_key
+        self.backend_name = backend_name
+        self.t0 = time.monotonic()
+        self.event = threading.Event()
+        self.response: dict = {}
+
+
+class ScheduleServer:
+    """The persistent aggregation server. Construct, then
+    :meth:`serve_forever` (blocking) or :meth:`start` (thread)."""
+
+    def __init__(self, *, backend: str = "jax_sim",
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_batch: int = 8, batch_window_s: float = 0.005,
+                 journal_path: str | None = None,
+                 metrics_port: int | None = None,
+                 retry_policy: RetryPolicy | None = None):
+        import socket
+
+        if host not in _LOOPBACK:
+            raise ValueError(
+                f"serve: refusing to bind {host!r} — the server binds "
+                f"127.0.0.1 only (loopback telemetry discipline, "
+                f"obs/export.py); tunnel remote clients through ssh")
+        if backend not in SERVE_BACKENDS:
+            raise ValueError(f"serve: unknown backend {backend!r}; "
+                             f"valid: {SERVE_BACKENDS}")
+        self._backend = backend
+        self._max_batch = max(1, int(max_batch))
+        self._batch_window_s = max(0.0, float(batch_window_s))
+        self._retry_policy = retry_policy
+
+        self._listener = socket.create_server((host, port))
+        self._listener.settimeout(0.2)
+        self.host = host
+        self.port = self._listener.getsockname()[1]
+
+        self._cv = threading.Condition()
+        self._queue: deque[_Pending] = deque()
+        self._stop = False
+        self._schedules: dict[tuple, tuple] = {}   # shape sig -> (sched, key)
+        self._cache = CompiledChainCache()
+        self._man = ledger.manifest()
+        from tpu_aggcomm.tune.cache import manifest_fingerprint
+        self._fp = manifest_fingerprint(self._man)
+
+        self._journal = RunJournal(journal_path) if journal_path else None
+        if self._journal is not None:
+            self._journal.begin_session(self._man)
+
+        # counters (all under _cv's lock for mutation)
+        self._rid = 0
+        self._batch_seq = 0
+        self._n_completed = 0
+        self._n_errors = 0
+        self._n_compiles = 0
+        self._n_batches = 0
+        self._n_batched_requests = 0
+        self._max_batch_seen = 0
+        self._warm_s: list[float] = []
+        self._cold_s: list[float] = []
+
+        # OFF by default; the /metrics import itself is the gate (the
+        # zero-cost obs invariant) — armed, the hot path pays one
+        # is-not-None check per request
+        self._registry = None
+        self._metrics = None
+        env_armed = os.environ.get("TPU_AGGCOMM_METRICS_PORT", "").strip()
+        if metrics_port is not None or env_armed:
+            from tpu_aggcomm.obs.export import MetricsRegistry, serve_from_env
+            registry = MetricsRegistry()
+            self._metrics = serve_from_env(registry.render,
+                                           port=metrics_port)
+            if self._metrics is not None:
+                self._registry = registry
+
+        self._exec_thread = threading.Thread(
+            target=self._executor_loop, name="tpu-aggcomm-serve-exec",
+            daemon=True)
+        self._accept_thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def ready_info(self) -> dict:
+        info = {"serve": "ready", "protocol": PROTOCOL,
+                "host": self.host, "port": self.port,
+                "backend": self._backend, "pid": os.getpid(),
+                "max_batch": self._max_batch}
+        if self._metrics is not None:
+            info["metrics_url"] = self._metrics.url
+        return info
+
+    def serve_forever(self) -> None:
+        """Accept loop; returns after :meth:`stop` (or a shutdown op)
+        once the queue has drained."""
+        import socket
+
+        self._exec_thread.start()
+        while not self._stop:
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             daemon=True).start()
+        self._exec_thread.join(timeout=60.0)
+        self.close()
+
+    def start(self) -> threading.Thread:
+        """Run :meth:`serve_forever` on a daemon thread (tests)."""
+        self._accept_thread = threading.Thread(
+            target=self.serve_forever, name="tpu-aggcomm-serve-accept",
+            daemon=True)
+        self._accept_thread.start()
+        return self._accept_thread
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        if self._metrics is not None:
+            self._metrics.close()
+            self._metrics = None
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=timeout)
+
+    # -- request intake ----------------------------------------------------
+    def _schedule_for(self, req, backend_name: str):
+        """(schedule, shape_key) for a request — compiled and (under a
+        fault spec) repaired once per distinct shape, jax-free."""
+        sig = tuple(getattr(req, f if f != "fault" else "fault")
+                    for f in req.shape_fields) + (backend_name,)
+        with self._cv:
+            hit = self._schedules.get(sig)
+        if hit is not None:
+            return hit
+        schedule = request_schedule(req)
+        from tpu_aggcomm.core.schedule import schedule_shape_key
+        shape_key = schedule_shape_key(schedule)
+        with self._cv:
+            self._schedules[sig] = (schedule, shape_key)
+        return schedule, shape_key
+
+    def _handle_conn(self, conn) -> None:
+        with conn:
+            fh = conn.makefile("rw", encoding="utf-8")
+            with fh:
+                while True:
+                    msg = read_msg(fh)
+                    if msg is None:
+                        return
+                    op = msg.get("op")
+                    if op == "run":
+                        self._handle_run(fh, msg)
+                    elif op == "stats":
+                        send_msg(fh, self.stats())
+                    elif op == "shutdown":
+                        send_msg(fh, {"ok": True, "stopping": True})
+                        self.stop()
+                        return
+                    else:
+                        send_msg(fh, {"ok": False,
+                                      "error": f"unknown op {op!r}"})
+
+    def _handle_run(self, fh, msg: dict) -> None:
+        try:
+            req = parse_request(msg)
+            backend_name = req.backend or self._backend
+            if backend_name not in SERVE_BACKENDS:
+                raise ProtocolError(
+                    f"run request backend {backend_name!r} is not "
+                    f"servable; valid: {SERVE_BACKENDS}")
+            schedule, shape_key = self._schedule_for(req, backend_name)
+        except (ProtocolError, FaultSpecError, RepairError,
+                ValueError) as e:
+            with self._cv:
+                self._n_errors += 1
+            send_msg(fh, {"ok": False, "error": str(e)})
+            return
+        with self._cv:
+            if self._stop:
+                send_msg(fh, {"ok": False,
+                              "error": "server is shutting down"})
+                return
+            self._rid += 1
+            pending = _Pending(req, self._rid, schedule, shape_key,
+                               backend_name)
+            self._queue.append(pending)
+            depth = len(self._queue)
+            self._cv.notify_all()
+        if self._registry is not None:
+            self._registry.gauge("tpu_aggcomm_serve_queue_depth", depth)
+        pending.event.wait()
+        send_msg(fh, pending.response)
+
+    # -- the batching executor --------------------------------------------
+    def _extract_same(self, head: _Pending, room: int) -> list[_Pending]:
+        """Pull up to ``room`` queued requests sharing head's compiled
+        program identity ((shape_key, backend) — iter/verify differ
+        freely: same program, different payload)."""
+        out: list[_Pending] = []
+        keep: deque[_Pending] = deque()
+        while self._queue and len(out) < room:
+            p = self._queue.popleft()
+            if (p.shape_key == head.shape_key
+                    and p.backend_name == head.backend_name):
+                out.append(p)
+            else:
+                keep.append(p)
+        keep.extend(self._queue)
+        self._queue.clear()
+        self._queue.extend(keep)
+        return out
+
+    def _executor_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait(0.1)
+                if not self._queue and self._stop:
+                    return
+                head = self._queue.popleft()
+            batch = [head]
+            deadline = time.monotonic() + self._batch_window_s
+            while len(batch) < self._max_batch:
+                with self._cv:
+                    batch.extend(self._extract_same(
+                        head, self._max_batch - len(batch)))
+                if len(batch) >= self._max_batch \
+                        or time.monotonic() >= deadline:
+                    break
+                time.sleep(min(0.0005,
+                               max(deadline - time.monotonic(), 0.0)))
+            if self._registry is not None:
+                with self._cv:
+                    depth = len(self._queue)
+                self._registry.gauge("tpu_aggcomm_serve_queue_depth",
+                                     depth)
+            self._run_batch(batch)
+
+    def _fail_batch(self, batch, disposition: str, err: str) -> None:
+        for p in batch:
+            self._finish(p, batch_n=len(batch), disposition=disposition,
+                         compile_s=None, verified=None, error=err)
+
+    def _run_batch(self, batch: list[_Pending]) -> None:
+        head = batch[0]
+        with self._cv:
+            self._batch_seq += 1
+            seq = self._batch_seq
+            self._n_batches += 1
+            self._max_batch_seen = max(self._max_batch_seen, len(batch))
+            if len(batch) > 1:
+                self._n_batched_requests += len(batch)
+        from tpu_aggcomm.serve import executor
+
+        entry, reason = self._cache.lookup(
+            head.shape_key, head.backend_name, fingerprint=self._fp,
+            manifest=self._man)
+        compile_s = None
+        disposition = "hit"
+        if entry is None:
+            disposition = "evict" if reason.startswith("manifest drift") \
+                else "miss"
+            print(f"serve: {reason}", file=sys.stderr)
+            try:
+                chain, compile_s = retry_call(
+                    lambda: executor.build_chain(head.schedule,
+                                                 head.backend_name),
+                    site=f"serve.compile:b{seq}",
+                    policy=self._retry_policy)
+            except Exception as e:  # lint: broad-ok (fault isolation: a compile error is the batch's response, never the server's death)
+                self._fail_batch(batch, disposition,
+                                 f"compile failed: {type(e).__name__}: {e}")
+                return
+            ledger.record_compile(
+                f"serve:{head.backend_name}:b{seq}", seconds=compile_s,
+                kind="compile+warmup", backend=head.backend_name)
+            entry = self._cache.put(
+                head.shape_key, head.backend_name, fingerprint=self._fp,
+                manifest=self._man, chain=chain, compile_s=compile_s)
+            with self._cv:
+                self._n_compiles += 1
+        chain = entry["chain"]
+        try:
+            with trace.span("serve.batch", seq=seq, n=len(batch),
+                            backend=head.backend_name,
+                            method=head.schedule.method_id):
+                results = retry_call(
+                    lambda: executor.execute_batch(
+                        chain, [p.req for p in batch]),
+                    site=f"serve.dispatch:b{seq}",
+                    policy=self._retry_policy)
+        except Exception as e:  # lint: broad-ok (fault isolation: a dispatch error is the batch's response, never the server's death)
+            self._fail_batch(batch, disposition,
+                             f"dispatch failed: {type(e).__name__}: {e}")
+            return
+        for p, res in zip(batch, results):
+            self._finish(p, batch_n=len(batch), disposition=disposition,
+                         compile_s=compile_s, verified=res["verified"],
+                         error=res["error"])
+
+    def _finish(self, p: _Pending, *, batch_n: int, disposition: str,
+                compile_s, verified, error) -> None:
+        latency = time.monotonic() - p.t0
+        ok = error is None
+        p.response = {"ok": ok, "request_id": p.rid,
+                      "verified": verified, "error": error,
+                      "latency_s": latency, "batch_n": batch_n,
+                      "cache": disposition, "compile_s": compile_s,
+                      "backend": p.backend_name,
+                      "shape_key": repr(p.shape_key)}
+        with self._cv:
+            if ok:
+                self._n_completed += 1
+                (self._warm_s if disposition == "hit"
+                 else self._cold_s).append(latency)
+            else:
+                self._n_errors += 1
+        if self._registry is not None:
+            self._registry.observe("tpu_aggcomm_serve_request_seconds",
+                                   latency, backend=p.backend_name,
+                                   cache=disposition)
+            self._registry.counter("tpu_aggcomm_serve_requests",
+                                   backend=p.backend_name,
+                                   outcome="ok" if ok else "error")
+        if self._journal is not None:
+            self._journal.record(
+                {"request": p.rid}, fingerprint=self._fp,
+                status="done" if ok else "fail",
+                shape_keys=[repr(p.shape_key)], backend=p.backend_name,
+                iter=p.req.iter_, latency_s=latency, batch_n=batch_n,
+                cache=disposition, error=error)
+        p.event.set()
+
+    # -- stats -------------------------------------------------------------
+    @staticmethod
+    def _quantiles(samples: list[float]) -> dict | None:
+        if not samples:
+            return None
+        return {"p50": percentile(samples, 50.0),
+                "p95": percentile(samples, 95.0),
+                "p99": percentile(samples, 99.0)}
+
+    def stats(self) -> dict:
+        with self._cv:
+            warm = list(self._warm_s)
+            cold = list(self._cold_s)
+            out = {"ok": True, "protocol": PROTOCOL,
+                   "backend": self._backend, "port": self.port,
+                   "fingerprint": self._fp,
+                   "queue_depth": len(self._queue),
+                   "completed": self._n_completed,
+                   "errors": self._n_errors,
+                   "cache": dict(self._cache.stats(),
+                                 compiles=self._n_compiles),
+                   "batch": {"batches": self._n_batches,
+                             "max_batch": self._max_batch_seen,
+                             "batched_requests": self._n_batched_requests}}
+        out["latency_s"] = self._quantiles(warm + cold)
+        out["warm"] = {"n": len(warm),
+                       "quantiles": self._quantiles(warm)}
+        out["cold"] = {"n": len(cold),
+                       "quantiles": self._quantiles(cold)}
+        if self._metrics is not None:
+            out["metrics_url"] = self._metrics.url
+        return out
