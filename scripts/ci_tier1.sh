@@ -115,7 +115,12 @@ python scripts/chaos_smoke.py || post_rc=1
 # result verified byte-exact, warm-cache hits skipping compilation
 # (exactly 4 compiles for 4 distinct shapes), warm p50 >= 10x below
 # cold p50, exactly ONE summary JSON line, and an emitted SERVE_*.json
-# that passes obs/regress.validate_serve (scripts/serve_smoke.py).
+# that passes obs/regress.validate_serve — PLUS the overload/drain/
+# recover legs: a 32-request burst against --max-queue 4 must answer
+# every request (ok+verified or a framed SHED[...] by name, >= 1 shed,
+# zero hangs), SIGTERM must drain rc-0 with a journal that replays
+# REPRODUCED carrying a drain record, and --recover must pre-warm the
+# cache so the first same-shape request is a HIT (scripts/serve_smoke.py).
 python scripts/serve_smoke.py || post_rc=1
 if [ "$rc" -eq 0 ]; then rc=$post_rc; fi
 exit $rc

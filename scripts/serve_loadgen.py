@@ -8,14 +8,22 @@ does not slow the offered load), then reports sustained requests/s and
 latency quantiles. Bursts are same-shape ON PURPOSE: that is the
 batching opportunity the server's leading request axis exists for.
 
+Overload mode: ``--rate R --overload`` replaces the burst/gap schedule
+with a fixed-rate arrival train (request i fires at ``t0 + i/R``) and
+tolerates named SHED responses — the report then carries goodput
+(completed/s against the offered rate), the shed rate, and the
+deadline-miss rate (``--deadline-ms`` stamps every request with a soft
+budget). Without ``--overload`` a shed response is a failure: a healthy
+in-capacity run must not shed.
+
 Prints exactly ONE summary JSON line on stdout (stderr carries detail),
-and with ``--out``/``--round`` writes the ``SERVE_r*.json`` (serve-v1)
+and with ``--out``/``--round`` writes the ``SERVE_r*.json`` (serve-v2)
 artifact via ``obs.atomic_write`` — validated by
 ``obs/regress.validate_serve``, discovered by ``obs/history``
-(``inspect history``), trend-gated like every other bench series.
-Latency quantiles in both outputs are ``obs.metrics.percentile``
-arithmetic over the recorded per-request samples, so a validator can
-re-derive them float-exactly.
+(``inspect history``), trend-gated like every other bench series (warm
+p50 AND inverse goodput). Latency quantiles in both outputs are
+``obs.metrics.percentile`` arithmetic over the recorded per-request
+samples, so a validator can re-derive them float-exactly.
 
 Usage::
 
@@ -23,8 +31,12 @@ Usage::
     python scripts/serve_loadgen.py --spawn --requests 32 --verify \
         --out SERVE_r01.json
 
-    # attach to a running server instead
-    python scripts/serve_loadgen.py --port 43210 --requests 64
+    # attach to a running server instead (fails by name if dead)
+    python scripts/serve_loadgen.py --attach 43210 --requests 64
+
+    # drive it past capacity and measure the shed behavior
+    python scripts/serve_loadgen.py --spawn --requests 64 \
+        --rate 200 --overload --deadline-ms 5000
 """
 
 from __future__ import annotations
@@ -42,7 +54,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tpu_aggcomm.obs.metrics import percentile
 from tpu_aggcomm.serve.protocol import ServeClient
 
-SERVE_SCHEMA = "serve-v1"
+SERVE_SCHEMA = "serve-v2"
 
 #: Default mixed-shape request menu (small CPU-smoke shapes; override
 #: with --shapes). Letters mirror the CLI bench flags.
@@ -89,6 +101,8 @@ def spawn_server(args) -> tuple[subprocess.Popen, int]:
            "--backend", args.backend, "--port", "0",
            "--max-batch", str(args.max_batch),
            "--batch-window-ms", str(args.batch_window_ms)]
+    if args.max_queue is not None:
+        cmd += ["--max-queue", str(args.max_queue)]
     if args.journal:
         cmd += ["--journal", args.journal]
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
@@ -106,6 +120,20 @@ def spawn_server(args) -> tuple[subprocess.Popen, int]:
     return proc, int(ready["port"])
 
 
+def probe_server(port: int, timeout: float) -> dict:
+    """One stats roundtrip before offering load — an attach against a
+    dead port must fail with a NAMED error up front, never leave every
+    loadgen thread blocking on a socket that answers nothing."""
+    try:
+        with ServeClient(port, timeout=timeout) as c:
+            return c.stats()
+    except Exception as e:  # lint: broad-ok (the probe exists to convert any connect failure into one named exit)
+        raise SystemExit(f"serve_loadgen: cannot attach to "
+                         f"127.0.0.1:{port}: {type(e).__name__}: {e} "
+                         f"(is the server running? the retry budget is "
+                         f"spent)")
+
+
 def run_load(args, port: int) -> dict:
     """Fire the open-loop schedule; returns the summary record."""
     shapes = [parse_shape(s) for s in args.shapes]
@@ -113,7 +141,12 @@ def run_load(args, port: int) -> dict:
     gap_s = args.gap_ms / 1e3
     n = args.requests
     t_start = time.monotonic()
-    arrivals = [t_start + (i // burst) * gap_s for i in range(n)]
+    if args.rate is not None:
+        # fixed-rate open-loop train: request i at t0 + i/R, shapes
+        # cycling per-burst so same-shape batches still form
+        arrivals = [t_start + i / args.rate for i in range(n)]
+    else:
+        arrivals = [t_start + (i // burst) * gap_s for i in range(n)]
     records: list[dict | None] = [None] * n
 
     def fire(i: int) -> None:
@@ -121,10 +154,13 @@ def run_load(args, port: int) -> dict:
         delay = arrivals[i] - time.monotonic()
         if delay > 0:
             time.sleep(delay)
+        fields = dict(shape, iter=i, verify=args.verify)
+        if args.deadline_ms is not None:
+            fields["deadline_ms"] = args.deadline_ms
         t0 = time.monotonic()
         try:
             with ServeClient(port, timeout=args.timeout) as c:
-                resp = c.run(**dict(shape, iter=i, verify=args.verify))
+                resp = c.run(**fields)
         except Exception as e:  # lint: broad-ok (a dead request is a record, not a loadgen crash)
             records[i] = {"ok": False, "error": f"{type(e).__name__}: {e}",
                           "latency_s": time.monotonic() - t0,
@@ -144,19 +180,39 @@ def run_load(args, port: int) -> dict:
         stats = c.stats()
 
     done = [r for r in records if r and r.get("ok")]
-    errs = [r for r in records if not (r and r.get("ok"))]
+    sheds = [r for r in records
+             if r and not r.get("ok") and r.get("shed")]
+    errs = [r for r in records
+            if not (r and (r.get("ok") or r.get("shed")))]
     warm = [r["latency_s"] for r in done if r.get("cache") == "hit"]
     cold = [r["latency_s"] for r in done if r.get("cache") != "hit"]
     samples = [r["latency_s"] for r in done]
     verified = sum(1 for r in done if r.get("verified"))
+    shed_reasons: dict[str, int] = {}
+    for r in sheds:
+        shed_reasons[r["shed"]] = shed_reasons.get(r["shed"], 0) + 1
+        print(f"serve_loadgen: shed: {r.get('error')}", file=sys.stderr)
+    deadline_missed = sum(
+        shed_reasons.get(k, 0)
+        for k in ("deadline-expired", "deadline_floor"))
+    if args.deadline_ms is not None:
+        budget_s = args.deadline_ms / 1e3
+        deadline_missed += sum(1 for r in done
+                               if r["latency_s"] > budget_s)
     for r in errs:
         print(f"serve_loadgen: request error: "
               f"{(r or {}).get('error')}", file=sys.stderr)
     return {
         "backend": args.backend, "requests": n, "completed": len(done),
-        "errors": len(errs), "verified": verified,
+        "errors": len(errs), "shed": len(sheds),
+        "shed_reasons": shed_reasons,
+        "deadline_missed": deadline_missed,
+        "deadline_ms": args.deadline_ms,
+        "verified": verified,
         "duration_s": duration,
         "rps": len(done) / duration if duration > 0 else 0.0,
+        "goodput_rps": len(done) / duration if duration > 0 else 0.0,
+        "offered_rate_rps": args.rate,
         "samples": samples, "latency_s": _quant(samples),
         "warm": {"n": len(warm), "samples": warm, "p50":
                  percentile(warm, 50.0) if warm else None},
@@ -180,8 +236,10 @@ def write_artifact(path: str, summary: dict) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     tgt = ap.add_mutually_exclusive_group()
-    tgt.add_argument("--port", type=int, default=None,
-                     help="attach to a running server on this port")
+    tgt.add_argument("--port", "--attach", dest="port", type=int,
+                     default=None, metavar="PORT",
+                     help="attach to a running server on this port "
+                          "(probed up front: a dead port fails by name)")
     tgt.add_argument("--spawn", action="store_true",
                      help="spawn 'cli serve' for the duration of the run "
                           "(default when no --port is given)")
@@ -193,6 +251,14 @@ def main(argv=None) -> int:
                          "(default 8 — the batching opportunity)")
     ap.add_argument("--gap-ms", type=float, default=30.0,
                     help="open-loop gap between bursts (default 30 ms)")
+    ap.add_argument("--rate", type=float, default=None, metavar="R",
+                    help="fixed-rate open-loop arrivals (request i at "
+                         "t0 + i/R), replacing the burst/gap schedule")
+    ap.add_argument("--overload", action="store_true",
+                    help="tolerate named SHED responses (report goodput/"
+                         "shed rate instead of failing on them)")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="stamp every request with this soft deadline")
     ap.add_argument("--shapes", nargs="+", default=list(DEFAULT_SHAPES),
                     metavar="SPEC",
                     help='request shapes, e.g. "m3 n8 a2 c4 d64" '
@@ -202,6 +268,8 @@ def main(argv=None) -> int:
                          "byte-exact against the deterministic oracle")
     ap.add_argument("--max-batch", type=int, default=8,
                     help="(spawn mode) server --max-batch")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="(spawn mode) server --max-queue admission bound")
     ap.add_argument("--batch-window-ms", type=float, default=5.0,
                     help="(spawn mode) server --batch-window-ms")
     ap.add_argument("--journal", default=None,
@@ -210,7 +278,7 @@ def main(argv=None) -> int:
                     help="per-request client timeout (default 600 s)")
     out = ap.add_mutually_exclusive_group()
     out.add_argument("--out", metavar="SERVE_rNN.json", default=None,
-                     help="write the serve-v1 artifact here")
+                     help="write the serve-v2 artifact here")
     out.add_argument("--round", type=int, default=None, metavar="NN",
                      help="write ./SERVE_rNN.json")
     args = ap.parse_args(argv)
@@ -220,6 +288,7 @@ def main(argv=None) -> int:
         proc, port = spawn_server(args)
     else:
         port = args.port
+        probe_server(port, min(args.timeout, 30.0))
     try:
         summary = run_load(args, port)
     finally:
@@ -245,8 +314,12 @@ def main(argv=None) -> int:
             if k not in ("samples",)}      # the one-line summary stays short
     line["warm"] = {"n": summary["warm"]["n"], "p50": summary["warm"]["p50"]}
     line["cold"] = {"n": summary["cold"]["n"], "p50": summary["cold"]["p50"]}
-    print(json.dumps({"serve_loadgen": "v1", **line}))
+    print(json.dumps({"serve_loadgen": "v2", **line}))
     bad = summary["errors"] > 0 or summary["completed"] == 0
+    if summary["shed"] > 0 and not args.overload:
+        # a healthy in-capacity run must not shed; overload runs shed
+        # by design and report the rate instead
+        bad = True
     if args.verify and summary["verified"] != summary["completed"]:
         bad = True
     return 1 if bad else 0

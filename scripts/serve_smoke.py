@@ -1,38 +1,46 @@
 """Serve smoke gate (ci_tier1.sh): the aggregation server must amortize
-compiles and batch correctly, CPU-only, auditable from its artifact.
+compiles, batch correctly, survive overload by NAMED shedding, drain
+cleanly on SIGTERM, and recover from its own journal — CPU-only,
+auditable from its artifacts.
 
-One subprocess drive of the real entry points (``cli serve`` spawned by
-``scripts/serve_loadgen.py``), then assertions over the ONE summary
-JSON line and the emitted ``SERVE_*.json``:
+Four legs, each driving the real entry points in subprocesses:
 
-1. **32 mixed-shape requests complete and verify byte-exact** — every
-   request carries ``--verify``, so each batched result was checked
-   in-process against the deterministic-fill oracle; any mismatch
-   fails the run.
-2. **Warm hits skip compilation** — bursts cycle 4 distinct shapes
-   twice, so exactly 4 compiles must serve all 32 requests
-   (``cache.compiles == misses == 4``, zero evictions) and the warm
-   hits must exist.
-3. **The cache is worth having** — warm p50 request latency must be at
-   least 10x below cold p50 (cold pays schedule build + jit + warmup;
-   warm is dispatch-only: the whole point of a persistent server).
-4. **Contract**: the load generator printed exactly ONE JSON line on
-   stdout, and the artifact passes ``obs/regress.validate_serve``
-   (what check_bench_schema.py enforces on committed history).
+1. **Warm/cold** (unchanged contract): 32 mixed-shape ``--verify``
+   requests through ``scripts/serve_loadgen.py --spawn`` — all complete
+   byte-exact, exactly 4 compiles serve 4 shapes, batching engages,
+   warm p50 is >= 10x below cold p50, the serve-v2 artifact passes
+   ``obs/regress.validate_serve``, exactly ONE stdout JSON line.
+2. **Overload**: a server bounded at ``--max-queue 4`` takes a burst of
+   32 concurrent same-shape requests while the first cold compile
+   blocks the executor — every request must come back (no hangs):
+   either ``ok`` + verified byte-exact, or a framed ``SHED[...]``
+   response naming the reason; at least one queue-full shed must occur
+   (the bound is 4, the burst is 32).
+3. **Drain**: SIGTERM to that server — it must exit rc 0, and its
+   journal must ``replay_journal`` to REPRODUCED with a drain record
+   whose counts the entries re-derive.
+4. **Recover**: a fresh ``cli serve --recover JOURNAL`` must report the
+   replay on its ready line and pre-warm the compiled-chain cache, so
+   the first same-shape request lands as a cache HIT.
 
 Exit 0 only when all hold.
 """
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
+import threading
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 WARM_SPEEDUP = 10.0
+OVERLOAD_SHAPE = dict(method=3, nprocs=8, cb_nodes=2, comm_size=4,
+                      data_size=64)
 
 
 def cpu_env(**extra) -> dict:
@@ -51,10 +59,24 @@ def fail(msg: str) -> int:
     return 1
 
 
-def main() -> int:
-    tmp = tempfile.mkdtemp(prefix="serve_smoke_")
-    out_path = os.path.join(tmp, "SERVE_smoke.json")
+def spawn_serve(extra_args: list, env: dict) -> tuple:
+    """Spawn ``cli serve`` and parse its ready line."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "serve",
+         "--backend", "jax_sim", "--port", "0"] + extra_args,
+        cwd=REPO, stdout=subprocess.PIPE, stderr=sys.stderr, text=True)
+    line = proc.stdout.readline()
+    try:
+        ready = json.loads(line)
+        assert ready.get("serve") == "ready"
+    except (ValueError, AssertionError):
+        proc.kill()
+        raise SystemExit(f"serve-smoke: no ready line (got {line!r})")
+    return proc, ready
 
+
+def leg_warm_cold(tmp: str) -> int:
+    out_path = os.path.join(tmp, "SERVE_smoke.json")
     # burst 4 over 4 default shapes: bursts 5-8 re-hit shapes 1-4, so
     # half the load MUST land warm on the compiled-chain cache. The
     # burst gap clears each compile before the next burst arrives —
@@ -81,20 +103,22 @@ def main() -> int:
         summary = json.loads(lines[0])
     except ValueError as e:
         return fail(f"summary line is not JSON ({e}): {lines[0]!r}")
-    if summary.get("serve_loadgen") != "v1":
+    if summary.get("serve_loadgen") != "v2":
         return fail(f"summary line missing the serve_loadgen tag: "
                     f"{lines[0]!r}")
 
-    # -- 1: all 32 requests completed and verified byte-exact --------------
+    # -- all 32 requests completed and verified byte-exact -----------------
     if summary["requests"] != 32 or summary["completed"] != 32 \
-            or summary["errors"] != 0:
+            or summary["errors"] != 0 or summary["shed"] != 0:
         return fail(f"request accounting off: {summary['completed']}/32 "
-                    f"completed, {summary['errors']} errors")
+                    f"completed, {summary['errors']} errors, "
+                    f"{summary['shed']} shed (an in-capacity run must "
+                    f"not shed)")
     if summary["verified"] != 32:
         return fail(f"only {summary['verified']}/32 requests verified "
                     f"byte-exact against the oracle")
 
-    # -- 2: warm hits skipped compilation ----------------------------------
+    # -- warm hits skipped compilation -------------------------------------
     cache = summary["cache"]
     if cache["compiles"] != 4 or cache["misses"] != 4 \
             or cache["evictions"] != 0:
@@ -109,7 +133,7 @@ def main() -> int:
         return fail(f"batching never engaged: {summary['batch']} — "
                     f"same-shape bursts of 4 must form real batches")
 
-    # -- 3: the warm path must beat the cold path by >= 10x -----------------
+    # -- the warm path must beat the cold path by >= 10x --------------------
     warm_p50, cold_p50 = summary["warm"]["p50"], summary["cold"]["p50"]
     if not (isinstance(warm_p50, float) and isinstance(cold_p50, float)):
         return fail(f"missing warm/cold p50: {warm_p50!r}, {cold_p50!r}")
@@ -118,7 +142,7 @@ def main() -> int:
                     f"below cold p50 {cold_p50:.4f}s — the compiled-"
                     f"chain cache is not amortizing the cold path")
 
-    # -- 4: the artifact validates like committed history -------------------
+    # -- the artifact validates like committed history ----------------------
     from tpu_aggcomm.obs.regress import validate_serve
     try:
         with open(out_path) as fh:
@@ -133,11 +157,150 @@ def main() -> int:
         return fail(f"artifact carries {len(blob.get('samples') or [])} "
                     f"samples; >= 3 required for the trend gate")
 
-    print(f"serve-smoke: PASS — 32/32 verified, {cache['compiles']} "
-          f"compiles, {cache['hits']} warm hits, warm p50 "
-          f"{warm_p50 * 1e3:.1f} ms vs cold p50 {cold_p50 * 1e3:.1f} ms "
-          f"({cold_p50 / warm_p50:.0f}x), artifact valid",
+    print(f"serve-smoke: warm/cold leg PASS — 32/32 verified, "
+          f"{cache['compiles']} compiles, {cache['hits']} warm hits, "
+          f"warm p50 {warm_p50 * 1e3:.1f} ms vs cold p50 "
+          f"{cold_p50 * 1e3:.1f} ms ({cold_p50 / warm_p50:.0f}x), "
+          f"artifact valid", file=sys.stderr)
+    return 0
+
+
+def leg_overload_drain_recover(tmp: str) -> int:
+    from tpu_aggcomm.serve.protocol import ServeClient
+    from tpu_aggcomm.serve.recover import replay_journal
+
+    journal = os.path.join(tmp, "overload.journal.jsonl")
+    proc, ready = spawn_serve(
+        ["--max-queue", "4", "--max-batch", "4",
+         "--batch-window-ms", "50", "--journal", journal], cpu_env())
+    port = int(ready["port"])
+    if ready.get("max_queue") != 4 or ready.get("state") != "ready":
+        proc.kill()
+        return fail(f"ready line missing overload fields: {ready}")
+
+    # -- overload: 32 concurrent same-shape requests vs a queue bound of
+    # 4, while the first cold compile (seconds on CPU) blocks the
+    # executor — the bound MUST shed, and every request MUST answer
+    results: list = [None] * 32
+
+    def fire(i: int) -> None:
+        try:
+            with ServeClient(port, timeout=300.0) as c:
+                results[i] = c.run(**dict(OVERLOAD_SHAPE, iter=i,
+                                          verify=True))
+        except Exception as e:  # lint: broad-ok (a dead request is a recorded verdict, not a smoke crash)
+            results[i] = {"ok": False,
+                          "error": f"{type(e).__name__}: {e}"}
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(32)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 300.0
+    for t in threads:
+        t.join(timeout=max(deadline - time.monotonic(), 1.0))
+    if any(t.is_alive() for t in threads):
+        proc.kill()
+        return fail("overload burst hung: some requests never answered "
+                    "(admission must respond, never block)")
+
+    ok_n = shed_n = 0
+    for i, r in enumerate(results):
+        if r is None:
+            proc.kill()
+            return fail(f"request {i} recorded nothing")
+        if r.get("ok"):
+            if r.get("verified") is not True:
+                proc.kill()
+                return fail(f"admitted request {i} did not verify "
+                            f"byte-exact: {r}")
+            ok_n += 1
+        elif r.get("shed"):
+            if not str(r.get("error", "")).startswith("SHED["):
+                proc.kill()
+                return fail(f"shed response {i} is not framed by name: "
+                            f"{r}")
+            shed_n += 1
+        else:
+            proc.kill()
+            return fail(f"request {i} failed without a named shed: {r}")
+    if shed_n < 1:
+        proc.kill()
+        return fail(f"no sheds under a 32-burst against --max-queue 4 "
+                    f"({ok_n} completed) — admission control never "
+                    f"engaged")
+    if ok_n < 1:
+        proc.kill()
+        return fail("every request shed — the bounded queue must still "
+                    "serve what it admits")
+    print(f"serve-smoke: overload leg PASS — {ok_n} verified, "
+          f"{shed_n} named sheds, 0 hangs", file=sys.stderr)
+
+    # -- drain: SIGTERM must exit rc 0 with a journal that replays ---------
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return fail("server did not drain within 120 s of SIGTERM")
+    if rc != 0:
+        return fail(f"drained server exited {rc}, expected 0")
+    report = replay_journal(journal)
+    if report["verdict"] != "REPRODUCED":
+        return fail(f"journal replay {report['verdict']}: "
+                    f"{report['problems']}")
+    if len(report["drains"]) < 1:
+        return fail("no drain record in the journal after SIGTERM")
+    if len(report["completed"]) != ok_n \
+            or len(report["shed"]) != shed_n:
+        return fail(f"journal re-derives {len(report['completed'])} "
+                    f"completed / {len(report['shed'])} shed; clients "
+                    f"saw {ok_n} / {shed_n}")
+    print(f"serve-smoke: drain leg PASS — rc 0, journal REPRODUCED "
+          f"with {len(report['drains'])} drain record(s)",
           file=sys.stderr)
+
+    # -- recover: replay + pre-warm, first same-shape request is a HIT -----
+    proc2, ready2 = spawn_serve(
+        ["--max-queue", "4", "--max-batch", "4", "--recover", journal],
+        cpu_env())
+    try:
+        rec = ready2.get("recover")
+        if not isinstance(rec, dict) or rec.get("verdict") != "REPRODUCED":
+            return fail(f"recover summary missing/unreproduced on the "
+                        f"ready line: {rec}")
+        if rec.get("prewarmed", 0) < 1:
+            return fail(f"recovery pre-warmed nothing: {rec} — the "
+                        f"journal's admitted shapes must warm the cache")
+        with ServeClient(int(ready2["port"]), timeout=300.0) as c:
+            resp = c.run(**dict(OVERLOAD_SHAPE, iter=99, verify=True))
+            if not resp.get("ok") or resp.get("verified") is not True:
+                return fail(f"post-recovery request failed: {resp}")
+            if resp.get("cache") != "hit":
+                return fail(f"post-recovery request was {resp.get('cache')!r}, "
+                            f"not a cache hit — the pre-warm did not land "
+                            f"under the live request's key")
+            c.shutdown()
+        proc2.wait(timeout=120)
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
+    print(f"serve-smoke: recover leg PASS — replay REPRODUCED, "
+          f"{rec['prewarmed']} pre-warmed chain(s), first request HIT",
+          file=sys.stderr)
+    return 0
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="serve_smoke_")
+    rc = leg_warm_cold(tmp)
+    if rc:
+        return rc
+    rc = leg_overload_drain_recover(tmp)
+    if rc:
+        return rc
+    print("serve-smoke: PASS — warm/cold, overload, drain and recover "
+          "legs all hold", file=sys.stderr)
     return 0
 
 

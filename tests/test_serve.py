@@ -13,6 +13,12 @@ The pins that define the subsystem:
 - **The control plane is jax-free**: protocol/cache/server must import
   (and a server must refuse/answer) where ``import jax`` raises —
   poisoned-jax subprocess pin, parameterized from the purity contract.
+- **Overload answers by name**: over the ``--max-queue`` bound, past a
+  soft deadline, in a DEGRADED/DRAINING state, or beyond the handler
+  pool, every request gets a framed ``SHED[reason]`` response — never a
+  silent drop, never a hang — and every shed/state/drain decision lands
+  in the journal so ``serve/recover.replay_journal`` re-derives the
+  whole lifecycle from artifacts alone (SIGKILL pin below).
 """
 
 import json
@@ -21,6 +27,8 @@ import socket
 import subprocess
 import sys
 import threading
+import time
+from types import SimpleNamespace
 
 import numpy as np
 import pytest
@@ -305,16 +313,37 @@ def test_server_roundtrip_batches_caches_and_evicts(tmp_path):
         srv.stop()
         srv.close()
 
-    # per-request accounting survived in the crash-safe journal
+    # per-request accounting survived in the crash-safe journal: one
+    # admitted record at enqueue (carrying the pre-warmable shape dict)
+    # plus one terminal record per rid
     recs = [json.loads(line) for line in journal.read_text().splitlines()
             if line.strip()]
-    reqs = [r for r in recs if "request" in json.dumps(r.get("key", ""))
-            or (isinstance(r.get("key"), dict) and "request" in r["key"])]
-    assert len(reqs) == 6
-    assert {r["key"]["request"] for r in reqs} == {1, 2, 3, 4, 5, 6}
+    reqs = [r for r in recs
+            if isinstance(r.get("key"), dict) and "request" in r["key"]]
+    admitted = [r for r in reqs if r.get("status") == "admitted"]
+    done = [r for r in reqs if r.get("status") == "done"]
+    assert len(admitted) == 6 and len(done) == 6
+    assert {r["key"]["request"] for r in done} == {1, 2, 3, 4, 5, 6}
     assert all(r["fingerprint"] for r in reqs)
-    caches = [r.get("cache") for r in reqs]
+    assert all(isinstance(r.get("shape"), dict) for r in admitted)
+    caches = [r.get("cache") for r in done]
     assert caches.count("hit") == 1 and caches.count("evict") == 1
+
+    # the shutdown op drained through the lifecycle state machine: a
+    # draining transition plus ONE drain record whose counts the
+    # preceding entries re-derive (the claim serve/recover cross-checks)
+    states = [r for r in recs
+              if isinstance(r.get("key"), dict) and "state" in r["key"]]
+    assert states and states[-1]["state"] == "draining"
+    drains = [r for r in recs
+              if isinstance(r.get("key"), dict) and "drain" in r["key"]]
+    assert len(drains) == 1
+    assert drains[0]["completed"] == 6 and drains[0]["failed"] == 0
+    assert drains[0]["shed"] == 0 and drains[0]["lost"] == []
+    from tpu_aggcomm.serve.recover import replay_journal
+    rep = replay_journal(str(journal))
+    assert rep["verdict"] == "REPRODUCED", rep["problems"]
+    assert rep["completed"] == [1, 2, 3, 4, 5, 6] and rep["lost"] == []
 
 
 def test_server_refuses_non_loopback_host():
@@ -345,6 +374,8 @@ def test_server_metrics_endpoint_opt_in(tmp_path):
         assert "tpu_aggcomm_serve_request_seconds" in body
         assert "tpu_aggcomm_serve_requests" in body
         assert "tpu_aggcomm_serve_queue_depth" in body
+        # the lifecycle gauge rides the same import-level gate
+        assert "tpu_aggcomm_serve_state" in body
     finally:
         srv.stop()
         srv.close()
@@ -397,6 +428,8 @@ srv.start()
 with ServeClient(srv.port, timeout=30.0) as c:
     st = c.stats()
     assert st["ok"] and st["completed"] == 0
+    h = c.health()
+    assert h["ok"] and h["state"] == "ready" and h["queue_depth"] == 0
     assert c.shutdown()["stopping"] is True
 srv.join(timeout=30.0)
 srv.stop(); srv.close()
@@ -409,6 +442,528 @@ print("STATS-OK")
             tmp_path, reason="serve control plane must not import jax"),
         capture_output=True, text=True)
     assert "STATS-OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# Overload protection: admission control, deadlines, lifecycle states
+# (the executor is faked so every shed decision is deterministic — the
+# control plane under test never needs the jax door)
+
+
+@pytest.fixture
+def fake_executor(monkeypatch):
+    """The real serve/executor module with gated fakes: ``gate`` holds
+    the compile (so tests can pin a request inside the executor), and
+    both fakes count calls so tests can prove the executor was (not)
+    reached."""
+    from tpu_aggcomm.serve import executor
+
+    calls = {"build": 0, "exec": 0}
+    gate = threading.Event()
+    gate.set()
+    entered = threading.Event()
+
+    def fake_build(schedule, backend_name):
+        calls["build"] += 1
+        entered.set()
+        assert gate.wait(120.0), "test gate never released"
+        return object(), 1e-3
+
+    def fake_exec(chain, reqs):
+        calls["exec"] += 1
+        return [{"verified": True if r.verify else None, "error": None}
+                for r in reqs]
+
+    monkeypatch.setattr(executor, "build_chain", fake_build)
+    monkeypatch.setattr(executor, "execute_batch", fake_exec)
+    return SimpleNamespace(calls=calls, gate=gate, entered=entered)
+
+
+_SHAPE = {"method": 3, "nprocs": 8, "cb_nodes": 2, "comm_size": 2,
+          "data_size": 64}
+
+
+def _wait_queue_depth(port, depth, timeout=60.0):
+    with ServeClient(port, timeout=30.0) as c:
+        deadline = time.monotonic() + timeout
+        while c.health()["queue_depth"] < depth:
+            assert time.monotonic() < deadline, "queue never filled"
+            time.sleep(0.01)
+
+
+def test_parse_request_deadline_ms():
+    req = parse_request(dict(_SHAPE, deadline_ms=50))
+    assert req.deadline_ms == 50.0
+    # deadline is payload, not program: it must not split the batch/cache
+    assert "deadline_ms" not in req.shape_fields
+    assert parse_request(dict(_SHAPE)).deadline_ms is None
+    for bad in (0, -5, True, "50"):
+        with pytest.raises(ProtocolError, match="deadline_ms"):
+            parse_request(dict(_SHAPE, deadline_ms=bad))
+
+
+def test_admission_queue_full_sheds_by_name(fake_executor):
+    fake_executor.gate.clear()
+    srv = ScheduleServer(port=0, max_queue=2, max_batch=1,
+                         batch_window_s=0.0)
+    srv.start()
+    results = []
+    try:
+        def fire(i):
+            with ServeClient(srv.port, timeout=120.0) as c:
+                results.append(c.run(**dict(_SHAPE, iter=i)))
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(3)]
+        # the head occupies the executor (held inside the gated compile)
+        threads[0].start()
+        assert fake_executor.entered.wait(60.0)
+        # ...two more fill the bounded queue to --max-queue
+        for t in threads[1:]:
+            t.start()
+        _wait_queue_depth(srv.port, 2)
+        # over capacity: a framed SHED naming depth and limit, instantly
+        with ServeClient(srv.port, timeout=60.0) as probe:
+            shed = probe.run(**dict(_SHAPE, iter=99))
+        assert shed["ok"] is False and shed["shed"] == "queue-full"
+        assert shed["error"].startswith("SHED[queue-full]")
+        assert "queue depth 2" in shed["error"]
+        assert "--max-queue 2" in shed["error"]
+        # nothing hung: once the gate opens, every ADMITTED request
+        # completes (the shed one consumed no executor work)
+        fake_executor.gate.set()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert not any(t.is_alive() for t in threads)
+        assert len(results) == 3 and all(r["ok"] for r in results)
+        with ServeClient(srv.port, timeout=60.0) as c:
+            h = c.health()
+        assert h["shed"]["queue-full"] == 1 and h["queue_depth"] == 0
+    finally:
+        fake_executor.gate.set()
+        srv.stop()
+        srv.close()
+
+
+def test_deadline_expired_sheds_at_fenced_boundary(fake_executor,
+                                                   tmp_path):
+    fake_executor.gate.clear()
+    journal = tmp_path / "serve.journal.jsonl"
+    srv = ScheduleServer(port=0, max_batch=1, batch_window_s=0.0,
+                         journal_path=str(journal))
+    srv.start()
+    out = {}
+    try:
+        def fire(name, **extra):
+            with ServeClient(srv.port, timeout=120.0) as c:
+                out[name] = c.run(**dict(_SHAPE, **extra))
+
+        t1 = threading.Thread(target=fire, args=("head",),
+                              kwargs={"iter": 0})
+        t1.start()
+        assert fake_executor.entered.wait(60.0)
+        t2 = threading.Thread(target=fire, args=("late",),
+                              kwargs={"iter": 1, "deadline_ms": 50.0})
+        t2.start()
+        _wait_queue_depth(srv.port, 1)
+        time.sleep(0.2)              # the soft budget lapses in-queue
+        fake_executor.gate.set()
+        t1.join(timeout=120.0)
+        t2.join(timeout=120.0)
+        assert out["head"]["ok"] is True
+        late = out["late"]
+        assert late["ok"] is False and late["shed"] == "deadline-expired"
+        assert late["error"].startswith("SHED[deadline-expired]")
+        assert "never mid-kernel" in late["error"]
+        # the expired request charged the executor nothing
+        assert fake_executor.calls["build"] == 1
+    finally:
+        fake_executor.gate.set()
+        srv.stop()
+        srv.close()
+    # the journal carries the shed terminal; the replay re-derives it
+    from tpu_aggcomm.serve.recover import replay_journal
+    rep = replay_journal(str(journal))
+    assert rep["verdict"] == "REPRODUCED", rep["problems"]
+    assert rep["completed"] == [1] and rep["shed"] == [2]
+    assert rep["lost"] == []
+
+
+def test_deadline_floor_presheds_before_executor(fake_executor, tmp_path):
+    # a calibration whose every parameter is 1000 s prices ANY schedule
+    # far beyond a 1 ms budget: admission must shed on the analytic
+    # floor alone, without touching the executor
+    from tpu_aggcomm.model.features import PARAM_NAMES
+    (tmp_path / "PREDICT_r99.json").write_text(json.dumps(
+        {"platforms": {"cpu": {"params": {k: 1000.0
+                                          for k in PARAM_NAMES}}}}))
+    srv = ScheduleServer(port=0, max_batch=1, batch_window_s=0.0,
+                         predict_root=str(tmp_path))
+    srv.start()
+    try:
+        with ServeClient(srv.port, timeout=60.0) as c:
+            shed = c.run(**dict(_SHAPE, deadline_ms=1.0))
+            assert shed["ok"] is False
+            assert shed["shed"] == "deadline_floor"
+            assert shed["error"].startswith("SHED[deadline_floor]")
+            assert "provably cannot meet its deadline" in shed["error"]
+            assert fake_executor.calls["build"] == 0
+            # the floor is advisory: without a deadline the same shape
+            # admits and runs normally
+            ok = c.run(**dict(_SHAPE, iter=1))
+            assert ok["ok"] is True
+            assert fake_executor.calls["build"] == 1
+    finally:
+        srv.stop()
+        srv.close()
+
+
+def test_exhausted_admit_flips_degraded_and_sheds_by_name(monkeypatch,
+                                                          fake_executor):
+    # chaos at the serve:admit site family with more budget than one
+    # request's retry policy: the exhausted TRANSIENT flips the state
+    # machine DEGRADED; later runs shed by name while the jax-free ops
+    # (stats/health) keep answering
+    from tpu_aggcomm.resilience import policy as rpolicy
+    monkeypatch.setenv("TPU_AGGCOMM_CHAOS", "serve:admit:5")
+    rpolicy._reset_chaos()
+    try:
+        srv = ScheduleServer(
+            port=0, max_batch=1, batch_window_s=0.0,
+            retry_policy=rpolicy.RetryPolicy(max_attempts=2,
+                                             backoff_base_s=0.001,
+                                             jitter_frac=0.0))
+        srv.start()
+        try:
+            with ServeClient(srv.port, timeout=60.0) as c:
+                first = c.run(**_SHAPE)
+                assert first["ok"] is False
+                assert "admit failed" in first["error"]
+                second = c.run(**dict(_SHAPE, iter=1))
+                assert second["ok"] is False
+                assert second["shed"] == "degraded"
+                assert "DEGRADED" in second["error"]
+                assert "serve:admit" in second["error"]
+                h = c.health()
+                assert h["ok"] and h["state"] == "degraded"
+                assert "retry budget exhausted" in h["degraded_reason"]
+                st = c.stats()
+                assert st["ok"] and st["state"] == "degraded"
+                assert st["shed"]["degraded"] == 1
+        finally:
+            srv.stop()
+            srv.close()
+    finally:
+        rpolicy._reset_chaos()
+
+
+def test_connection_limit_sheds_framed_line(fake_executor):
+    srv = ScheduleServer(port=0, max_conns=1)
+    srv.start()
+    a = ServeClient(srv.port, timeout=60.0)
+    try:
+        assert a.stats()["ok"]       # holds the single handler slot
+        with socket.create_connection(("127.0.0.1", srv.port),
+                                      timeout=30.0) as s:
+            line = s.makefile("r", encoding="utf-8").readline()
+        rec = json.loads(line)
+        assert rec["ok"] is False
+        assert rec["shed"] == "connection-limit"
+        assert rec["error"].startswith("SHED[connection-limit]")
+        assert "--max-conns" in rec["error"]
+        a.close()
+        # the slot frees on disconnect: the next connection is served
+        deadline = time.monotonic() + 60.0
+        while True:
+            with ServeClient(srv.port, timeout=30.0) as b:
+                r = b.stats()
+            if r.get("ok"):
+                assert r["shed"]["connection-limit"] >= 1
+                break
+            assert time.monotonic() < deadline, "slot never released"
+            time.sleep(0.01)
+    finally:
+        a.close()
+        srv.stop()
+        srv.close()
+
+
+def test_client_dead_port_raises_named_after_budget():
+    from tpu_aggcomm.resilience.policy import RetryPolicy, retries_exhausted
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    c = ServeClient(port, retry_policy=RetryPolicy(max_attempts=2,
+                                                   backoff_base_s=0.001,
+                                                   jitter_frac=0.0))
+    try:
+        with pytest.raises(ConnectionRefusedError) as ei:
+            c.stats()
+    finally:
+        c.close()
+    # a dead port is a TRANSIENT that outlived the budget — NAMED, so
+    # callers (loadgen --attach, the serve health machine) can tell it
+    # from a deterministic failure
+    assert retries_exhausted(ei.value)
+
+
+def test_loadgen_attach_dead_port_fails_named(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, PYTHONPATH=REPO,
+               TPU_AGGCOMM_RETRY_MAX="1", TPU_AGGCOMM_RETRY_BASE="0.01")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "serve_loadgen.py"),
+         "--attach", str(port), "--requests", "1"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode != 0
+    assert "cannot attach" in r.stderr and str(port) in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# Crash recovery: journal replay + cache pre-warm (serve/recover.py)
+
+
+def test_replay_journal_reproduced_and_mismatch(tmp_path):
+    from tpu_aggcomm.resilience.journal import RunJournal
+    from tpu_aggcomm.serve.recover import render_recovery, replay_journal
+    path = str(tmp_path / "j.jsonl")
+    j = RunJournal(path)
+    fp = j.begin_session(_man("0.4.37"))
+    shape = dict(_SHAPE, proc_node=1, agg_type=0, barrier_type=0,
+                 fault=None)
+    j.record({"request": 1}, fingerprint=fp, status="admitted",
+             shape=shape, backend="jax_sim", iter=0)
+    j.record({"request": 1}, fingerprint=fp, status="done", cache="miss")
+    j.record({"request": 2}, fingerprint=fp, status="admitted",
+             shape=shape, backend="jax_sim", iter=1)
+    j.record({"request": 3}, fingerprint=fp, status="admitted",
+             shape=shape, backend="jax_sim", iter=2)
+    j.record({"request": 3}, fingerprint=fp, status="shed",
+             reason="deadline-expired")
+    j.record({"state": 1}, fingerprint=fp, status="state",
+             state="draining", prev="ready", reason="SIGTERM")
+    j.record({"drain": 1}, fingerprint=fp, status="drain",
+             reason="SIGTERM", completed=1, failed=0, shed=1, lost=[2])
+    # a torn tail (the crash ate the final append) must not poison it
+    with open(path, "a") as fh:
+        fh.write('{"key": {"request": 9}, "status": "don')
+    rep = replay_journal(path)
+    assert rep["verdict"] == "REPRODUCED", rep["problems"]
+    assert rep["completed"] == [1] and rep["shed"] == [3]
+    assert rep["lost"] == [2]
+    assert len(rep["states"]) == 1 and len(rep["drains"]) == 1
+    assert 9 not in rep["admitted"]
+    text = "\n".join(render_recovery(rep))
+    assert "REPRODUCED" in text and "LOST in flight" in text
+
+    # a drain record whose claim the entries contradict is a MISMATCH
+    # with the disagreement named — a journal must agree with itself
+    with open(path, "a") as fh:
+        fh.write("\n")    # terminate the torn line so later appends parse
+    j.record({"drain": 2}, fingerprint=fp, status="drain",
+             reason="stop", completed=5, failed=0, shed=1, lost=[2])
+    rep2 = replay_journal(path)
+    assert rep2["verdict"] == "MISMATCH"
+    assert any("claims completed=5" in p and "re-derive 1" in p
+               for p in rep2["problems"])
+
+    # a terminal without an admission is a MISMATCH too
+    path2 = str(tmp_path / "j2.jsonl")
+    j2 = RunJournal(path2)
+    fp2 = j2.begin_session(_man("0.4.37"))
+    j2.record({"request": 7}, fingerprint=fp2, status="done")
+    rep3 = replay_journal(path2)
+    assert rep3["verdict"] == "MISMATCH"
+    assert any("without an admission record" in p
+               for p in rep3["problems"])
+
+
+def test_prewarm_plan_drift_skips_by_name():
+    from tpu_aggcomm.serve.recover import prewarm_plan
+    from tpu_aggcomm.tune.cache import manifest_fingerprint
+    m1, m2 = _man("0.4.37"), _man("0.5.0")
+    fp1, fp2 = manifest_fingerprint(m1), manifest_fingerprint(m2)
+    shape = dict(_SHAPE, proc_node=1, agg_type=0, barrier_type=0,
+                 fault=None)
+    report = {"admitted": {1: {"shape": shape, "backend": "jax_sim",
+                               "fingerprint": fp1},
+                           2: {"shape": shape, "backend": "jax_sim",
+                               "fingerprint": fp1}},
+              "sessions": {fp1: m1}}
+    # same fingerprint: one worklist item per distinct (shape, backend)
+    warm, skips = prewarm_plan(report, fingerprint=fp1, manifest=m1)
+    assert skips == []
+    assert warm == [{"shape": shape, "backend": "jax_sim",
+                     "requests": [1, 2]}]
+    # drifted fingerprint: SKIPPED with the divergent manifest keys
+    # named through diff_manifests — never a stale warm
+    warm2, skips2 = prewarm_plan(report, fingerprint=fp2, manifest=m2)
+    assert warm2 == [] and len(skips2) == 1
+    assert "manifest drift" in skips2[0]
+    assert "versions.jax" in skips2[0]
+    assert "first request recompiles" in skips2[0]
+    # pre-shape journals (no shape dict) have nothing to warm
+    assert prewarm_plan({"admitted": {1: {"backend": "jax_sim",
+                                          "fingerprint": fp1}},
+                         "sessions": {}},
+                        fingerprint=fp1, manifest=m1) == ([], [])
+
+
+def test_recover_prewarms_cache_and_first_request_hits(tmp_path,
+                                                       monkeypatch,
+                                                       fake_executor):
+    from tpu_aggcomm.core.schedule import schedule_shape_key
+    from tpu_aggcomm.obs import ledger
+    from tpu_aggcomm.resilience.journal import RunJournal
+    from tpu_aggcomm.serve import executor
+    from tpu_aggcomm.tune.cache import manifest_fingerprint
+
+    def fake_prewarm(shape, backend_name):
+        sched = request_schedule(parse_request(shape))
+        return object(), 2e-3, schedule_shape_key(sched)
+
+    monkeypatch.setattr(executor, "prewarm_chain", fake_prewarm)
+    man = ledger.manifest()
+    fp = manifest_fingerprint(man)
+    shape = dict(_SHAPE, proc_node=1, agg_type=0, barrier_type=0,
+                 fault=None)
+    journal = str(tmp_path / "crashed.journal.jsonl")
+    j = RunJournal(journal)
+    assert j.begin_session(man) == fp
+    j.record({"request": 1}, fingerprint=fp, status="admitted",
+             shape=shape, backend="jax_sim", iter=0)
+    j.record({"request": 1}, fingerprint=fp, status="done", cache="miss")
+    j.record({"request": 2}, fingerprint=fp, status="admitted",
+             shape=shape, backend="jax_sim", iter=1)   # lost in flight
+    # an admitted shape from a DRIFTED session must be skipped by name
+    drifted = json.loads(json.dumps(man))
+    drifted.setdefault("versions", {})["jax"] = "drifted-for-test"
+    dfp = j.begin_session(drifted)
+    j.record({"request": 3}, fingerprint=dfp, status="admitted",
+             shape=dict(shape, method=1), backend="jax_sim", iter=0)
+
+    srv = ScheduleServer(port=0, recover=journal, max_batch=1,
+                         batch_window_s=0.0)
+    try:
+        rec = srv.ready_info()["recover"]
+        assert rec["verdict"] == "REPRODUCED"
+        assert rec["completed"] == [1] and rec["lost"] == [2, 3]
+        assert rec["prewarmed"] == 1
+        assert len(rec["skipped"]) == 1
+        assert "manifest drift" in rec["skipped"][0]
+        srv.start()
+        # the pre-warmed chain serves the first same-shape request as a
+        # warm HIT: no compile, the executor's build door never opens
+        with ServeClient(srv.port, timeout=60.0) as c:
+            r = c.run(**dict(_SHAPE, iter=7))
+            assert r["ok"] is True and r["cache"] == "hit"
+            assert r["compile_s"] is None
+            assert fake_executor.calls["build"] == 0
+            st = c.stats()
+            assert st["cache"]["prewarmed"] == 1
+    finally:
+        srv.stop()
+        srv.close()
+
+
+def test_sigkill_mid_flight_replays_and_recovers_jaxfree(tmp_path):
+    # the acceptance pin: SIGKILL a server mid-request (plus a torn
+    # journal tail), then re-derive the loss and pre-warm the cache
+    # from the journal alone — BOTH halves under poisoned jax, because
+    # recovery runs precisely where a wedged tunnel hangs `import jax`
+    journal = str(tmp_path / "crash.journal.jsonl")
+    env = _jaxfree.poisoned_env(
+        tmp_path, reason="serve crash recovery must not import jax")
+    code1 = f"""
+import os, sys, threading, time, types
+fake = types.ModuleType("tpu_aggcomm.serve.executor")
+def _build(schedule, backend_name):
+    time.sleep(600)     # a wedged compile: the crash will eat this one
+fake.build_chain = _build
+fake.execute_batch = lambda chain, reqs: []
+sys.modules["tpu_aggcomm.serve.executor"] = fake
+import tpu_aggcomm.serve as serve_pkg
+serve_pkg.executor = fake
+from tpu_aggcomm.serve.protocol import ServeClient
+from tpu_aggcomm.serve.server import ScheduleServer
+srv = ScheduleServer(port=0, journal_path={journal!r}, max_batch=1,
+                     batch_window_s=0.0)
+srv.start()
+def fire():
+    try:
+        with ServeClient(srv.port, timeout=300.0) as c:
+            c.run(method=3, nprocs=8, cb_nodes=2, comm_size=2,
+                  data_size=64)
+    except Exception:
+        pass
+threading.Thread(target=fire, daemon=True).start()
+while True:     # the admitted record lands BEFORE the executor runs
+    try:
+        txt = open({journal!r}).read()
+    except OSError:
+        txt = ""
+    if '"admitted"' in txt:
+        break
+    time.sleep(0.01)
+with open({journal!r}, "a") as fh:     # tear the tail mid-append
+    fh.write('{{"key": {{"request": 9}}, "status": "don')
+    fh.flush(); os.fsync(fh.fileno())
+print("READY-TO-KILL", flush=True)
+time.sleep(600)
+"""
+    proc = subprocess.Popen([sys.executable, "-c", code1], cwd=REPO,
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline().strip()
+        assert line == "READY-TO-KILL", (line, proc.stderr.read())
+    finally:
+        proc.kill()                   # SIGKILL: no cleanup runs
+        proc.wait(timeout=30)
+
+    # jax-free replay in THIS process: the torn line is skipped, the
+    # in-flight request is named lost, and no drain record exists (the
+    # crash never drained — that asymmetry is the signal)
+    from tpu_aggcomm.serve.recover import replay_journal
+    rep = replay_journal(journal)
+    assert rep["verdict"] == "REPRODUCED", rep["problems"]
+    assert rep["lost"] == [1] and rep["completed"] == []
+    assert rep["drains"] == [] and 9 not in rep["admitted"]
+
+    # --recover under poisoned jax: replay + pre-warm plan + a fake
+    # jax-door compile, reported in the ready info
+    code2 = f"""
+import json, sys, types
+fake = types.ModuleType("tpu_aggcomm.serve.executor")
+def _prewarm(shape, backend_name):
+    from tpu_aggcomm.core.schedule import schedule_shape_key
+    from tpu_aggcomm.serve.protocol import parse_request, request_schedule
+    sched = request_schedule(parse_request(shape))
+    return object(), 2e-3, schedule_shape_key(sched)
+fake.prewarm_chain = _prewarm
+sys.modules["tpu_aggcomm.serve.executor"] = fake
+import tpu_aggcomm.serve as serve_pkg
+serve_pkg.executor = fake
+from tpu_aggcomm.serve.server import ScheduleServer
+srv = ScheduleServer(port=0, recover={journal!r})
+info = srv.ready_info()["recover"]
+srv.close()
+assert "jax" not in sys.modules
+print("RECOVER " + json.dumps(info))
+"""
+    out = subprocess.run([sys.executable, "-c", code2], cwd=REPO,
+                         env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stderr
+    info = json.loads(out.stdout.split("RECOVER ", 1)[1])
+    assert info["verdict"] == "REPRODUCED"
+    assert info["lost"] == [1] and info["prewarmed"] == 1
+    assert info["skipped"] == []
 
 
 # ---------------------------------------------------------------------------
@@ -507,3 +1062,60 @@ def test_check_bench_schema_validates_serve(tmp_path):
          str(tmp_path)], capture_output=True, text=True, env=env)
     assert bad.returncode == 1
     assert "SERVE_r02.json: rps" in bad.stdout
+
+
+def _serve_blob_v2(warm_p50, rnd, duration=2.0, backend="jax_sim"):
+    blob = _serve_blob(warm_p50, rnd, backend=backend)
+    comp = blob["completed"]
+    blob.update({
+        "schema": "serve-v2", "duration_s": duration,
+        "rps": comp / duration, "goodput_rps": comp / duration,
+        "shed": 2,
+        "shed_reasons": {"queue-full": 1, "deadline-expired": 1},
+        "deadline_missed": 1,
+        "requests": comp + blob["errors"] + 2})
+    return blob
+
+
+def test_validate_serve_v2_overload_accounting():
+    from tpu_aggcomm.obs.regress import validate_serve
+    blob = _serve_blob_v2(0.01, 1)
+    assert validate_serve(blob) == []
+    # v1 blobs stay valid: the overload fields are a v2 extension
+    assert validate_serve(_serve_blob(0.01, 1)) == []
+    # every shed must carry a reason — the reason map must sum to shed
+    bad_sr = dict(blob, shed_reasons={"queue-full": 1})
+    assert any("every shed must carry a reason" in e
+               for e in validate_serve(bad_sr))
+    assert any("non-negative" in e for e in
+               validate_serve(dict(blob, shed=-1,
+                                   shed_reasons=None, requests=1)))
+    # shed joins the request accounting (and the message says so)
+    off = dict(blob, requests=blob["requests"] + 1)
+    assert any("+ shed 2" in e and "accounted" in e
+               for e in validate_serve(off))
+    # goodput is completed/duration — a made-up number is invalid
+    assert any("goodput_rps" in e for e in
+               validate_serve(dict(blob, goodput_rps=123.0)))
+
+
+def test_history_inverse_goodput_trend_gate(tmp_path):
+    from tpu_aggcomm.obs.history import check_trends, serve_series
+    # goodput FALLING round over round: the inverted series RISES, so
+    # the shared drifting-up verdict catches a server losing goodput
+    for rnd in range(1, 6):
+        blob = _serve_blob_v2(0.01, rnd, duration=2.0 * (1.6 ** rnd))
+        (tmp_path / f"SERVE_r{rnd:02d}.json").write_text(
+            json.dumps(blob))
+    series = serve_series(str(tmp_path))
+    key = "serve inverse goodput | jax_sim"
+    assert key in series and len(series[key]) == 5
+    vals = [r["value"] for r in series[key]]
+    assert vals == sorted(vals) and vals[0] < vals[-1]
+    assert all(r["unit"] == "s/req" for r in series[key])
+
+    trends = check_trends(str(tmp_path))
+    assert trends["series"][key]["verdict"] == "drifting-up"
+    assert trends["ok"] is False
+    # seeded like every statistical verdict: same artifacts, same bytes
+    assert check_trends(str(tmp_path)) == trends

@@ -333,6 +333,24 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--journal", metavar="PATH", default=None,
                     help="crash-safe per-request JSONL journal "
                          "(resilience/journal.py discipline)")
+    sv.add_argument("--max-queue", type=int, default=256,
+                    help="admission bound: over this many queued "
+                         "requests, new ones get a framed SHED[queue-"
+                         "full] response naming depth and limit "
+                         "(default 256)")
+    sv.add_argument("--max-conns", type=int, default=64,
+                    help="bounded handler-thread pool; a connection "
+                         "beyond it gets a framed SHED[connection-"
+                         "limit] line (default 64)")
+    sv.add_argument("--recover", metavar="JOURNAL", default=None,
+                    help="replay a previous run's journal at startup: "
+                         "report completed/lost requests by name and "
+                         "pre-warm the compiled-chain cache from its "
+                         "shape records (manifest drift = named skip)")
+    sv.add_argument("--predict-root", metavar="DIR", default=".",
+                    help="where to find the newest PREDICT_*.json for "
+                         "the advisory deadline_floor pre-shed "
+                         "(default: .)")
     sv.add_argument("--metrics-port", type=int, default=None,
                     help="opt-in OpenMetrics /metrics endpoint "
                          "(obs/export.py; 0 = ephemeral port, announced "
@@ -1907,7 +1925,9 @@ def _run_serve(args) -> int:
     srv = ScheduleServer(
         backend=args.backend, port=args.port, max_batch=args.max_batch,
         batch_window_s=args.batch_window_ms / 1e3,
-        journal_path=args.journal, metrics_port=args.metrics_port)
+        max_queue=args.max_queue, max_conns=args.max_conns,
+        journal_path=args.journal, metrics_port=args.metrics_port,
+        recover=args.recover, predict_root=args.predict_root)
     print(_json.dumps(srv.ready_info()), flush=True)
     try:
         with _tracing(args.trace):

@@ -37,11 +37,17 @@ import threading
 
 __all__ = ["LatencyHistogram", "MetricsRegistry", "MetricsServer",
            "trace_registry", "serve_from_env", "METRICS_PORT_ENV",
-           "default_buckets", "PREFIX"]
+           "default_buckets", "PREFIX", "SERVE_STATE_VALUES"]
 
 #: The env var that switches the /metrics endpoint ON (absent/empty =
 #: no server, no socket, no thread — the documented default).
 METRICS_PORT_ENV = "TPU_AGGCOMM_METRICS_PORT"
+
+#: The serve lifecycle states as gauge values for
+#: ``tpu_aggcomm_serve_state`` (serve/server.py SERVE_STATES, in
+#: order): a scraper alerts on the NUMBER going up, the state name
+#: stays in the server's ``health`` op.
+SERVE_STATE_VALUES = {"ready": 0, "degraded": 1, "draining": 2}
 
 #: Metric-name prefix for everything this repo exports.
 PREFIX = "tpu_aggcomm"

@@ -133,7 +133,12 @@ def serve_series(root: str = ".", *,
     point), falling back to the overall p50 when a round recorded no
     warm hits. Keyed ``"serve warm p50 | <backend>"`` so the trend gate
     treats each backend as its own series, exactly like the bench
-    metric/platform split."""
+    metric/platform split.
+
+    serve-v2 rounds additionally contribute ``"serve inverse goodput |
+    <backend>"``: seconds per completed request (``1/goodput_rps``) —
+    inverted so the shared "drifting-up = worse" trend verdict applies
+    (goodput FALLING makes this series RISE, which the gate fails)."""
     series: dict[str, list[dict]] = {}
     for rnd, path, blob in load_history(root, "SERVE", errors=errors):
         warm = blob.get("warm") if isinstance(blob.get("warm"), dict) \
@@ -153,6 +158,16 @@ def serve_series(root: str = ".", *,
             "compile_seconds": None, "hbm_peak_bytes": None,
             "rps": blob.get("rps"),
             "file": os.path.basename(path)})
+        gp = blob.get("goodput_rps")
+        if isinstance(gp, (int, float)) and not isinstance(gp, bool) \
+                and gp > 0:
+            gkey = f"serve inverse goodput | {blob.get('backend', 'unknown')}"
+            series.setdefault(gkey, []).append({
+                "round": rnd, "value": 1.0 / float(gp), "unit": "s/req",
+                "samples_n": len(s) if isinstance(s, list) else 0,
+                "compile_seconds": None, "hbm_peak_bytes": None,
+                "rps": blob.get("rps"),
+                "file": os.path.basename(path)})
     return series
 
 

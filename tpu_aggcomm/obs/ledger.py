@@ -181,10 +181,13 @@ def hbm_peak() -> int | None:
 def record_resilience(site: str, *, kind: str, **extra) -> dict:
     """Append one resilience record (tpu_aggcomm/resilience/):
     ``kind`` in {"attempt", "suppressed", "deadline", "preflight",
-    "cancel"}. Attempt records carry the full retry-policy fields so
-    the backoff timeline replays deterministically from the artifact
-    alone (resilience/policy.replay_attempts). None extras are
-    dropped, record_compile discipline."""
+    "cancel"} — plus the serve lifecycle kinds {"shed", "state",
+    "drain", "bind"} (serve/server.py), all ignored by
+    ``replay_attempts`` because they are not attempts. Attempt records
+    carry the full retry-policy fields so the backoff timeline replays
+    deterministically from the artifact alone
+    (resilience/policy.replay_attempts). None extras are dropped,
+    record_compile discipline."""
     rec = {"site": str(site), "kind": str(kind)}
     for k, v in extra.items():
         if v is not None:

@@ -1043,7 +1043,7 @@ def validate_compare(obj, where: str = "COMPARE") -> list[str]:
     return errors
 
 
-SERVE_SCHEMAS = ("serve-v1",)
+SERVE_SCHEMAS = ("serve-v1", "serve-v2")
 
 
 def validate_serve(obj, where: str = "SERVE") -> list[str]:
@@ -1083,13 +1083,38 @@ def validate_serve(obj, where: str = "SERVE") -> list[str]:
         errors.append(f"{where}: 'shapes' must be a non-empty list of "
                       f"shape-spec strings")
 
+    shed = 0
+    if schema == "serve-v2":
+        # v2 (overload-aware): shed requests are accounted separately
+        # from errors, and goodput is the completed rate
+        for k in ("shed", "deadline_missed"):
+            _require(obj, k, int, errors, where)
+            v = obj.get(k)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                errors.append(f"{where}: {k!r} must be non-negative, "
+                              f"got {v}")
+        shed = obj.get("shed") if isinstance(obj.get("shed"), int) else 0
+        sr = obj.get("shed_reasons")
+        if sr is not None:
+            if not isinstance(sr, dict) or not all(
+                    isinstance(k, str) and isinstance(v, int)
+                    for k, v in sr.items()):
+                errors.append(f"{where}: 'shed_reasons' must map reason "
+                              f"-> count")
+            elif sum(sr.values()) != shed:
+                errors.append(f"{where}: shed_reasons sum to "
+                              f"{sum(sr.values())} but shed is {shed} — "
+                              f"every shed must carry a reason")
+
     req, comp, errs = obj.get("requests"), obj.get("completed"), \
         obj.get("errors")
     if isinstance(req, int) and isinstance(comp, int) \
-            and isinstance(errs, int) and comp + errs != req:
-        errors.append(f"{where}: completed {comp} + errors {errs} != "
-                      f"requests {req} — every request must be "
-                      f"accounted for")
+            and isinstance(errs, int) and comp + errs + shed != req:
+        parts = f"completed {comp} + errors {errs}"
+        if schema == "serve-v2":
+            parts += f" + shed {shed}"
+        errors.append(f"{where}: {parts} != requests {req} — every "
+                      f"request must be accounted for")
     if isinstance(comp, int) and isinstance(obj.get("verified"), int) \
             and obj["verified"] > comp:
         errors.append(f"{where}: verified {obj['verified']} > "
@@ -1160,6 +1185,11 @@ def validate_serve(obj, where: str = "SERVE") -> list[str]:
         if not _is_num(rps) or abs(rps - want) > 1e-9 * max(1.0, want):
             errors.append(f"{where}: rps {rps!r} != completed/"
                           f"duration_s == {want!r}")
+        if schema == "serve-v2":
+            gp = obj.get("goodput_rps")
+            if not _is_num(gp) or abs(gp - want) > 1e-9 * max(1.0, want):
+                errors.append(f"{where}: goodput_rps {gp!r} != "
+                              f"completed/duration_s == {want!r}")
 
     cache = obj.get("cache")
     if not isinstance(cache, dict):

@@ -77,6 +77,13 @@ class RunJournal:
     def entries(self) -> list[dict]:
         return self._scan()[1]
 
+    def sessions(self) -> dict:
+        """fingerprint -> manifest for every session header in the file
+        — the lens :mod:`tpu_aggcomm.serve.recover` names drift through
+        when a ``--recover`` pre-warm meets entries written by a
+        different environment."""
+        return self._scan()[0]
+
     # -- writing -----------------------------------------------------------
     def _append(self, rec: dict) -> None:
         os.makedirs(os.path.dirname(os.path.abspath(self.path)),

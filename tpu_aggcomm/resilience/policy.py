@@ -43,7 +43,7 @@ from tpu_aggcomm.obs import ledger, trace
 
 __all__ = ["TRANSIENT", "COMPILE", "VERIFY", "PROGRAM", "RETRYABLE",
            "ChaosError", "classify_error", "RetryPolicy", "retry_call",
-           "replay_attempts", "maybe_chaos_fail"]
+           "replay_attempts", "maybe_chaos_fail", "retries_exhausted"]
 
 TRANSIENT = "transient-tunnel"
 COMPILE = "compile"
@@ -163,7 +163,10 @@ def _chaos_budget() -> dict:
             part = part.strip()
             if not part:
                 continue
-            name, _, n = part.partition(":")
+            # rpartition: the count is after the LAST colon, so chaos
+            # keys may themselves contain colons ("serve:admit:3" arms
+            # the serve:admit site family with budget 3)
+            name, _, n = part.rpartition(":")
             try:
                 _CHAOS[name.strip()] = int(n)
             except ValueError:
@@ -197,6 +200,21 @@ def maybe_chaos_fail(site: str) -> None:
 # --------------------------------------------------------------------------
 # The retry loop.
 
+#: Attribute stamped on a TRANSIENT error that retry_call re-raised only
+#: because the policy's attempt budget ran out — the signal the serve
+#: layer's health state machine keys DEGRADED on (a deterministic
+#: program/verify error is the REQUEST's fault; an exhausted transient
+#: is the TUNNEL's).
+_EXHAUSTED_ATTR = "_tpu_aggcomm_retries_exhausted"
+
+
+def retries_exhausted(exc: BaseException) -> bool:
+    """Did :func:`retry_call` raise ``exc`` because a TRANSIENT error
+    outlived the whole attempt budget (as opposed to a non-retryable
+    class that raised on attempt 1)?"""
+    return bool(getattr(exc, _EXHAUSTED_ATTR, False))
+
+
 def retry_call(fn, *, site: str, policy: RetryPolicy | None = None,
                classify=classify_error, sleep=time.sleep):
     """Run ``fn()`` under the classified retry policy.
@@ -225,6 +243,14 @@ def retry_call(fn, *, site: str, policy: RetryPolicy | None = None,
                 backoff_s=backoff, **pol.as_record())
             trace.instant("ledger.resilience", **rec)
             if not retryable:
+                if cls in RETRYABLE:
+                    # transient, but the budget is spent: mark it so
+                    # callers (serve health state machine) can tell an
+                    # exhausted tunnel from a deterministic failure.
+                    try:
+                        setattr(e, _EXHAUSTED_ATTR, True)
+                    except Exception:  # lint: broad-ok (exceptions with __slots__ refuse attributes; the marker is advisory)
+                        pass
                 raise
             sleep(backoff)
             continue

@@ -1,24 +1,34 @@
 """Aggregation-as-a-service: persistent schedule server + compiled-chain
-cache + same-shape request batching.
+cache + same-shape request batching + overload protection.
 
 Package layout (the purity split is the point — see
 analysis/lint.PURE_PACKAGES):
 
-- ``protocol.py`` — JSON-lines wire protocol + client, jax-free.
+- ``protocol.py`` — JSON-lines wire protocol + retrying client,
+  jax-free.
 - ``cache.py`` — the compiled-chain cache with manifest-drift eviction
   (tune-cache keying), jax-free.
-- ``server.py`` — the control plane: socket accept loop, batching
-  queue, journal, metrics, retry; jax-free.
+- ``server.py`` — the control plane: socket accept loop (bounded
+  handler pool), admission control + deadline shedding, lifecycle
+  state machine (ready/degraded/draining), batching queue, journal,
+  metrics, retry; jax-free.
+- ``recover.py`` — ``--recover`` journal replay + cache pre-warm
+  planning (drift = named skip), jax-free.
 - ``executor.py`` — THE one jax door: compile chains, vmap-batch
-  same-shape requests (declared in PURE_PACKAGES like tune/measure.py).
+  same-shape requests, recovery pre-warm compiles (declared in
+  PURE_PACKAGES like tune/measure.py).
 """
 
 from tpu_aggcomm.serve.cache import CompiledChainCache
 from tpu_aggcomm.serve.protocol import (PROTOCOL, ProtocolError,
                                         ServeClient, ServeRequest,
                                         parse_request, request_schedule)
-from tpu_aggcomm.serve.server import SERVE_BACKENDS, ScheduleServer
+from tpu_aggcomm.serve.recover import (prewarm_plan, render_recovery,
+                                       replay_journal)
+from tpu_aggcomm.serve.server import (SERVE_BACKENDS, SERVE_STATES,
+                                      ScheduleServer)
 
 __all__ = ["PROTOCOL", "ProtocolError", "ServeClient", "ServeRequest",
            "parse_request", "request_schedule", "CompiledChainCache",
-           "ScheduleServer", "SERVE_BACKENDS"]
+           "ScheduleServer", "SERVE_BACKENDS", "SERVE_STATES",
+           "replay_journal", "prewarm_plan", "render_recovery"]

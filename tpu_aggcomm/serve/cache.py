@@ -36,6 +36,7 @@ class CompiledChainCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.prewarmed = 0
 
     @staticmethod
     def _key(shape_key, backend: str) -> tuple:
@@ -72,14 +73,20 @@ class CompiledChainCache:
             return e, None
 
     def put(self, shape_key, backend: str, *, fingerprint: str,
-            manifest: dict | None, chain, compile_s: float) -> dict:
+            manifest: dict | None, chain, compile_s: float,
+            prewarmed: bool = False) -> dict:
         """Install a freshly compiled chain (replaces any entry the
-        drift eviction left behind)."""
+        drift eviction left behind). ``prewarmed=True`` marks a chain
+        rebuilt from a recovery journal (``--recover``) rather than a
+        live request — same keying lens, counted separately so the
+        recovery report is auditable."""
         entry = {"chain": chain, "fingerprint": str(fingerprint),
                  "manifest": manifest, "compile_s": float(compile_s),
-                 "hits": 0}
+                 "hits": 0, "prewarmed": bool(prewarmed)}
         with self._lock:
             self._entries[self._key(shape_key, backend)] = entry
+            if prewarmed:
+                self.prewarmed += 1
         return entry
 
     def __len__(self) -> int:
@@ -89,4 +96,5 @@ class CompiledChainCache:
     def stats(self) -> dict:
         with self._lock:
             return {"entries": len(self._entries), "hits": self.hits,
-                    "misses": self.misses, "evictions": self.evictions}
+                    "misses": self.misses, "evictions": self.evictions,
+                    "prewarmed": self.prewarmed}

@@ -33,7 +33,7 @@ import time
 import numpy as np
 
 __all__ = ["CompiledChain", "build_chain", "execute_batch",
-           "recv_bytes", "batched_recv_bytes"]
+           "prewarm_chain", "recv_bytes", "batched_recv_bytes"]
 
 #: Backends the server may compile chains for. jax_shard needs the
 #: multichip driver harness (__graft_entry__) and refuses staged
@@ -120,6 +120,22 @@ def build_chain(schedule, backend_name: str) -> tuple[CompiledChain, float]:
     single(send0).block_until_ready()
     chain = CompiledChain(schedule, backend, backend_name, single, batched)
     return chain, time.perf_counter() - t0
+
+
+def prewarm_chain(shape: dict, backend_name: str):
+    """Rebuild one journal-recorded request shape into a compiled chain
+    — the ``--recover`` pre-warm path. ``shape`` is the dict of
+    ``ServeRequest.shape_fields`` the admission journal record carries;
+    returns ``(chain, compile_seconds, shape_key)`` keyed by the same
+    ``schedule_shape_key`` a live request would compute, so the warmed
+    entry is a cache HIT for the replayed traffic, never an alias."""
+    from tpu_aggcomm.core.schedule import schedule_shape_key
+    from tpu_aggcomm.serve.protocol import parse_request, request_schedule
+
+    req = parse_request(dict(shape))
+    schedule = request_schedule(req)
+    chain, compile_s = build_chain(schedule, backend_name)
+    return chain, compile_s, schedule_shape_key(schedule)
 
 
 def execute_batch(chain: CompiledChain, requests) -> list[dict]:

@@ -8,10 +8,17 @@ wedged connection state machine. Three ops:
 - ``{"op": "run", ...pattern fields...}`` — execute one rep of the
   requested (method, shape, fault, backend) and answer with the request
   latency, the cache disposition (hit/miss/evict) and the ``--verify``
-  verdict when asked for.
+  verdict when asked for. An optional ``deadline_ms`` (positive number)
+  is a SOFT budget: the server sheds the request at an admission or
+  batch boundary once it expires (never mid-kernel), answering
+  ``{"ok": false, "shed": "deadline-expired", ...}`` by name.
 - ``{"op": "stats"}`` — the server's counters (cache, batching, queue
   depth, latency quantiles) as one JSON object.
-- ``{"op": "shutdown"}`` — drain and stop.
+- ``{"op": "health"}`` — the lifecycle state machine's view: state
+  (ready/degraded/draining), queue depth vs bound, per-reason shed
+  counts. Answered even when the server is DEGRADED (jax-free op).
+- ``{"op": "shutdown"}`` — graceful drain (stop admitting, finish
+  in-flight batches, flush the journal) and stop.
 
 Everything in this module is jax-free (stdlib + core + faults): the
 client side and the request -> Schedule compilation run precisely where
@@ -68,6 +75,7 @@ class ServeRequest:
     verify: bool = False
     fault: str | None = None
     backend: str | None = None      # None = the server's default backend
+    deadline_ms: float | None = None  # soft budget; None = no deadline
 
     #: Shape identity for batching/caching — everything that changes the
     #: compiled program. ``iter_`` and ``verify`` deliberately excluded:
@@ -107,8 +115,17 @@ def parse_request(obj) -> ServeRequest:
     if not isinstance(verify, bool):
         raise ProtocolError(f"run request field 'verify' must be a "
                             f"bool, got {verify!r}")
+    deadline_ms = obj.get("deadline_ms")
+    if deadline_ms is not None:
+        if isinstance(deadline_ms, bool) \
+                or not isinstance(deadline_ms, (int, float)) \
+                or deadline_ms <= 0:
+            raise ProtocolError(f"run request field 'deadline_ms' must "
+                                f"be a positive number, got "
+                                f"{deadline_ms!r}")
+        deadline_ms = float(deadline_ms)
     return ServeRequest(verify=verify, fault=fault or None,
-                        backend=backend, **vals)
+                        backend=backend, deadline_ms=deadline_ms, **vals)
 
 
 def request_schedule(req: ServeRequest):
@@ -164,7 +181,19 @@ def read_msg(fh) -> dict | None:
 
 
 class ServeClient:
-    """A blocking client for one server connection (jax-free).
+    """A blocking client for one server address (jax-free).
+
+    Connects lazily and routes every roundtrip through the seeded
+    classified retry (``resilience.retry_call``): tunnel-class
+    transients — connection refused/reset, a per-request socket
+    ``timeout`` expiring against a wedged server — reconnect and retry
+    under the policy's bounded backoff; protocol/program errors raise
+    on attempt 1 (a malformed request retried is malformed twice).
+    Retrying a ``run`` is honest because requests are idempotent: the
+    payload is a deterministic fill, so a duplicate execution returns
+    the same bytes. A dead port therefore fails with a NAMED
+    ConnectionRefusedError after the budget — never a silent forever-
+    block (``retries_exhausted(e)`` distinguishes it).
 
     Usage::
 
@@ -175,10 +204,12 @@ class ServeClient:
     """
 
     def __init__(self, port: int, host: str = "127.0.0.1",
-                 timeout: float | None = 300.0):
-        self._sock = socket.create_connection((host, port),
-                                              timeout=timeout)
-        self._fh = self._sock.makefile("rw", encoding="utf-8")
+                 timeout: float | None = 300.0, retry_policy=None):
+        self._addr = (host, port)
+        self._timeout = timeout
+        self._retry_policy = retry_policy
+        self._sock = None
+        self._fh = None
 
     def __enter__(self):
         return self
@@ -186,13 +217,34 @@ class ServeClient:
     def __exit__(self, *exc):
         self.close()
 
-    def _roundtrip(self, obj: dict) -> dict:
-        send_msg(self._fh, obj)
-        resp = read_msg(self._fh)
+    def _connect(self) -> None:
+        if self._sock is None:
+            self._sock = socket.create_connection(self._addr,
+                                                  timeout=self._timeout)
+            self._fh = self._sock.makefile("rw", encoding="utf-8")
+
+    def _once(self, obj: dict) -> dict:
+        """One send/recv on the current connection; any socket trouble
+        closes it so the next retry attempt reconnects fresh."""
+        self._connect()
+        try:
+            send_msg(self._fh, obj)
+            resp = read_msg(self._fh)
+        except OSError:
+            self.close()
+            raise
         if resp is None:
+            self.close()
             raise ProtocolError("server closed the connection without "
                                 "a response")
         return resp
+
+    def _roundtrip(self, obj: dict) -> dict:
+        from tpu_aggcomm.resilience.policy import retry_call
+        op = str(obj.get("op", "?"))
+        return retry_call(lambda: self._once(obj),
+                          site=f"serve:client:{op}",
+                          policy=self._retry_policy)
 
     def run(self, **fields) -> dict:
         return self._roundtrip(dict(fields, op="run"))
@@ -200,11 +252,22 @@ class ServeClient:
     def stats(self) -> dict:
         return self._roundtrip({"op": "stats"})
 
+    def health(self) -> dict:
+        return self._roundtrip({"op": "health"})
+
     def shutdown(self) -> dict:
         return self._roundtrip({"op": "shutdown"})
 
     def close(self) -> None:
+        sock, fh = self._sock, self._fh
+        self._sock = self._fh = None
         try:
-            self._fh.close()
-        finally:
-            self._sock.close()
+            if fh is not None:
+                fh.close()
+        except OSError:
+            pass
+        try:
+            if sock is not None:
+                sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,174 @@
+"""Crash recovery for the serve layer: journal replay + cache pre-warm.
+
+A server journal (resilience/journal.py) is write-only while the server
+lives; this module is the read side, run at ``--recover`` startup —
+**jax-free**, because the one time you need recovery is precisely when
+the previous process died ugly and the tunnel may hang ``import jax``.
+
+Two halves:
+
+- :func:`replay_journal` re-derives the request ledger from the
+  torn-line-tolerant entries alone — which requests were admitted,
+  which reached a terminal status (``done``/``fail``/``shed``), which
+  were **lost in flight** (admitted, never finished: the crash ate
+  them) — and cross-checks every ``drain`` record's counts against the
+  entries preceding it: ``REPRODUCED`` when the journal agrees with
+  itself, ``MISMATCH`` with named problems otherwise (the
+  ``replay_attempts`` discipline applied to the request lifecycle).
+- :func:`prewarm_plan` turns the admitted records' shape dicts into a
+  compile worklist for the compiled-chain cache, through the SAME lens
+  every cache in this repo uses (``schedule_shape_key`` + backend +
+  manifest fingerprint): entries whose session fingerprint differs from
+  the recovering process's are SKIPPED with the drifted manifest keys
+  named via ``diff_manifests`` — a drifted environment must recompile
+  on first request, never serve a stale warm.
+
+The pre-warm compiles themselves happen in serve/executor.py (the jax
+door); this module only decides WHAT to warm and WHY something was
+skipped.
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpu_aggcomm.obs.ledger import diff_manifests
+from tpu_aggcomm.resilience.journal import RunJournal
+
+__all__ = ["replay_journal", "prewarm_plan", "render_recovery"]
+
+
+def replay_journal(path: str) -> dict:
+    """Re-derive the request ledger from a server journal.
+
+    Returns ``{"verdict": "REPRODUCED"|"MISMATCH", "problems": [...],
+    "completed": [rids], "failed": [rids], "shed": [rids],
+    "lost": [rids], "states": [...], "drains": [...],
+    "sessions": {fp: manifest}, "admitted": {rid: record},
+    "n_entries": int}``. Torn lines were already skipped by the journal
+    reader (crash safety is the reader's job); ``lost`` names requests
+    the crash ate — admitted with no terminal record."""
+    j = RunJournal(path)
+    sessions = j.sessions()
+    entries = j.entries()
+    admitted: dict = {}
+    terminal: dict = {}
+    states: list[dict] = []
+    drains: list[dict] = []
+    problems: list[str] = []
+    counts = {"done": 0, "fail": 0, "shed": 0}
+    for rec in entries:
+        key = rec.get("key") or {}
+        status = rec.get("status")
+        if "request" in key:
+            rid = key["request"]
+            if status == "admitted":
+                if rid in admitted:
+                    problems.append(f"request {rid}: duplicate admission "
+                                    f"record")
+                admitted[rid] = rec
+            elif status in counts:
+                if rid in terminal:
+                    problems.append(
+                        f"request {rid}: duplicate terminal record "
+                        f"({terminal[rid].get('status')} then {status})")
+                    continue
+                if status in ("done", "fail") and rid not in admitted:
+                    problems.append(f"request {rid}: {status} without an "
+                                    f"admission record")
+                terminal[rid] = rec
+                counts[status] += 1
+        elif "state" in key and status == "state":
+            states.append(rec)
+        elif "drain" in key and status == "drain":
+            drains.append(rec)
+            # a drain record is a CLAIM about the entries before it —
+            # re-derive each count and name any disagreement
+            for fld, have in (("completed", counts["done"]),
+                              ("failed", counts["fail"]),
+                              ("shed", counts["shed"])):
+                want = rec.get(fld)
+                if want is not None and want != have:
+                    problems.append(
+                        f"drain record claims {fld}={want}, the journal "
+                        f"entries before it re-derive {have}")
+            want_lost = rec.get("lost")
+            have_lost = sorted(r for r in admitted if r not in terminal)
+            if want_lost is not None and sorted(want_lost) != have_lost:
+                problems.append(
+                    f"drain record claims lost={sorted(want_lost)}, the "
+                    f"journal entries re-derive {have_lost}")
+
+    def _with(status):
+        return sorted(r for r in terminal
+                      if terminal[r].get("status") == status)
+
+    return {"verdict": "REPRODUCED" if not problems else "MISMATCH",
+            "problems": problems,
+            "completed": _with("done"), "failed": _with("fail"),
+            "shed": _with("shed"),
+            "lost": sorted(r for r in admitted if r not in terminal),
+            "states": states, "drains": drains, "sessions": sessions,
+            "admitted": admitted, "n_entries": len(entries)}
+
+
+def prewarm_plan(report: dict, *, fingerprint: str,
+                 manifest: dict | None) -> tuple[list[dict], list[str]]:
+    """(worklist, skips) for the compiled-chain cache pre-warm.
+
+    Each worklist item is ``{"shape": <shape-fields dict>, "backend":
+    str, "requests": [rids]}``, one per distinct (shape, backend) among
+    the journal's admitted records. An item whose recording session's
+    fingerprint differs from ``fingerprint`` lands in ``skips`` instead,
+    with the drifted manifest keys named (tune-cache / RunJournal
+    semantics: drift = named skip, never a stale warm)."""
+    groups: dict = {}
+    for rid in sorted(report.get("admitted", {})):
+        rec = report["admitted"][rid]
+        shape = rec.get("shape")
+        backend = rec.get("backend")
+        if not isinstance(shape, dict) or not isinstance(backend, str):
+            continue   # pre-v2 journals carry no shape dict: nothing to warm
+        key = (json.dumps(shape, sort_keys=True), backend)
+        g = groups.setdefault(key, {"shape": shape, "backend": backend,
+                                    "fingerprint": rec.get("fingerprint"),
+                                    "requests": []})
+        g["requests"].append(rid)
+    warm: list[dict] = []
+    skips: list[str] = []
+    for (shape_json, backend), g in sorted(groups.items()):
+        if g["fingerprint"] != fingerprint:
+            drift = diff_manifests(
+                report.get("sessions", {}).get(g["fingerprint"]), manifest)
+            keys = ", ".join(d["key"] for d in drift[:4]) or \
+                f"fingerprint {g['fingerprint']} != {fingerprint}"
+            more = f" (+{len(drift) - 4} more)" if len(drift) > 4 else ""
+            skips.append(f"{backend} shape {shape_json}: manifest drift "
+                         f"vs journal session ({keys}{more}) — not "
+                         f"pre-warming, first request recompiles")
+        else:
+            warm.append({"shape": g["shape"], "backend": backend,
+                         "requests": g["requests"]})
+    return warm, skips
+
+
+def render_recovery(report: dict) -> list[str]:
+    """Human lines for the recovery report (stderr; the ready JSON line
+    carries the machine form)."""
+    lines = [f"journal replay {report['verdict']}: "
+             f"{len(report['completed'])} completed, "
+             f"{len(report['failed'])} failed, "
+             f"{len(report['shed'])} shed, "
+             f"{len(report['lost'])} lost in flight "
+             f"({report['n_entries']} entries)"]
+    if report["completed"]:
+        lines.append(f"completed requests: {report['completed']}")
+    if report["lost"]:
+        lines.append(f"LOST in flight (admitted, never finished — the "
+                     f"crash ate them): {report['lost']}")
+    for d in report["drains"]:
+        lines.append(f"clean drain recorded: reason="
+                     f"{d.get('reason')!r}, completed={d.get('completed')}")
+    for p in report["problems"]:
+        lines.append(f"MISMATCH: {p}")
+    return lines

@@ -11,11 +11,47 @@ tunnel warmup before its first rep).
 Division of labor, enforced by the purity contract
 (analysis/lint.PURE_PACKAGES + the poisoned-jax pin in
 tests/test_serve.py): THIS module is control plane — sockets, queueing,
-batch formation, cache policy, journal, metrics, retry — and never
-imports jax; ``serve/executor.py`` is the one jax door. An operator
-must be able to query ``stats`` on (and cleanly stop) a server whose
-tunnel has wedged so badly that ``import jax`` hangs in fresh
-processes.
+admission control, batch formation, cache policy, journal, metrics,
+retry, lifecycle — and never imports jax; ``serve/executor.py`` is the
+one jax door. An operator must be able to query ``stats``/``health`` on
+(and cleanly stop) a server whose tunnel has wedged so badly that
+``import jax`` hangs in fresh processes.
+
+Overload protection (the fair-weather server hardened):
+
+- **Admission control** — the request queue is bounded (``--max-queue``)
+  and the admission decision happens at enqueue time: over capacity the
+  client gets a framed ``SHED[queue-full]`` response naming the depth
+  and the limit — never a silent drop, never a hang. Handler threads
+  are a bounded pool (``--max-conns``); a connection beyond the pool
+  gets a framed ``SHED[connection-limit]`` line and a close.
+- **Soft deadlines** — a request may carry ``deadline_ms``; expired
+  requests are shed at batch boundaries BEFORE compile/dispatch (the
+  ``safe_cancellation`` discipline: never mid-kernel), and admission
+  consults the cost model's jax-free analytic floor (tpu_aggcomm/model)
+  to pre-shed requests that provably cannot meet their budget
+  (``SHED[deadline_floor]`` — advisory: predictions never gate a
+  request that COULD meet its budget, only ones the floor proves out).
+- **Lifecycle** — READY → DEGRADED (a retry budget exhausted on
+  tunnel-class transients: TPU-backed runs are shed by name, the
+  jax-free ops still answer) → DRAINING (SIGTERM or a shutdown op:
+  admissions close, in-flight batches finish at their fenced
+  boundaries, the journal is flushed, a ledger ``drain`` record lands).
+  Exposed via the ``health`` op and a ``/metrics`` state gauge behind
+  the existing import-level gate.
+- **Crash recovery** — ``--recover JOURNAL`` replays the torn-line-
+  tolerant per-request journal at startup (serve/recover.py): completed
+  and in-flight-lost requests reported by name, the compiled-chain
+  cache pre-warmed from the journal's shape records under the
+  ``schedule_shape_key`` + backend + manifest-fingerprint lens (drift =
+  named skip, not a stale warm).
+
+Every shed/state/drain decision lands in trace + ledger resilience
+records AND the journal, so the whole lifecycle re-derives from
+artifacts alone (serve/recover.replay_journal — the replay_attempts
+discipline applied to requests). Chaos sites ``serve:admit`` /
+``serve:compile`` / ``serve:dispatch`` inject synthetic transients
+through the same ``TPU_AGGCOMM_CHAOS`` budget as everything else.
 
 Wired substrate, not regrown:
 
@@ -29,9 +65,10 @@ Wired substrate, not regrown:
   + fsync, torn-line-tolerant readers): a killed server loses at most
   the record being written.
 - **Metrics** — the opt-in obs/export ``/metrics`` endpoint (OFF by
-  default; the import itself is gated) serves queue depth and request
-  latency histograms whose ``_exact`` summary quantiles use the same
-  ``obs.metrics.percentile`` arithmetic as every other exposition.
+  default; the import itself is gated) serves queue depth, request
+  latency histograms and the lifecycle state gauge whose ``_exact``
+  summary quantiles use the same ``obs.metrics.percentile`` arithmetic
+  as every other exposition.
 
 The listener binds 127.0.0.1 ONLY — serving is for the operator's
 machine, not the network (the obs/export discipline); a non-loopback
@@ -40,6 +77,7 @@ host refuses by name.
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import threading
@@ -50,26 +88,37 @@ from tpu_aggcomm.faults import FaultSpecError, RepairError
 from tpu_aggcomm.obs import ledger, trace
 from tpu_aggcomm.obs.metrics import percentile
 from tpu_aggcomm.resilience.journal import RunJournal
-from tpu_aggcomm.resilience.policy import RetryPolicy, retry_call
+from tpu_aggcomm.resilience.policy import (RetryPolicy, retries_exhausted,
+                                           retry_call)
 from tpu_aggcomm.serve.cache import CompiledChainCache
 from tpu_aggcomm.serve.protocol import (PROTOCOL, ProtocolError,
                                         parse_request, read_msg,
                                         request_schedule, send_msg)
 
-__all__ = ["ScheduleServer", "SERVE_BACKENDS"]
+__all__ = ["ScheduleServer", "SERVE_BACKENDS", "SERVE_STATES"]
 
 #: Backends the server compiles chains for (mirrors
 #: serve/executor.CHAIN_BACKENDS without importing the jax module).
 SERVE_BACKENDS = ("jax_sim", "pallas_fused")
 
+#: The lifecycle state machine, in order. READY admits; DEGRADED (a
+#: retry budget exhausted on tunnel-class transients) sheds TPU-backed
+#: runs but still answers the jax-free ops; DRAINING admits nothing and
+#: finishes in-flight work at fenced boundaries.
+SERVE_STATES = ("ready", "degraded", "draining")
+
 _LOOPBACK = ("127.0.0.1", "localhost")
+
+#: Sentinel: floor params not loaded yet (lazy — most servers never see
+#: a deadline and must not pay a PREDICT_*.json scan at startup).
+_FLOOR_UNSET = object()
 
 
 class _Pending:
     """One enqueued request awaiting its batch."""
 
     __slots__ = ("req", "rid", "schedule", "shape_key", "backend_name",
-                 "t0", "event", "response")
+                 "t0", "deadline", "event", "response")
 
     def __init__(self, req, rid, schedule, shape_key, backend_name):
         self.req = req
@@ -78,6 +127,8 @@ class _Pending:
         self.shape_key = shape_key
         self.backend_name = backend_name
         self.t0 = time.monotonic()
+        self.deadline = (self.t0 + req.deadline_ms / 1e3
+                         if req.deadline_ms is not None else None)
         self.event = threading.Event()
         self.response: dict = {}
 
@@ -89,9 +140,12 @@ class ScheduleServer:
     def __init__(self, *, backend: str = "jax_sim",
                  host: str = "127.0.0.1", port: int = 0,
                  max_batch: int = 8, batch_window_s: float = 0.005,
+                 max_queue: int = 256, max_conns: int = 64,
                  journal_path: str | None = None,
                  metrics_port: int | None = None,
-                 retry_policy: RetryPolicy | None = None):
+                 retry_policy: RetryPolicy | None = None,
+                 recover: str | None = None,
+                 predict_root: str = "."):
         import socket
 
         if host not in _LOOPBACK:
@@ -105,7 +159,11 @@ class ScheduleServer:
         self._backend = backend
         self._max_batch = max(1, int(max_batch))
         self._batch_window_s = max(0.0, float(batch_window_s))
+        self._max_queue = max(1, int(max_queue))
+        self._max_conns = max(1, int(max_conns))
+        self._conn_slots = threading.Semaphore(self._max_conns)
         self._retry_policy = retry_policy
+        self._predict_root = predict_root
 
         self._listener = socket.create_server((host, port))
         self._listener.settimeout(0.2)
@@ -116,6 +174,8 @@ class ScheduleServer:
         self._queue: deque[_Pending] = deque()
         self._stop = False
         self._schedules: dict[tuple, tuple] = {}   # shape sig -> (sched, key)
+        self._floor_params = _FLOOR_UNSET
+        self._floors: dict = {}                    # shape_key -> float | None
         self._cache = CompiledChainCache()
         self._man = ledger.manifest()
         from tpu_aggcomm.tune.cache import manifest_fingerprint
@@ -128,14 +188,24 @@ class ScheduleServer:
         # counters (all under _cv's lock for mutation)
         self._rid = 0
         self._batch_seq = 0
+        self._reserved = 0        # admission slots between bound-check and enqueue
         self._n_completed = 0
         self._n_errors = 0
+        self._n_failed = 0        # _finish failures only (journaled 1:1)
+        self._n_shed_rec = 0      # per-request sheds (journaled 1:1 when armed)
         self._n_compiles = 0
         self._n_batches = 0
         self._n_batched_requests = 0
         self._max_batch_seen = 0
         self._warm_s: list[float] = []
         self._cold_s: list[float] = []
+        self._shed: dict[str, int] = {}
+
+        # lifecycle state machine (READY until proven otherwise)
+        self._state = "ready"
+        self._state_seq = 0
+        self._degraded_reason: str | None = None
+        self._drain_reason: str | None = None
 
         # OFF by default; the /metrics import itself is the gate (the
         # zero-cost obs invariant) — armed, the hot path pays one
@@ -150,6 +220,11 @@ class ScheduleServer:
                                            port=metrics_port)
             if self._metrics is not None:
                 self._registry = registry
+                self._state_gauge("ready")
+
+        self._recover = None
+        if recover:
+            self._recover = self._run_recovery(recover)
 
         self._exec_thread = threading.Thread(
             target=self._executor_loop, name="tpu-aggcomm-serve-exec",
@@ -161,28 +236,157 @@ class ScheduleServer:
         info = {"serve": "ready", "protocol": PROTOCOL,
                 "host": self.host, "port": self.port,
                 "backend": self._backend, "pid": os.getpid(),
-                "max_batch": self._max_batch}
+                "max_batch": self._max_batch,
+                "max_queue": self._max_queue,
+                "max_conns": self._max_conns,
+                "state": self._state}
         if self._metrics is not None:
             info["metrics_url"] = self._metrics.url
+        if self._recover is not None:
+            info["recover"] = self._recover
         return info
 
+    def _state_gauge(self, state: str) -> None:
+        if self._registry is not None:
+            from tpu_aggcomm.obs.export import SERVE_STATE_VALUES
+            self._registry.gauge("tpu_aggcomm_serve_state",
+                                 float(SERVE_STATE_VALUES.get(state, -1)))
+
+    def _set_state(self, state: str, reason: str) -> None:
+        """One lifecycle transition: ledger + trace + journal + gauge —
+        every transition re-derivable from artifacts alone."""
+        with self._cv:
+            if self._state == state:
+                return
+            prev = self._state
+            self._state = state
+            self._state_seq += 1
+            seq = self._state_seq
+        rec = ledger.record_resilience("serve:lifecycle", kind="state",
+                                       state=state, prev=prev,
+                                       reason=str(reason)[:500])
+        trace.instant("ledger.resilience", **rec)
+        if self._journal is not None:
+            self._journal.record({"state": seq}, fingerprint=self._fp,
+                                 status="state", state=state, prev=prev,
+                                 reason=str(reason)[:500])
+        self._state_gauge(state)
+        print(f"serve: state {prev} -> {state} ({reason})",
+              file=sys.stderr)
+
+    def _enter_degraded(self, reason: str) -> None:
+        """Tunnel-class retry budget exhausted: stop accepting TPU-backed
+        work (shed by name) while the jax-free ops keep answering. Sticky
+        until restart — a tunnel that ate a whole retry budget is not
+        presumed healed by the next request."""
+        with self._cv:
+            if self._state != "ready":
+                return
+            self._degraded_reason = str(reason)
+        self._set_state("degraded", reason)
+
+    def begin_drain(self, reason: str) -> None:
+        """Graceful drain: admissions close (new runs shed by name),
+        in-flight batches finish at their fenced boundaries — never
+        mid-kernel — then the journal gets the drain record."""
+        with self._cv:
+            already = self._state == "draining"
+            if not already:
+                self._drain_reason = str(reason)
+        if not already:
+            self._set_state("draining", reason)
+        self.stop()
+
+    def _install_sigterm(self):
+        """SIGTERM = graceful drain. Main-thread only (the
+        safe_cancellation discipline: signal handlers install nowhere
+        else); returns the previous handler, or None if not installed."""
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        import signal
+
+        def _on_term(signum, frame):
+            print("serve: SIGTERM — draining (admissions close; "
+                  "in-flight batches finish at their fenced boundaries, "
+                  "never mid-kernel)", file=sys.stderr, flush=True)
+            self.begin_drain("SIGTERM")
+
+        try:
+            return signal.signal(signal.SIGTERM, _on_term)
+        except (ValueError, OSError):
+            return None
+
     def serve_forever(self) -> None:
-        """Accept loop; returns after :meth:`stop` (or a shutdown op)
-        once the queue has drained."""
+        """Accept loop; returns after :meth:`stop` (or a shutdown op /
+        SIGTERM) once the queue has drained."""
         import socket
 
         self._exec_thread.start()
-        while not self._stop:
-            try:
-                conn, _addr = self._listener.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                break
-            threading.Thread(target=self._handle_conn, args=(conn,),
-                             daemon=True).start()
+        old_term = self._install_sigterm()
+        try:
+            while not self._stop:
+                try:
+                    conn, _addr = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not self._conn_slots.acquire(blocking=False):
+                    self._shed_conn(conn)
+                    continue
+                threading.Thread(target=self._handle_conn_slot,
+                                 args=(conn,),
+                                 name="tpu-aggcomm-serve-conn",
+                                 daemon=True).start()
+        finally:
+            if old_term is not None:
+                import signal
+                try:
+                    signal.signal(signal.SIGTERM, old_term)
+                except (ValueError, OSError):
+                    pass
         self._exec_thread.join(timeout=60.0)
+        if self._exec_thread.is_alive():
+            # the drain join used to be fire-and-forget: a stuck
+            # executor returned "clean" with a live thread and an
+            # unflushed request ledger. Name it instead.
+            rec = ledger.record_resilience(
+                "serve:drain", kind="suppressed", error_class="program",
+                error="executor thread still alive after the 60 s drain "
+                      "join — in-flight work may be lost; the journal "
+                      "carries no drain record")
+            trace.instant("ledger.resilience", **rec)
+            print("serve: WARNING — executor thread did not drain within "
+                  "60 s; in-flight work may be lost (ledger 'suppressed' "
+                  "record written, no drain record)", file=sys.stderr)
+        else:
+            self._finish_drain()
         self.close()
+
+    def _finish_drain(self) -> None:
+        """The drain epilogue: ledger + journal drain record carrying
+        counts re-derivable from the journal entries alone
+        (serve/recover.replay_journal cross-checks them)."""
+        with self._cv:
+            reason = self._drain_reason or "stop"
+            lost = [p.rid for p in self._queue]
+            completed = self._n_completed
+            failed = self._n_failed
+            shed_rec = self._n_shed_rec
+            shed_all = dict(self._shed)
+        rec = ledger.record_resilience(
+            "serve:drain", kind="drain", reason=reason,
+            completed=completed, failed=failed, shed=shed_rec, lost=lost)
+        trace.instant("ledger.resilience", **rec)
+        if self._journal is not None:
+            self._journal.record({"drain": 1}, fingerprint=self._fp,
+                                 status="drain", reason=reason,
+                                 completed=completed, failed=failed,
+                                 shed=shed_rec, lost=lost)
+        extra = f", LOST {lost}" if lost else ""
+        print(f"serve: drained ({reason}) — {completed} completed, "
+              f"{failed} failed, {sum(shed_all.values())} shed{extra}",
+              file=sys.stderr)
 
     def start(self) -> threading.Thread:
         """Run :meth:`serve_forever` on a daemon thread (tests)."""
@@ -210,12 +414,182 @@ class ScheduleServer:
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=timeout)
 
+    # -- crash recovery ----------------------------------------------------
+    def _run_recovery(self, path: str) -> dict:
+        """Replay the journal and pre-warm the compiled-chain cache
+        (serve/recover.py decides what and why; executor compiles)."""
+        from tpu_aggcomm.serve.recover import (prewarm_plan,
+                                               render_recovery,
+                                               replay_journal)
+        report = replay_journal(path)
+        for line in render_recovery(report):
+            print(f"serve: recover: {line}", file=sys.stderr)
+        warm, skips = prewarm_plan(report, fingerprint=self._fp,
+                                   manifest=self._man)
+        prewarmed = 0
+        for i, w in enumerate(warm):
+            try:
+                from tpu_aggcomm.serve import executor
+                chain, compile_s, shape_key = retry_call(
+                    lambda w=w: executor.prewarm_chain(w["shape"],
+                                                       w["backend"]),
+                    site=f"serve:prewarm:{i}",
+                    policy=self._retry_policy)
+            except Exception as e:  # lint: broad-ok (pre-warm is advisory: a shape that no longer compiles must not kill recovery — its first live request reports the error)
+                skips.append(f"{w['backend']} shape {w['shape']}: "
+                             f"pre-warm failed: {type(e).__name__}: {e}")
+                continue
+            ledger.record_compile(
+                f"serve:{w['backend']}:prewarm{i}", seconds=compile_s,
+                kind="compile+warmup", backend=w["backend"], prewarm=True)
+            self._cache.put(shape_key, w["backend"], fingerprint=self._fp,
+                            manifest=self._man, chain=chain,
+                            compile_s=compile_s, prewarmed=True)
+            with self._cv:
+                self._n_compiles += 1
+            prewarmed += 1
+        for s in skips:
+            print(f"serve: recover: skip — {s}", file=sys.stderr)
+        return {"journal": path, "verdict": report["verdict"],
+                "completed": report["completed"],
+                "failed": report["failed"], "shed": report["shed"],
+                "lost": report["lost"], "prewarmed": prewarmed,
+                "skipped": skips}
+
+    # -- the cost-model floor (jax-free pre-shed) --------------------------
+    def _load_floor_params(self) -> dict | None:
+        """Params from the newest committed PREDICT_*.json for this
+        platform (falling back to the cpu calibration like
+        floor_from_trace_events) — None if there is no usable artifact;
+        the floor is then simply not consulted (admission stays open)."""
+        try:
+            from tpu_aggcomm.model.predict import newest_predict_path
+            path = newest_predict_path(self._predict_root)
+            if path is None:
+                return None
+            with open(path) as fh:
+                blob = json.load(fh)
+            platforms = blob.get("platforms") or {}
+            platform = str(self._man.get("platform") or "cpu")
+            entry = platforms.get(platform) or platforms.get("cpu") or {}
+            params = entry.get("params")
+            if isinstance(params, dict):
+                return {"path": path, "params": params}
+        except Exception as e:  # lint: broad-ok (floor is advisory: a malformed PREDICT artifact must not break admission)
+            print(f"serve: cost-model floor unavailable "
+                  f"({type(e).__name__}: {e}) — deadline_floor pre-shed "
+                  f"disabled", file=sys.stderr)
+        return None
+
+    def _floor_for(self, schedule, shape_key) -> float | None:
+        """The analytic lower bound (seconds) for one rep of
+        ``schedule``, or None when unpriceable/uncalibrated. Cached per
+        shape_key; jax-free (model features come from op programs)."""
+        if self._floor_params is _FLOOR_UNSET:
+            self._floor_params = self._load_floor_params()
+        if self._floor_params is None:
+            return None
+        with self._cv:
+            if shape_key in self._floors:
+                return self._floors[shape_key]
+        try:
+            from tpu_aggcomm.model.features import schedule_features
+            from tpu_aggcomm.model.predict import floor_from_features
+            floor = float(floor_from_features(
+                schedule_features(schedule), self._floor_params["params"]))
+        except Exception:  # lint: broad-ok (floor is advisory: an unpriceable schedule — dense collectives the traffic matrices refuse — admits normally)
+            floor = None
+        with self._cv:
+            self._floors[shape_key] = floor
+        return floor
+
+    # -- load shedding -----------------------------------------------------
+    def _record_shed(self, rid: int | None, reason: str, detail: str,
+                     *, site: str | None = None, **extra) -> dict:
+        """One shed decision: counter + ledger + trace + journal +
+        metrics, and the framed response the client gets — always by
+        name, never a silent drop."""
+        with self._cv:
+            self._shed[reason] = self._shed.get(reason, 0) + 1
+            if rid is not None:
+                self._n_shed_rec += 1
+        rec = ledger.record_resilience(
+            site or (f"serve:admit:r{rid}" if rid is not None
+                     else "serve:admit"),
+            kind="shed", reason=reason, detail=detail[:500], **extra)
+        trace.instant("ledger.resilience", **rec)
+        if self._registry is not None:
+            self._registry.counter("tpu_aggcomm_serve_shed",
+                                   reason=reason)
+        if self._journal is not None and rid is not None:
+            self._journal.record({"request": rid}, fingerprint=self._fp,
+                                 status="shed", reason=reason,
+                                 detail=detail[:500], **extra)
+        return {"ok": False, "shed": reason, "request_id": rid,
+                "error": f"SHED[{reason}]: {detail}"}
+
+    def _shed_pending(self, p: _Pending, reason: str, detail: str,
+                      **extra) -> None:
+        """Shed an already-queued request at a batch boundary."""
+        p.response = self._record_shed(
+            p.rid, reason, detail, site=f"serve:dispatch:r{p.rid}",
+            **extra)
+        p.response["latency_s"] = time.monotonic() - p.t0
+        p.event.set()
+
+    def _shed_conn(self, conn) -> None:
+        """All handler slots busy: one framed SHED line on the raw
+        socket, then close — the client learns WHY, immediately."""
+        with self._cv:
+            self._shed["connection-limit"] = \
+                self._shed.get("connection-limit", 0) + 1
+        rec = ledger.record_resilience(
+            "serve:admit:conn", kind="shed", reason="connection-limit",
+            detail=f"all {self._max_conns} handler slots busy")
+        trace.instant("ledger.resilience", **rec)
+        if self._registry is not None:
+            self._registry.counter("tpu_aggcomm_serve_shed",
+                                   reason="connection-limit")
+        try:
+            conn.sendall((json.dumps(
+                {"ok": False, "shed": "connection-limit",
+                 "error": f"SHED[connection-limit]: all "
+                          f"{self._max_conns} handler slots are busy "
+                          f"(--max-conns) — retry"}) + "\n")
+                .encode("utf-8"))
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _sweep_deadlines(self, batch: list[_Pending],
+                         boundary: str) -> list[_Pending]:
+        """Shed expired-deadline requests at a batch boundary (never
+        mid-kernel: the only places this runs are before compile and
+        before dispatch)."""
+        now = time.monotonic()
+        live: list[_Pending] = []
+        for p in batch:
+            if p.deadline is not None and now > p.deadline:
+                self._shed_pending(
+                    p, "deadline-expired",
+                    f"soft deadline {p.req.deadline_ms:g} ms expired "
+                    f"{boundary} (deadlines shed at fenced batch "
+                    f"boundaries only, never mid-kernel)",
+                    deadline_ms=p.req.deadline_ms)
+            else:
+                live.append(p)
+        return live
+
     # -- request intake ----------------------------------------------------
     def _schedule_for(self, req, backend_name: str):
         """(schedule, shape_key) for a request — compiled and (under a
         fault spec) repaired once per distinct shape, jax-free."""
-        sig = tuple(getattr(req, f if f != "fault" else "fault")
-                    for f in req.shape_fields) + (backend_name,)
+        sig = tuple(getattr(req, f) for f in req.shape_fields) \
+            + (backend_name,)
         with self._cv:
             hit = self._schedules.get(sig)
         if hit is not None:
@@ -226,6 +600,12 @@ class ScheduleServer:
         with self._cv:
             self._schedules[sig] = (schedule, shape_key)
         return schedule, shape_key
+
+    def _handle_conn_slot(self, conn) -> None:
+        try:
+            self._handle_conn(conn)
+        finally:
+            self._conn_slots.release()
 
     def _handle_conn(self, conn) -> None:
         with conn:
@@ -240,9 +620,11 @@ class ScheduleServer:
                         self._handle_run(fh, msg)
                     elif op == "stats":
                         send_msg(fh, self.stats())
+                    elif op == "health":
+                        send_msg(fh, self.health())
                     elif op == "shutdown":
                         send_msg(fh, {"ok": True, "stopping": True})
-                        self.stop()
+                        self.begin_drain("shutdown op")
                         return
                     else:
                         send_msg(fh, {"ok": False,
@@ -264,20 +646,95 @@ class ScheduleServer:
             send_msg(fh, {"ok": False, "error": str(e)})
             return
         with self._cv:
-            if self._stop:
-                send_msg(fh, {"ok": False,
-                              "error": "server is shutting down"})
-                return
             self._rid += 1
-            pending = _Pending(req, self._rid, schedule, shape_key,
-                               backend_name)
-            self._queue.append(pending)
-            depth = len(self._queue)
-            self._cv.notify_all()
+            rid = self._rid
+            state = self._state
+            stopping = self._stop
+        # lifecycle gates: a DEGRADED/DRAINING server refuses TPU-backed
+        # work by name (stats/health/shutdown keep answering)
+        if state == "degraded":
+            send_msg(fh, self._record_shed(
+                rid, "degraded",
+                f"server is DEGRADED ({self._degraded_reason}); run "
+                f"requests are shed until restart — stats/health/"
+                f"shutdown still answer"))
+            return
+        if state == "draining" or stopping:
+            send_msg(fh, self._record_shed(
+                rid, "draining",
+                "server is DRAINING — admissions are closed; in-flight "
+                "work finishes at its fenced boundaries"))
+            return
+        # advisory cost-model pre-shed: the jax-free analytic floor vs
+        # the request's soft budget — shed only what provably cannot fit
+        if req.deadline_ms is not None:
+            floor = self._floor_for(schedule, shape_key)
+            if floor is not None and floor > req.deadline_ms / 1e3:
+                send_msg(fh, self._record_shed(
+                    rid, "deadline_floor",
+                    f"analytic cost-model floor {floor * 1e3:.3f} ms "
+                    f"exceeds the {req.deadline_ms:g} ms budget — the "
+                    f"request provably cannot meet its deadline "
+                    f"(advisory floor, tpu_aggcomm/model)",
+                    floor_s=floor, deadline_ms=req.deadline_ms))
+                return
+        # the admission decision itself is a retry/chaos site
+        # ("serve:admit"): a transient here retries under the seeded
+        # policy; an exhausted budget flips the server DEGRADED
+        try:
+            retry_call(lambda: None, site=f"serve:admit:r{rid}",
+                       policy=self._retry_policy)
+        except Exception as e:  # lint: broad-ok (an admission failure is the request's response, never the server's death)
+            if retries_exhausted(e):
+                self._enter_degraded(
+                    f"retry budget exhausted at serve:admit:r{rid}: "
+                    f"{type(e).__name__}: {e}")
+            with self._cv:
+                self._n_errors += 1
+            send_msg(fh, {"ok": False, "request_id": rid,
+                          "error": f"admit failed: "
+                                   f"{type(e).__name__}: {e}"})
+            return
+        # bounded queue: the admission decision happens at enqueue time
+        # (a reserved slot covers the journal write below, so concurrent
+        # admits cannot overshoot the bound)
+        with self._cv:
+            depth = len(self._queue) + self._reserved
+            over = depth >= self._max_queue
+            if not over:
+                self._reserved += 1
+        if over:
+            send_msg(fh, self._record_shed(
+                rid, "queue-full",
+                f"queue depth {depth} >= --max-queue {self._max_queue}; "
+                f"retry later or raise the bound",
+                depth=depth, limit=self._max_queue))
+            return
+        pending = _Pending(req, rid, schedule, shape_key, backend_name)
+        try:
+            # admission journal record BEFORE the executor can see the
+            # pending: a done/fail always follows its admitted record
+            # (serve/recover.replay_journal pins the ordering), and the
+            # shape dict is what --recover pre-warms from
+            if self._journal is not None:
+                shape = {f: getattr(req, f) for f in req.shape_fields}
+                self._journal.record(
+                    {"request": rid}, fingerprint=self._fp,
+                    status="admitted", shape=shape, backend=backend_name,
+                    iter=req.iter_, deadline_ms=req.deadline_ms)
+        finally:
+            with self._cv:
+                self._reserved -= 1
+                self._queue.append(pending)
+                depth = len(self._queue)
+                self._cv.notify_all()
         if self._registry is not None:
             self._registry.gauge("tpu_aggcomm_serve_queue_depth", depth)
         pending.event.wait()
-        send_msg(fh, pending.response)
+        try:
+            send_msg(fh, pending.response)
+        except OSError:
+            pass   # client vanished mid-wait; the journal has the verdict
 
     # -- the batching executor --------------------------------------------
     def _extract_same(self, head: _Pending, room: int) -> list[_Pending]:
@@ -330,6 +787,11 @@ class ScheduleServer:
                          compile_s=None, verified=None, error=err)
 
     def _run_batch(self, batch: list[_Pending]) -> None:
+        # deadline sweep BEFORE compile: an expired request must not pay
+        # (or charge the batch for) a compile it cannot use
+        batch = self._sweep_deadlines(batch, "before compile")
+        if not batch:
+            return
         head = batch[0]
         with self._cv:
             self._batch_seq += 1
@@ -353,9 +815,13 @@ class ScheduleServer:
                 chain, compile_s = retry_call(
                     lambda: executor.build_chain(head.schedule,
                                                  head.backend_name),
-                    site=f"serve.compile:b{seq}",
+                    site=f"serve:compile:b{seq}",
                     policy=self._retry_policy)
             except Exception as e:  # lint: broad-ok (fault isolation: a compile error is the batch's response, never the server's death)
+                if retries_exhausted(e):
+                    self._enter_degraded(
+                        f"retry budget exhausted at serve:compile:b{seq}: "
+                        f"{type(e).__name__}: {e}")
                 self._fail_batch(batch, disposition,
                                  f"compile failed: {type(e).__name__}: {e}")
                 return
@@ -367,6 +833,13 @@ class ScheduleServer:
                 manifest=self._man, chain=chain, compile_s=compile_s)
             with self._cv:
                 self._n_compiles += 1
+        # deadline sweep again AFTER compile, BEFORE dispatch: the
+        # compile wall may have outlived a budget, and shedding here is
+        # still a fenced boundary (nothing dispatched yet)
+        batch = self._sweep_deadlines(batch, "after compile, before "
+                                             "dispatch")
+        if not batch:
+            return
         chain = entry["chain"]
         try:
             with trace.span("serve.batch", seq=seq, n=len(batch),
@@ -375,9 +848,13 @@ class ScheduleServer:
                 results = retry_call(
                     lambda: executor.execute_batch(
                         chain, [p.req for p in batch]),
-                    site=f"serve.dispatch:b{seq}",
+                    site=f"serve:dispatch:b{seq}",
                     policy=self._retry_policy)
         except Exception as e:  # lint: broad-ok (fault isolation: a dispatch error is the batch's response, never the server's death)
+            if retries_exhausted(e):
+                self._enter_degraded(
+                    f"retry budget exhausted at serve:dispatch:b{seq}: "
+                    f"{type(e).__name__}: {e}")
             self._fail_batch(batch, disposition,
                              f"dispatch failed: {type(e).__name__}: {e}")
             return
@@ -403,6 +880,7 @@ class ScheduleServer:
                  else self._cold_s).append(latency)
             else:
                 self._n_errors += 1
+                self._n_failed += 1
         if self._registry is not None:
             self._registry.observe("tpu_aggcomm_serve_request_seconds",
                                    latency, backend=p.backend_name,
@@ -428,6 +906,21 @@ class ScheduleServer:
                 "p95": percentile(samples, 95.0),
                 "p99": percentile(samples, 99.0)}
 
+    def health(self) -> dict:
+        """The lifecycle view — jax-free, answered in every state (the
+        whole point: you ask a sick server how sick it is)."""
+        with self._cv:
+            return {"ok": True, "op": "health", "protocol": PROTOCOL,
+                    "state": self._state,
+                    "degraded_reason": self._degraded_reason,
+                    "draining": self._state == "draining",
+                    "queue_depth": len(self._queue),
+                    "max_queue": self._max_queue,
+                    "max_conns": self._max_conns,
+                    "shed": dict(self._shed),
+                    "completed": self._n_completed,
+                    "errors": self._n_errors}
+
     def stats(self) -> dict:
         with self._cv:
             warm = list(self._warm_s)
@@ -435,9 +928,13 @@ class ScheduleServer:
             out = {"ok": True, "protocol": PROTOCOL,
                    "backend": self._backend, "port": self.port,
                    "fingerprint": self._fp,
+                   "state": self._state,
+                   "degraded_reason": self._degraded_reason,
                    "queue_depth": len(self._queue),
+                   "max_queue": self._max_queue,
                    "completed": self._n_completed,
                    "errors": self._n_errors,
+                   "shed": dict(self._shed),
                    "cache": dict(self._cache.stats(),
                                  compiles=self._n_compiles),
                    "batch": {"batches": self._n_batches,
