@@ -29,7 +29,8 @@ from tpu_aggcomm.obs.history import load_history
 from tpu_aggcomm.obs.regress import (parsed_schema_version, validate_bench,
                                      validate_compare, validate_multichip,
                                      validate_predict, validate_serve,
-                                     validate_traffic, validate_tune)
+                                     validate_synth, validate_traffic,
+                                     validate_tune)
 
 
 def check(root: str) -> int:
@@ -107,6 +108,29 @@ def check(root: str) -> int:
         n_serve += 1
         n_errors += 1
         print(f"FAIL {e}")
+    # SYNTH_r*.json synthesis artifacts (tpu_aggcomm/synth/, synth-v1):
+    # discovered through load_history like the serve/bench rounds; a
+    # winner whose own recorded race contradicts it must fail here
+    n_synth = 0
+    synth_errors: list[str] = []
+    for rnd, path, blob in load_history(root, "SYNTH",
+                                        errors=synth_errors):
+        n_files += 1
+        n_synth += 1
+        errors = validate_synth(blob, os.path.basename(path))
+        if errors:
+            n_errors += len(errors)
+            for e in errors:
+                print(f"FAIL {e}")
+        else:
+            win = blob.get("winner") or {}
+            print(f"ok   {os.path.basename(path)} "
+                  f"({blob.get('schema', '?')}, winner {win.get('cid')})")
+    for e in synth_errors:
+        n_files += 1
+        n_synth += 1
+        n_errors += 1
+        print(f"FAIL {e}")
     from tpu_aggcomm.tune.cache import tune_paths
     for path in tune_paths(root):
         n_files += 1
@@ -161,7 +185,7 @@ def check(root: str) -> int:
         print(f"FAIL no BENCH_r*/MULTICHIP_r*.json found under {root}")
         return 1
     print(f"{n_files} artifact(s) ({n_tune} tune, {n_traffic} traffic, "
-          f"{n_model} model/compare, {n_serve} serve), "
+          f"{n_model} model/compare, {n_serve} serve, {n_synth} synth), "
           f"{n_errors} schema error(s)")
     return 1 if n_errors else 0
 

@@ -93,6 +93,27 @@ for f in PREDICT_*.json; do
   [ -e "$f" ] || continue
   python -m tpu_aggcomm.cli inspect explain --replay "$f" || post_rc=1
 done
+# schedule-synthesis replay gate (tpu_aggcomm/synth/, jax-free): every
+# committed SYNTH_r*.json must re-derive its seeded search trace from
+# (config, seed, embedded model params) and its race verdict from the
+# recorded samples, both byte-for-byte — the same replay discipline as
+# tune and PREDICT. A synthesized method whose search or race cannot
+# reproduce must not sit in the METHODS table.
+for f in SYNTH_r*.json; do
+  [ -e "$f" ] || continue
+  python -m tpu_aggcomm.cli synth --replay "$f" || post_rc=1
+done
+# synthesized-method static gates: re-register every committed winner
+# (--synth-root .) and hold the registered ids to the SAME standards as
+# the reference 22 — all-methods checker sweep (deadlock freedom,
+# recv-slot races, conservation, barrier symmetry, round monotonicity)
+# and the -c throttle-conformance sweep. No --fused-export here: the
+# fused cross-check over synthesized ids is covered per-method by
+# tests/test_synth.py; these sweeps prove program-level soundness.
+python -m tpu_aggcomm.cli inspect check -m 0 -n 32 -a 8 -c 4 \
+  --synth-root . > /dev/null || post_rc=1
+python -m tpu_aggcomm.cli inspect traffic -m 0 -n 32 -a 8 -c 4 \
+  --synth-root . > /dev/null || post_rc=1
 # live-telemetry gate (obs/export.py + obs/history.py, jax-free):
 # render OpenMetrics from every committed trace and validate it with
 # the parser in obs/regress.py (format drift fails HERE, not in a

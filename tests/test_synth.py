@@ -1,0 +1,590 @@
+"""Schedule synthesizer tests (tpu_aggcomm/synth/, ISSUE 15).
+
+The contract under test, layer by layer:
+
+- compositions are a canonical, parseable identity (two spellings can
+  never alias) and every named validation error stays named;
+- ``build_schedule`` emits ordinary Schedule IR: every PROVEN
+  composition passes ``--verify`` byte-exact on the local oracle (and
+  the registered winner on jax_sim + pallas_fused interpret), while the
+  deliberately cyclic ``sync=crossed`` compositions are REFUTED by the
+  model checker AND deadlock the oracle — checker<->oracle agreement,
+  the analysis-suite discipline;
+- the seeded search replays byte-for-byte (same config + seed + params
+  in, same trace out) and its prune bookkeeping is self-consistent;
+- registration is opt-in, idempotent, and conflict-refusing by name;
+- the CLI round trip (``synth --synthetic`` -> validate_synth ->
+  ``synth --replay`` REPRODUCED, tamper -> MISMATCH) runs end to end
+  where ``import jax`` raises — the whole pipeline is jax-free.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpu_aggcomm.backends.local import DeadlockError, run_schedule_local
+from tpu_aggcomm.core.methods import METHODS, compile_method
+from tpu_aggcomm.core.pattern import AggregatorPattern, Direction
+from tpu_aggcomm.core.schedule import schedule_shape_key
+from tpu_aggcomm.synth import (SYNTH_ID_BASE, Composition, CompositionError,
+                               RegisterError, build_schedule,
+                               enumerate_space, parse_composition,
+                               register_composition, registered_synth_ids)
+from tpu_aggcomm.synth.search import (UNREGISTERED_ID, evaluate_composition,
+                                      search)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COMMITTED_SYNTH = os.path.join(REPO, "SYNTH_r01.json")
+
+
+@pytest.fixture(autouse=True)
+def _registry_guard():
+    """Registration mutates the global METHODS table; every test leaves
+    it exactly as found (the opt-in contract extends to the suite)."""
+    before = set(METHODS)
+    yield
+    for mid in set(METHODS) - before:
+        del METHODS[mid]
+
+
+def _pattern(**kw):
+    kw.setdefault("data_size", 64)
+    kw.setdefault("comm_size", 3)
+    return AggregatorPattern(kw.pop("nprocs", 8), kw.pop("cb_nodes", 3),
+                             **kw)
+
+
+# ---------------------------------------------------------------------------
+# compositions: canonical identity + named validation
+
+
+class TestComposition:
+    def test_canonical_roundtrip(self):
+        for comp in enumerate_space():
+            assert parse_composition(comp.canonical()) == comp
+
+    def test_spellings_cannot_alias(self):
+        # reordered, padded, defaulted — one canonical form
+        a = parse_composition("sync=eager|order=strided")
+        b = parse_composition(" order=strided |sync=eager|relay=0")
+        assert a == b
+        assert a.canonical() == b.canonical()
+
+    def test_defaults_are_the_reference_shape(self):
+        c = parse_composition("")
+        assert (c.order, c.sync, c.selfedge, c.wait, c.window) == \
+            ("rotated", "rendezvous", "wire", "round", "chunk")
+
+    @pytest.mark.parametrize("text,needle", [
+        ("order=spiral", "order="),
+        ("sync=psync", "sync="),
+        ("self=ptr", "self="),
+        ("wait=never", "wait="),
+        ("window=sliding", "window="),
+        ("flavor=mild", "unknown composition key"),
+        ("fanin=two|order=tree", "not an integer"),
+        ("order=tree", "fanin >= 2"),
+        ("fanin=2", "only composes with order=tree"),
+        ("sync=crossed|wait=tail", "wait=round"),
+        ("relay=-1", "must be >= 0"),
+        ("orderstrided", "not key=value"),
+        ("window=posted|wait=tail", "wait=round"),
+        ("window=posted|order=tree|fanin=2", "chunk width"),
+        ("window=posted|relay=1", "window=chunk"),
+        ("window=drain|wait=tail", "wait=round"),
+        ("window=drain|order=tree|fanin=2", "cannot collapse"),
+        ("window=drain|relay=1", "window=chunk"),
+    ])
+    def test_errors_are_named(self, text, needle):
+        with pytest.raises(CompositionError) as ei:
+            parse_composition(text)
+        assert needle in str(ei.value)
+
+    def test_enumerate_space_is_sorted_and_valid(self):
+        space = enumerate_space(fanins=(2, 3), relays=(0, 1))
+        canons = [c.canonical() for c in space]
+        assert canons == sorted(canons)
+        assert len(canons) == len(set(canons))
+        # crossed+tail and fanin-without-tree never enumerate, and the
+        # window axis only opens where its constraints allow
+        for c in space:
+            assert not (c.sync == "crossed" and c.wait == "tail")
+            assert (c.fanin >= 2) == (c.order == "tree")
+            if c.window != "chunk":
+                assert (c.wait, c.relay) == ("round", 0)
+                assert c.order != "tree"
+
+
+# ---------------------------------------------------------------------------
+# build_schedule: ordinary IR, oracle-verified
+
+
+PROVEN_COMPS = [
+    "order=rotated|sync=rendezvous|self=wire|wait=round",
+    "order=rotated|sync=eager|self=copy|wait=round",
+    "order=strided|sync=eager|self=copy|wait=tail",
+    "order=blocked|sync=rendezvous|self=wire|wait=tail",
+    "order=tree|fanin=2|sync=rendezvous|self=wire|wait=round",
+    "order=tree|fanin=4|sync=eager|self=copy|wait=round",
+    "order=rotated|sync=rendezvous|self=wire|wait=round|relay=2",
+    "order=rotated|sync=eager|self=copy|wait=round|window=posted",
+    "order=blocked|sync=rendezvous|self=wire|wait=round|window=posted",
+    "order=rotated|sync=eager|self=copy|wait=round|window=drain",
+    "order=blocked|sync=rendezvous|self=wire|wait=round|window=drain",
+]
+
+
+class TestBuildSchedule:
+    @pytest.mark.parametrize("text", PROVEN_COMPS)
+    def test_local_verify_byte_exact(self, text):
+        comp = parse_composition(text)
+        sched = build_schedule(comp, _pattern())
+        run_schedule_local(sched, verify=True)
+
+    def test_m2a_mirror_verifies(self):
+        comp = parse_composition("order=rotated|sync=eager|self=copy")
+        sched = build_schedule(
+            comp, _pattern(direction=Direction.MANY_TO_ALL))
+        assert sched.pattern.direction is Direction.MANY_TO_ALL
+        run_schedule_local(sched, verify=True)
+
+    def test_relay_is_the_repair_detour_ir(self):
+        comp = parse_composition("relay=2")
+        sched = build_schedule(comp, _pattern())
+        # 2 ring-predecessor sources per aggregator, one staging row each
+        assert sched.n_staging == 2 * sched.pattern.cb_nodes
+        assert len(sched.dead_edges) == 2 * sched.pattern.cb_nodes
+        ops = [op for prog in sched.programs for op in prog]
+        assert any(op.to_stage for op in ops)
+        assert any(op.from_stage for op in ops)
+        assert any(op.chan > 0 for op in ops)
+        run_schedule_local(sched, verify=True)
+
+    def test_relay_refuses_m2a_by_name(self):
+        comp = parse_composition("relay=1")
+        with pytest.raises(CompositionError, match="all-to-many"):
+            build_schedule(comp,
+                           _pattern(direction=Direction.MANY_TO_ALL))
+
+    def test_relay_refuses_tiny_patterns_by_name(self):
+        with pytest.raises(CompositionError, match="relay\\+2 ranks"):
+            build_schedule(parse_composition("relay=7"), _pattern())
+
+    def test_posted_resizes_rounds_to_the_budget(self):
+        """window=posted must find strictly fewer rounds than the
+        conservative chunker at this shape, while the in-flight audit
+        still CONFORMS — the whole point of budgeting against the
+        documented min(c,n)+cb bound instead of the chunk width."""
+        chunk = build_schedule(
+            parse_composition("order=rotated|sync=eager|self=copy"),
+            _pattern())
+        posted = build_schedule(
+            parse_composition(
+                "order=rotated|sync=eager|self=copy|window=posted"),
+            _pattern())
+        r_chunk = int(chunk.data_edges()[:, 4].max()) + 1
+        r_posted = int(posted.data_edges()[:, 4].max()) + 1
+        assert r_posted < r_chunk
+        row = evaluate_composition(
+            parse_composition(
+                "order=rotated|sync=eager|self=copy|window=posted"),
+            _pattern())
+        assert row["verdict"] == "PROVEN"
+        assert row["peak"] <= row["bound"]
+        run_schedule_local(posted, verify=True)
+
+    def test_drain_is_one_data_round(self):
+        """window=drain collapses the schedule to a single data round:
+        every send posted up front, the incast drained by blocking
+        receives that post nothing against the -c bound (the
+        m=6/10/12 conformance precedent, taken to its fixed point)."""
+        sched = build_schedule(
+            parse_composition(
+                "order=rotated|sync=eager|self=copy|window=drain"),
+            _pattern())
+        assert int(sched.data_edges()[:, 4].max()) == 0
+        row = evaluate_composition(
+            parse_composition(
+                "order=rotated|sync=eager|self=copy|window=drain"),
+            _pattern())
+        assert row["verdict"] == "PROVEN"
+        assert row["rounds"] == 1
+        assert row["peak"] <= row["bound"]
+        run_schedule_local(sched, verify=True)
+
+    def test_variant_isolates_shape_keys_before_registration(self):
+        # two compositions sharing the placeholder id must never alias a
+        # shape-keyed cache entry: the canonical string rides variant
+        p = _pattern()
+        a = build_schedule(parse_composition(PROVEN_COMPS[0]), p,
+                           method_id=UNREGISTERED_ID)
+        b = build_schedule(parse_composition(PROVEN_COMPS[1]), p,
+                           method_id=UNREGISTERED_ID)
+        assert a.variant.startswith("synth:")
+        assert schedule_shape_key(a) != schedule_shape_key(b)
+
+
+# ---------------------------------------------------------------------------
+# checker <-> oracle agreement (the hard-pruning contract)
+
+
+class TestCheckerAgreement:
+    @pytest.mark.parametrize("text", [
+        "sync=crossed|order=strided",
+        # crossed+drain waits the rendezvous sends BEFORE the blocking
+        # drain posts any matching receive — the same cycle, one window
+        # deeper
+        "sync=crossed|order=rotated|self=copy|window=drain",
+    ])
+    def test_crossed_refuted_and_oracle_deadlocks(self, text):
+        """The deliberately cyclic sync=crossed shapes: the checker must
+        REFUTE them by name AND the local oracle must deadlock on the
+        very same schedule — a static verdict the runtime contradicts
+        would make the search's hard pruning meaningless."""
+        comp = parse_composition(text)
+        row = evaluate_composition(comp, _pattern())
+        assert row["verdict"] == "REFUTED"
+        assert row["pruned_by"].startswith("check:deadlock_freedom")
+        assert row["check_detail"]  # the waits-for cycle, named
+        with pytest.raises(DeadlockError):
+            run_schedule_local(build_schedule(comp, _pattern()))
+
+    def test_proven_row_carries_static_features(self):
+        row = evaluate_composition(
+            parse_composition(PROVEN_COMPS[0]), _pattern())
+        assert row["verdict"] == "PROVEN" and row["pruned_by"] is None
+        assert row["rounds"] > 0 and row["bytes"] > 0
+        assert row["peak"] <= row["bound"]
+        assert row["price_s"] is None          # no params passed
+
+    def test_pricing_orders_but_never_gates(self):
+        params = {"rpc_s": 1e-4, "fence_s": 1e-5, "bytes_s_per_kb": 1e-6,
+                  "bottleneck_s_per_kb": 1e-6, "spill_s_per_kb": 0.0}
+        row = evaluate_composition(
+            parse_composition(PROVEN_COMPS[0]), _pattern(), params)
+        assert row["verdict"] == "PROVEN"
+        assert row["price_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# seeded search
+
+
+class TestSearch:
+    def _cfg(self, **kw):
+        kw.setdefault("nprocs", 8)
+        kw.setdefault("cb_nodes", 3)
+        kw.setdefault("comm_size", 4)
+        kw.setdefault("data_size", 64)
+        kw.setdefault("init", 12)
+        kw.setdefault("mutate_rounds", 2)
+        kw.setdefault("beam", 3)
+        return kw
+
+    def test_deterministic_given_seed(self):
+        a = search(seed=7, **self._cfg())
+        b = search(seed=7, **self._cfg())
+        assert json.loads(json.dumps(a)) == json.loads(json.dumps(b))
+
+    def test_bookkeeping_is_self_consistent(self):
+        sr = search(seed=0, **self._cfg())
+        rows = sr["rows"]
+        assert sr["evaluated"] == len(rows)
+        assert len({r["composition"] for r in rows}) == len(rows)
+        # prune counters match the recorded prefixes exactly
+        for key, prefix in (("invalid", "build:"), ("check", "check:"),
+                            ("traffic", "traffic:"),
+                            ("dominated", "dominated:")):
+            assert sr["pruned"][key] == sum(
+                1 for r in rows
+                if (r["pruned_by"] or "").startswith(prefix))
+        by_comp = {r["composition"]: r for r in rows}
+        for i, canon in enumerate(sr["survivors"]):
+            r = by_comp[canon]
+            assert r["pruned_by"] is None and r["verdict"] == "PROVEN"
+            assert r["rank"] == i + 1
+        assert sr["finalists"] == sr["survivors"][:sr["top_k"]]
+        # every check-pruned row names the refuted property
+        for r in rows:
+            if (r["pruned_by"] or "").startswith("check:"):
+                assert r["pruned_by"] != "check:unknown"
+
+    def test_every_finalist_verifies_on_the_oracle(self):
+        sr = search(seed=0, **self._cfg())
+        assert sr["finalists"]
+        for canon in sr["finalists"]:
+            sched = build_schedule(parse_composition(canon), _pattern(
+                comm_size=4))
+            run_schedule_local(sched, verify=True)
+
+
+# ---------------------------------------------------------------------------
+# registration
+
+
+class TestRegister:
+    CANON = parse_composition(PROVEN_COMPS[0]).canonical()
+
+    def test_reserved_range_guard(self):
+        with pytest.raises(RegisterError, match="SYNTH_ID_BASE"):
+            register_composition(self.CANON, method_id=SYNTH_ID_BASE)
+        with pytest.raises(RegisterError, match="SYNTH_ID_BASE"):
+            register_composition(self.CANON, method_id=13)
+
+    def test_idempotent_then_conflict_named(self):
+        spec = register_composition(self.CANON, method_id=150)
+        assert register_composition(self.CANON, method_id=150) is spec
+        with pytest.raises(RegisterError, match="alias"):
+            register_composition(
+                parse_composition(PROVEN_COMPS[1]), method_id=150)
+        with pytest.raises(RegisterError, match="alias"):
+            register_composition(self.CANON, method_id=150,
+                                 direction="m2a")
+
+    def test_registered_method_is_first_class(self):
+        register_composition(self.CANON, method_id=151)
+        assert 151 in registered_synth_ids()
+        sched = compile_method(151, _pattern())
+        assert sched.method_id == 151
+        assert sched.variant == f"synth:{self.CANON}"
+        run_schedule_local(sched, verify=True)
+        key = schedule_shape_key(sched)
+        assert self.CANON in str(key)
+
+
+# ---------------------------------------------------------------------------
+# CLI round trip (jax-free synthetic race)
+
+
+def _synth_cli(tmp_path, *extra):
+    from tpu_aggcomm.cli import main
+    return main(["synth", "-n", "8", "-a", "3", "-c", "4", "-d", "64",
+                 "--init", "12", "--mutate-rounds", "1", "--beam", "2",
+                 "--max-batches", "3", "--predict-root", str(tmp_path),
+                 "--synth-root", str(tmp_path), *extra])
+
+
+class TestCli:
+    def test_synthetic_win_roundtrip(self, tmp_path, capsys):
+        from tpu_aggcomm.cli import main
+        from tpu_aggcomm.obs.regress import validate_synth
+
+        # m101 (the first registered finalist) injected 2x faster than
+        # the reference field: the synthesized schedule must win and the
+        # artifact must validate and replay REPRODUCED
+        rc = _synth_cli(tmp_path, "--synthetic", "250,m101*0.5")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "winner: m101:" in out
+        path = tmp_path / "SYNTH_r01.json"
+        assert path.exists()
+        blob = json.loads(path.read_text())
+        assert validate_synth(blob, "SYNTH_r01.json") == []
+        assert blob["winner"]["synthesized"] is True
+        assert blob["synthetic"] == "250,m101*0.5"
+
+        rc = main(["synth", "--replay", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0 and "REPRODUCED" in out
+
+    def test_reference_win_writes_nothing(self, tmp_path, capsys):
+        # the references injected faster: no artifact, named refusal
+        rc = _synth_cli(tmp_path, "--synthetic", "250,m3*0.1")
+        cap = capsys.readouterr()
+        assert rc == 1
+        assert "reference method m=3 won the race" in cap.err
+        assert not list(tmp_path.glob("SYNTH_r*.json"))
+
+    def test_replay_detects_tampered_search(self, tmp_path, capsys):
+        """A schema-valid search block that was not produced by the
+        recorded (config, seed) must MISMATCH on replay."""
+        from tpu_aggcomm.cli import main
+        rc = _synth_cli(tmp_path, "--synthetic", "250,m101*0.5")
+        capsys.readouterr()
+        assert rc == 0
+        blob = json.loads((tmp_path / "SYNTH_r01.json").read_text())
+        bad = copy.deepcopy(blob)
+        bad["search"]["init"] += 1      # different seeded frontier
+        p = tmp_path / "SYNTH_r90.json"
+        p.write_text(json.dumps(bad))
+        rc = main(["synth", "--replay", str(p)])
+        out = capsys.readouterr().out
+        assert rc == 1 and "MISMATCH" in out
+
+    def test_replay_detects_tampered_race(self, tmp_path, capsys):
+        """A forged elimination timeline the samples do not support
+        must MISMATCH (an internally-INCONSISTENT forgery — e.g. a
+        swapped winner — already fails schema validation upstream)."""
+        from tpu_aggcomm.cli import main
+        rc = _synth_cli(tmp_path, "--synthetic", "250,m101*0.5")
+        capsys.readouterr()
+        assert rc == 0
+        blob = json.loads((tmp_path / "SYNTH_r01.json").read_text())
+        bad = copy.deepcopy(blob)
+        assert bad["race"]["eliminations"], "race should separate refs"
+        bad["race"]["eliminations"][0]["batch"] += 1
+        p = tmp_path / "SYNTH_r91.json"
+        p.write_text(json.dumps(bad))
+        rc = main(["synth", "--replay", str(p)])
+        out = capsys.readouterr().out
+        assert rc == 1 and "MISMATCH" in out
+
+    def test_inconsistent_winner_fails_schema(self, tmp_path, capsys):
+        """validate_synth refuses a winner the recorded race
+        contradicts, before replay even runs."""
+        from tpu_aggcomm.cli import main
+        rc = _synth_cli(tmp_path, "--synthetic", "250,m101*0.5")
+        capsys.readouterr()
+        assert rc == 0
+        blob = json.loads((tmp_path / "SYNTH_r01.json").read_text())
+        bad = copy.deepcopy(blob)
+        loser = next(c for c in bad["race"]["order"]
+                     if c != bad["race"]["winner"])
+        bad["race"]["winner"] = loser
+        p = tmp_path / "SYNTH_r92.json"
+        p.write_text(json.dumps(bad))
+        with pytest.raises(SystemExit, match="schema validation"):
+            main(["synth", "--replay", str(p)])
+
+    def test_registration_is_opt_in(self, tmp_path, capsys,
+                                    monkeypatch):
+        """Synthesized ids resolve only through --synth-root (or the
+        implicit cwd scan a >100 -m triggers): with the flag the id
+        compiles; in an artifact-less cwd without it, the same -m fails
+        exactly as an unknown method always has."""
+        from tpu_aggcomm.cli import main
+        rc = _synth_cli(tmp_path, "--synthetic", "250,m101*0.5")
+        capsys.readouterr()
+        assert rc == 0
+        for mid in list(METHODS):
+            if mid > SYNTH_ID_BASE:
+                del METHODS[mid]
+        rc = main(["inspect", "check", "-m", "101", "-n", "8", "-a", "3",
+                   "-c", "4", "--synth-root", str(tmp_path)])
+        capsys.readouterr()
+        assert rc == 0
+        for mid in list(METHODS):
+            if mid > SYNTH_ID_BASE:
+                del METHODS[mid]
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        monkeypatch.chdir(empty)
+        with pytest.raises((SystemExit, KeyError)):
+            main(["inspect", "check", "-m", "101", "-n", "8", "-a", "3",
+                  "-c", "4"])
+
+
+# ---------------------------------------------------------------------------
+# the committed artifact (the ci_tier1.sh gate, in-process)
+
+
+class TestCommittedArtifact:
+    def _blob(self):
+        assert os.path.exists(COMMITTED_SYNTH), \
+            "committed SYNTH artifact gone"
+        with open(COMMITTED_SYNTH) as f:
+            return json.load(f)
+
+    def test_validates_and_replays(self, capsys):
+        from tpu_aggcomm.cli import main
+        from tpu_aggcomm.obs.regress import validate_synth
+        blob = self._blob()
+        assert validate_synth(blob, "SYNTH_r01.json") == []
+        rc = main(["synth", "--replay", COMMITTED_SYNTH])
+        out = capsys.readouterr().out
+        assert rc == 0 and "REPRODUCED" in out
+
+    def test_winner_beats_every_reference_on_record(self):
+        """The acceptance criterion, read off the committed samples: the
+        synthesized winner's pooled median is strictly the smallest."""
+        import statistics
+        blob = self._blob()
+        assert blob["winner"]["synthesized"] is True
+        meds = {cid: statistics.median([x for b in bl for x in b])
+                for cid, bl in blob["race"]["samples"].items()}
+        win = blob["race"]["winner"]
+        assert all(meds[win] < m for c, m in meds.items() if c != win)
+        assert int(win.split(":", 1)[0][1:]) > SYNTH_ID_BASE
+
+    def test_winner_verifies_on_every_backend(self):
+        """Byte-exact --verify for the committed winner on the local
+        oracle AND jax_sim (and pallas_fused interpret when the
+        composition is fusable — no staging rows)."""
+        from tpu_aggcomm.backends.jax_sim import JaxSimBackend
+        from tpu_aggcomm.synth import ensure_registered
+        blob = self._blob()
+        ensure_registered(REPO)
+        mid = blob["winner"]["method_id"]
+        cfg = blob["config"]
+        p = AggregatorPattern(
+            nprocs=8, cb_nodes=3, data_size=64, proc_node=1,
+            comm_size=cfg["comm_size"], placement=cfg["agg_type"])
+        sched = compile_method(mid, p)
+        recv_o, _ = run_schedule_local(sched, verify=True)
+        recv_s, _ = JaxSimBackend().run(sched, verify=True, iter_=0)
+        for a, b in zip(recv_o, recv_s):
+            if a is None:
+                assert b is None
+            else:
+                np.testing.assert_array_equal(a, np.asarray(b))
+        if sched.n_staging == 0 and not sched.collective:
+            from tpu_aggcomm.backends.pallas_fused import \
+                PallasFusedBackend
+            recv_f, _ = PallasFusedBackend(interpret=True).run(
+                sched, verify=True, iter_=0)
+            for a, b in zip(recv_o, recv_f):
+                if a is None:
+                    assert b is None
+                else:
+                    np.testing.assert_array_equal(a, np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# jax-free pins (the purity contract, executed)
+
+
+def test_full_pipeline_survives_poisoned_jax(tmp_path):
+    """The WHOLE synthetic pipeline — search, check-pruning, traffic
+    audit, registration, race, artifact write, then replay — must run
+    where ``import jax`` raises (shared recipe, tests/_jaxfree.py)."""
+    import _jaxfree
+    env = _jaxfree.poisoned_env(
+        tmp_path, "synth must not import jax")
+    out = tmp_path / "work"
+    out.mkdir()
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "synth", "-n", "8",
+         "-a", "3", "-c", "4", "-d", "64", "--init", "12",
+         "--mutate-rounds", "1", "--beam", "2", "--max-batches", "3",
+         "--synthetic", "250,m101*0.5", "--predict-root", str(out),
+         "--synth-root", str(out)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    path = out / "SYNTH_r01.json"
+    assert path.exists()
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "synth", "--replay",
+         str(path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "REPRODUCED" in r.stdout
+
+
+def test_committed_replay_survives_poisoned_jax(tmp_path):
+    """The exact ci_tier1.sh gate, under the poison."""
+    import _jaxfree
+    if not os.path.exists(COMMITTED_SYNTH):
+        pytest.skip("no committed SYNTH artifact")
+    env = _jaxfree.poisoned_env(
+        tmp_path, "synth --replay must not import jax")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "synth", "--replay",
+         COMMITTED_SYNTH],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "REPRODUCED" in r.stdout

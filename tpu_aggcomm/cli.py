@@ -87,6 +87,14 @@ def build_parser() -> argparse.ArgumentParser:
     bench.add_argument("--tune-root", default=".",
                        help="directory holding TUNE_*.json artifacts "
                             "(default: .)")
+    bench.add_argument("--synth-root", metavar="DIR", default=None,
+                       help="register the synthesized methods recorded in "
+                            "DIR's committed SYNTH_r*.json artifacts "
+                            "before resolving -m (tpu_aggcomm/synth/); "
+                            "implied with root '.' when -m falls in the "
+                            "reserved id range (> 100). Without it, "
+                            "output is byte-identical to a synth-less "
+                            "build")
     bench.add_argument("--results-csv", default="results.csv")
     bench.add_argument("--trace", metavar="PREFIX", default=None,
                        help="flight recorder: write PREFIX.trace.jsonl "
@@ -246,9 +254,10 @@ def build_parser() -> argparse.ArgumentParser:
     tn.add_argument("-d", dest="data_size", type=int, default=2048)
     tn.add_argument("-p", dest="proc_node", type=int, default=1)
     tn.add_argument("--backend", choices=BACKENDS, default="jax_sim",
-                    help="measured tuning rides the chained jax_sim "
-                         "scaffold; other values are only meaningful "
-                         "with --synthetic")
+                    help="measured tuning rides the chained jax_sim, "
+                         "pallas_fused or jax_shard (sharded-rank "
+                         "tier) scaffolds; other values are only "
+                         "meaningful with --synthetic")
     tn.add_argument("--methods", default="1,3",
                     help="comma-separated method ids (one direction "
                          "only; dead ids m=21/22 refused by name)")
@@ -303,6 +312,94 @@ def build_parser() -> argparse.ArgumentParser:
                          "backend, no jax); exits nonzero unless the "
                          "re-derivation matches the stored record "
                          "byte-for-byte")
+    tn.add_argument("--synth-root", metavar="DIR", default=None,
+                    help="register the synthesized methods recorded in "
+                         "DIR's SYNTH_r*.json before building the "
+                         "candidate space, so --methods may name them "
+                         "(implied with root '.' when a requested id "
+                         "is > 100)")
+
+    # synth — the schedule synthesizer (tpu_aggcomm/synth/)
+    sy = sub.add_parser(
+        "synth", help="schedule synthesizer (ROADMAP item 2): seeded "
+                      "search over primitive compositions (fan-in "
+                      "trees, multicast orders, relay staging, "
+                      "throttled chunking) pruned by the model checker "
+                      "and the static traffic audit, priced by the "
+                      "committed cost model, then RACED measured "
+                      "against every dispatched reference method of "
+                      "the same direction at the same cell. Writes a "
+                      "committed SYNTH_r*.json only when a synthesized "
+                      "schedule wins; --replay re-derives a committed "
+                      "artifact jax-free (the ci_tier1.sh gate)")
+    sy.add_argument("-n", "--nprocs", type=int, default=32)
+    sy.add_argument("-d", dest="data_size", type=int, default=2048)
+    sy.add_argument("-p", dest="proc_node", type=int, default=1)
+    sy.add_argument("-a", dest="cb_nodes", type=int, default=8,
+                    help="aggregator count of the synthesis cell "
+                         "(single value — one cell per artifact)")
+    sy.add_argument("-c", dest="comm_size", type=int, default=4,
+                    help="throttle of the synthesis cell (single value)")
+    sy.add_argument("-t", dest="agg_type", type=int, default=1)
+    sy.add_argument("--direction", choices=("a2m", "m2a"), default="a2m",
+                    help="schedule direction (default: a2m)")
+    sy.add_argument("--seed", type=int, default=0,
+                    help="search-sample + race-bootstrap seed (recorded; "
+                         "same config + seed = same artifact modulo "
+                         "timestamps)")
+    sy.add_argument("--backend", choices=("jax_sim",), default="jax_sim",
+                    help="measured racing rides the chained jax_sim "
+                         "scaffold (or pass --synthetic for jax-free)")
+    sy.add_argument("--init", type=int, default=32,
+                    help="seeded initial sample size from the "
+                         "composition space (default 32)")
+    sy.add_argument("--mutate-rounds", type=int, default=3,
+                    help="beam-mutation rounds after the initial sample")
+    sy.add_argument("--beam", type=int, default=4,
+                    help="survivors whose neighborhoods each mutation "
+                         "round expands")
+    sy.add_argument("--top-k", type=int, default=3,
+                    help="ranked finalists registered and raced "
+                         "(default 3)")
+    sy.add_argument("--fanins", default="2,4",
+                    help="comma-separated tree fan-in axis (default 2,4)")
+    sy.add_argument("--relays", default="0,2",
+                    help="comma-separated relay-staging axis "
+                         "(default 0,2)")
+    sy.add_argument("--max-batches", type=int, default=6)
+    sy.add_argument("--batch-trials", type=int, default=3)
+    sy.add_argument("--alpha", type=float, default=0.05)
+    sy.add_argument("--iters-small", type=int, default=50)
+    sy.add_argument("--iters-big", type=int, default=1050)
+    sy.add_argument("--windows", type=int, default=1)
+    sy.add_argument("--predict-root", metavar="DIR", default=".",
+                    help="where the newest committed PREDICT_*.json "
+                         "lives: its calibration prices the survivors "
+                         "(ranking prior only — the race decides; no "
+                         "artifact = structural ranking, recorded)")
+    sy.add_argument("--synth-root", metavar="DIR", default=".",
+                    help="where committed SYNTH_r*.json artifacts live: "
+                         "their ids are registered FIRST so a new run "
+                         "never reuses one, and the new artifact is "
+                         "written there (default: .)")
+    sy.add_argument("--out", metavar="PATH", default=None,
+                    help="artifact path (default: the first unused "
+                         "SYNTH_rNN.json under --synth-root)")
+    sy.add_argument("--id-base", type=int, default=None,
+                    help="first method id for this run's finalists "
+                         "(default: one past the highest registered "
+                         "synthesized id)")
+    sy.add_argument("--synthetic", metavar="SPEC", default=None,
+                    help="race a seeded synthetic latency model instead "
+                         "of measuring ('BASE_US[,mID*FACTOR]...', the "
+                         "tune flag): jax-free, CPU-smoke only — the "
+                         "artifact records it and replays identically")
+    sy.add_argument("--replay", metavar="SYNTH_JSON", default=None,
+                    help="re-derive a committed artifact jax-free: the "
+                         "search block from (config, seed, embedded "
+                         "params) and the race verdict from the "
+                         "recorded samples; exits nonzero unless both "
+                         "match byte-for-byte")
 
     # serve — the persistent aggregation server (tpu_aggcomm/serve/)
     sv = sub.add_parser(
@@ -498,6 +595,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "was launched with, so remaining-cell ETA "
                           "counts the right cells (default: the Theta "
                           "grid)")
+    ins.add_argument("--synth-root", metavar="DIR", default=None,
+                     help="'traffic'/'check': register the synthesized "
+                          "methods recorded in DIR's SYNTH_r*.json "
+                          "first, so -m may name one and the -m 0 "
+                          "sweeps include them (implied with root '.' "
+                          "when -m > 100); without it, output is "
+                          "byte-identical to a synth-less build")
 
     # analyze — summarize accumulated results.csv rows
     an = sub.add_parser(
@@ -980,6 +1084,19 @@ def _ints(csv_text: str) -> list[int]:
     return vals
 
 
+def _ensure_synth(args, methods=()) -> None:
+    """Register the synthesized methods committed under ``--synth-root``
+    before any METHODS lookup: explicitly when the flag was passed,
+    implicitly (root '.') when a requested id falls in the reserved
+    range. Without either, nothing is imported and every command's
+    output stays byte-identical to a synth-less build."""
+    root = getattr(args, "synth_root", None)
+    if root is None and not any(m is not None and m > 100 for m in methods):
+        return
+    from tpu_aggcomm.synth import ensure_registered
+    ensure_registered(root or ".")
+
+
 def _model_prune(args, cands):
     """The ``tune --model-prune`` block: price every candidate with the
     newest committed PREDICT_*.json and split the grid into kept/pruned
@@ -1112,6 +1229,7 @@ def _run_tune(args) -> int:
     cbs = _ints(args.cb_nodes)
     comms = _ints(args.comm_sizes)
     aggs = _ints(args.agg_types)
+    _ensure_synth(args, methods)
     try:
         cands = space_mod.build_space(methods, cbs, comms, aggs,
                                       nprocs=args.nprocs,
@@ -1148,13 +1266,23 @@ def _run_tune(args) -> int:
         except race_mod.RaceError as e:
             raise SystemExit(f"tune --synthetic: {e}")
     else:
-        if args.backend not in SINGLE_DEVICE_BACKENDS:
+        if args.backend not in SINGLE_DEVICE_BACKENDS \
+                and args.backend != "jax_shard":
             raise SystemExit(
                 f"tune: measured tuning rides the chained single-device "
                 f"scaffold (got --backend {args.backend}); pass "
-                f"--backend jax_sim or pallas_fused, or --synthetic SPEC "
-                f"for a backend-free run")
-        if args.backend == "pallas_fused":
+                f"--backend jax_sim, pallas_fused or jax_shard, or "
+                f"--synthetic SPEC for a backend-free run")
+        if args.backend == "jax_shard":
+            # the 16,384-rank-class tier: same chained differenced
+            # discipline, rank axis sharded over the device mesh
+            from tpu_aggcomm.tune.measure import make_jax_shard_sampler
+            sampler = make_jax_shard_sampler(
+                nprocs=args.nprocs, data_size=args.data_size,
+                proc_node=args.proc_node, iters_small=args.iters_small,
+                iters_big=args.iters_big, batch_trials=args.batch_trials,
+                windows=args.windows)
+        elif args.backend == "pallas_fused":
             from tpu_aggcomm.tune.measure import make_pallas_fused_sampler
             sampler = make_pallas_fused_sampler(
                 nprocs=args.nprocs, data_size=args.data_size,
@@ -1212,6 +1340,139 @@ def _run_tune(args) -> int:
     print(f"winner: {res.winner} (median {meds[res.winner] * 1e6:.2f} "
           f"us/rep) after {res.batches_run} batch(es)")
     print(f"tuned cache written: {path}")
+    return 0
+
+
+def _synth_params(args):
+    """Pricing inputs for the synth search: the newest committed
+    PREDICT_*.json's calibration for this platform (the _model_prune
+    platform pick), or (None, None) with a stderr note — an absent
+    model degrades to structural ranking, never blocks synthesis."""
+    import os
+
+    from tpu_aggcomm.model.artifact import load_artifact
+    from tpu_aggcomm.model.predict import newest_predict_path
+    from tpu_aggcomm.obs.ledger import manifest
+
+    path = newest_predict_path(args.predict_root)
+    if path is None:
+        print("synth: no committed PREDICT_*.json — ranking finalists "
+              "structurally", file=sys.stderr)
+        return None, None
+    try:
+        art = load_artifact(path)
+    except (OSError, ValueError) as e:
+        print(f"synth: unreadable {path}: {e} — ranking finalists "
+              f"structurally", file=sys.stderr)
+        return None, None
+    env = (manifest().get("env") or {})
+    platform = "tpu" if env.get("tunnel_armed") \
+        and env.get("jax_platforms") != "cpu" else "cpu"
+    block = (art.get("platforms") or {}).get(platform)
+    if not block:
+        print(f"synth: {os.path.basename(path)} has no {platform!r} "
+              f"calibration — ranking finalists structurally",
+              file=sys.stderr)
+        return None, None
+    return dict(block["params"]), \
+        f"{os.path.basename(path)} [{platform}]"
+
+
+def _run_synth(args) -> int:
+    """The schedule synthesizer (tpu_aggcomm/synth/): search -> register
+    -> measured race vs the reference field, or --replay re-deriving a
+    committed SYNTH_r*.json jax-free (the ci_tier1.sh gate)."""
+    import os
+
+    from tpu_aggcomm.synth import (SearchError, ensure_registered,
+                                   load_artifact, next_artifact_path,
+                                   replay_artifact, run_synth,
+                                   save_artifact)
+
+    if args.replay:
+        from tpu_aggcomm.obs.regress import validate_synth
+        try:
+            blob = load_artifact(args.replay)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"synth --replay: cannot read "
+                             f"{args.replay}: {e}")
+        errors = validate_synth(blob, os.path.basename(args.replay))
+        if errors:
+            for e in errors:
+                print(f"FAIL {e}", file=sys.stderr)
+            raise SystemExit(f"synth --replay: {args.replay} failed "
+                             f"schema validation ({len(errors)} "
+                             f"error(s))")
+        same, diffs = replay_artifact(args.replay)
+        win = (blob.get("winner") or {}).get("cid")
+        print(f"replay {os.path.basename(args.replay)}: "
+              f"{blob['search']['evaluated']} composition(s) "
+              f"re-searched, winner {win} -> "
+              f"{'REPRODUCED' if same else 'MISMATCH vs stored record'}")
+        for d in diffs:
+            print(f"  {d}")
+        return 0 if same else 1
+
+    from tpu_aggcomm.tune import race as race_mod
+
+    # committed ids first, so this run's finalists never collide
+    ensure_registered(args.synth_root)
+    params, params_source = _synth_params(args)
+
+    if args.synthetic:
+        try:
+            sampler = race_mod.make_synthetic_sampler(
+                args.synthetic, batch_trials=args.batch_trials,
+                seed=args.seed)
+        except race_mod.RaceError as e:
+            raise SystemExit(f"synth --synthetic: {e}")
+    else:
+        from tpu_aggcomm.tune.measure import make_jax_sim_sampler
+        sampler = make_jax_sim_sampler(
+            nprocs=args.nprocs, data_size=args.data_size,
+            proc_node=args.proc_node, iters_small=args.iters_small,
+            iters_big=args.iters_big, batch_trials=args.batch_trials,
+            windows=args.windows)
+
+    try:
+        art = run_synth(
+            nprocs=args.nprocs, cb_nodes=args.cb_nodes,
+            comm_size=args.comm_size, data_size=args.data_size,
+            proc_node=args.proc_node, agg_type=args.agg_type,
+            direction=args.direction, seed=args.seed, params=params,
+            params_source=params_source, init=args.init,
+            mutate_rounds=args.mutate_rounds, beam=args.beam,
+            top_k=args.top_k, fanins=tuple(_ints(args.fanins)),
+            relays=tuple(_ints(args.relays)), id_base=args.id_base,
+            sampler=sampler, backend=args.backend,
+            synthetic=args.synthetic, max_batches=args.max_batches,
+            batch_trials=args.batch_trials, alpha=args.alpha, log=print)
+    except SearchError as e:
+        raise SystemExit(f"synth: {e}")
+
+    race = art["race"]
+    for e in race["eliminations"]:
+        print(f"  batch {e['batch']}: {e['candidate']} out vs leader "
+              f"{e['leader']} "
+              f"(CI [{e['ci_pct'][0]:+.1f}%, {e['ci_pct'][1]:+.1f}%])")
+    for cid in race["survivors"]:
+        if cid != race["winner"]:
+            print(f"  survivor (not separable from winner at "
+                  f"alpha={args.alpha:g}): {cid}")
+    win = art["winner"]
+    print(f"winner: {win['cid']} (median {win['median_s'] * 1e6:.2f} "
+          f"us/rep) after {race['batches_run']} batch(es)")
+    if not win["synthesized"]:
+        print(f"synth: the reference method m={win['method_id']} won "
+              f"the race — no synthesized schedule beat the field at "
+              f"this cell, so no artifact is written (try another "
+              f"cell/seed)", file=sys.stderr)
+        return 1
+    print(f"  composition: {win['composition']} "
+          f"(predicted rank {win['predicted_rank']})")
+    out = args.out or next_artifact_path(args.synth_root)
+    save_artifact(out, art)
+    print(f"synth artifact written: {out}")
     return 0
 
 
@@ -1395,6 +1656,7 @@ def _run_inspect_traffic(args) -> int:
     if args.method is None:
         raise SystemExit("inspect traffic: -m is required "
                          "(-m 0 sweeps every method as a gate)")
+    _ensure_synth(args, [args.method])
     if args.method == 0:
         if args.json or args.trace or args.fault:
             raise SystemExit("inspect traffic: --json/--trace/--fault "
@@ -1467,6 +1729,7 @@ def _run_inspect_check(args) -> int:
     if args.method is None:
         raise SystemExit("inspect check: -m is required "
                          "(-m 0 sweeps every method as a gate)")
+    _ensure_synth(args, [args.method])
     if args.method == 0:
         if args.json or args.trace:
             raise SystemExit("inspect check: --json/--trace apply to a "
@@ -1960,10 +2223,13 @@ def main(argv=None) -> int:
         return _run_analyze(args)
     if args.command == "tune":
         return _run_tune(args)
+    if args.command == "synth":
+        return _run_synth(args)
     if args.command == "serve":
         return _run_serve(args)
 
     from tpu_aggcomm.harness.runner import ExperimentConfig, run_experiment
+    _ensure_synth(args, [args.method])
     nprocs = args.nprocs if args.nprocs is not None \
         else _default_nprocs(args.backend)
     if args.auto:

@@ -718,6 +718,12 @@ class MethodSpec:
     generator: Callable[[AggregatorPattern], Schedule]
     dispatched: bool = True  # False = dead code kept for parity
     tam: bool = False
+    #: Canonical composition string for synthesized methods
+    #: (tpu_aggcomm/synth/ — ids >= synth.SYNTH_ID_BASE); None for the
+    #: 22 reference methods. Carrying it HERE is what makes a winner a
+    #: first-class method: schedule_shape_key, caches, journals,
+    #: traffic, check, fuse, and serve consume the registry unchanged.
+    composition: str | None = None
 
 
 def _tam_generator(p: AggregatorPattern) -> Schedule:

@@ -252,9 +252,22 @@ def build_index(root: str = ".") -> dict:
                         "verdict": conf.get("verdict"),
                         "peak": conf.get("peak"),
                         "bound": conf.get("bound")})
+    synth = []
+    for rnd, path, blob in load_history(root, "SYNTH", errors=errors):
+        sr = blob.get("search") or {}
+        win = blob.get("winner") or {}
+        synth.append({"round": rnd, "file": os.path.basename(path),
+                      "config": blob.get("config"),
+                      "evaluated": sr.get("evaluated"),
+                      "pruned": sr.get("pruned"),
+                      "winner": win.get("cid"),
+                      "composition": win.get("composition"),
+                      "median_s": win.get("median_s"),
+                      "predicted_rank": win.get("predicted_rank")})
     return {"schema": HISTORY_SCHEMA, "root": os.path.abspath(root),
             "bench": bench, "multichip": multichip, "tune": tune,
             "traffic": traffic, "serve": serve_series(root, errors=errors),
+            "synth": synth,
             "traces": _trace_rows(root), "errors": errors}
 
 
@@ -457,6 +470,11 @@ def render_history(root: str = ".") -> str:
         verd = ", ".join(f"{t['file']}={t['verdict']}"
                          for t in index["traffic"])
         lines.append(f"traffic audits: {verd}")
+    for s in index["synth"]:
+        lines.append(f"synth: {s['file']} winner {s['winner']} "
+                     f"({s['composition']}) over {s['evaluated']} "
+                     f"composition(s), predicted rank "
+                     f"{s['predicted_rank']}")
     tr = index["traces"]
     if tr:
         faulted = sum(1 for t in tr if t.get("fault"))

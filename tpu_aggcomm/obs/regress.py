@@ -72,8 +72,8 @@ __all__ = ["validate_bench", "validate_multichip", "validate_tune",
            "parsed_schema_version", "DEFAULT_TOLERANCE",
            "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE", "TUNE_SCHEMAS",
            "TRAFFIC_SCHEMAS", "PREDICT_SCHEMAS", "COMPARE_SCHEMAS",
-           "SERVE_SCHEMAS", "validate_predict", "validate_compare",
-           "validate_serve"]
+           "SERVE_SCHEMAS", "SYNTH_SCHEMAS", "validate_predict",
+           "validate_compare", "validate_serve", "validate_synth"]
 
 #: Relative slowdown vs the best prior same-platform round that counts as
 #: a regression. Differenced-chain numbers jitter a few percent
@@ -1203,4 +1203,257 @@ def validate_serve(obj, where: str = "SERVE") -> list[str]:
     else:
         for k in ("batches", "max_batch", "batched_requests"):
             _require(batch, k, int, errors, f"{where}.batch")
+    return errors
+
+
+#: Accepted SYNTH artifact schema tags (tpu_aggcomm/synth/artifact.py,
+#: the ``cli synth`` output) — versioned like TUNE_SCHEMAS.
+SYNTH_SCHEMAS = ("synth-v1",)
+
+_SYNTH_ROW_VERDICTS = ("PROVEN", "REFUTED", "INVALID")
+
+
+def validate_synth(obj, where: str = "SYNTH") -> list[str]:
+    """Schema errors (empty list = valid) for one ``SYNTH_r*.json``
+    synthesis artifact (tpu_aggcomm/synth/). The internal-consistency
+    rule is the traffic/predict one, applied three times over: the
+    finalists must be the top of the PROVEN survivor ranking, the
+    registration block must bind exactly the finalists, and the winner
+    must be SYNTHESIZED, carry PROVEN/CONFORMS verdicts, match
+    ``race.winner``, and have the smallest pooled sample median among
+    the non-eliminated survivors — a winner whose own recorded race
+    contradicts it is schema-invalid."""
+    import statistics
+
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    schema = obj.get("schema")
+    if schema not in SYNTH_SCHEMAS:
+        errors.append(f"{where}: unknown schema tag {schema!r} "
+                      f"(expected one of {list(SYNTH_SCHEMAS)})")
+        return errors
+    for k, types in (("seed", int), ("backend", str)):
+        _require(obj, k, types, errors, where)
+    if "synthetic" in obj and obj["synthetic"] is not None \
+            and not isinstance(obj["synthetic"], str):
+        errors.append(f"{where}: 'synthetic' must be null or the spec "
+                      f"string")
+    cfg = obj.get("config")
+    if not isinstance(cfg, dict):
+        errors.append(f"{where}: missing/invalid 'config' object")
+    else:
+        for k in ("nprocs", "cb_nodes", "comm_size", "data_size",
+                  "proc_node", "agg_type"):
+            _require(cfg, k, int, errors, f"{where}.config")
+        _require(cfg, "direction", str, errors, f"{where}.config")
+    if "manifest" in obj and obj["manifest"] is not None \
+            and not isinstance(obj["manifest"], dict):
+        errors.append(f"{where}: 'manifest' must be null or an object")
+
+    # --- search block: rows, prune accounting, survivor ranking -------
+    sr = obj.get("search")
+    if not isinstance(sr, dict):
+        errors.append(f"{where}: missing/invalid 'search' object")
+        return errors
+    w = f"{where}.search"
+    for k in ("seed", "space_size", "evaluated", "init", "mutate_rounds",
+              "beam", "top_k"):
+        _require(sr, k, int, errors, w)
+    rows = sr.get("rows")
+    if not isinstance(rows, list) or not rows:
+        errors.append(f"{w}: 'rows' must be a non-empty list")
+        rows = []
+    by_comp: dict = {}
+    for i, r in enumerate(rows):
+        if not isinstance(r, dict):
+            errors.append(f"{w}.rows[{i}]: must be an object")
+            continue
+        comp = r.get("composition")
+        if not isinstance(comp, str) or not comp:
+            errors.append(f"{w}.rows[{i}]: missing composition string")
+            continue
+        by_comp[comp] = r
+        if r.get("verdict") not in _SYNTH_ROW_VERDICTS:
+            errors.append(f"{w}.rows[{i}]: verdict must be one of "
+                          f"{_SYNTH_ROW_VERDICTS}, got "
+                          f"{r.get('verdict')!r}")
+        pruned_by = r.get("pruned_by")
+        if pruned_by is not None and not isinstance(pruned_by, str):
+            errors.append(f"{w}.rows[{i}]: pruned_by must be null or "
+                          f"a named reason")
+        if r.get("verdict") in ("REFUTED", "INVALID") and not pruned_by:
+            errors.append(f"{w}.rows[{i}]: a {r.get('verdict')} row "
+                          f"must name its prune reason")
+    survivors = sr.get("survivors")
+    finalists = sr.get("finalists")
+    if not isinstance(survivors, list) or not isinstance(finalists, list):
+        errors.append(f"{w}: 'survivors' and 'finalists' must be lists")
+        survivors, finalists = [], []
+    for comp in survivors:
+        r = by_comp.get(comp)
+        if r is None:
+            errors.append(f"{w}: survivor {comp!r} has no row")
+        elif r.get("verdict") != "PROVEN" or r.get("pruned_by"):
+            errors.append(f"{w}: survivor {comp!r} is not an unpruned "
+                          f"PROVEN row — the ranking contradicts the "
+                          f"rows")
+    top_k = sr.get("top_k")
+    if isinstance(top_k, int) and finalists != survivors[:top_k]:
+        errors.append(f"{w}: finalists must be survivors[:top_k] "
+                      f"(ranked prefix), got {finalists}")
+    pruned = sr.get("pruned")
+    if not isinstance(pruned, dict):
+        errors.append(f"{w}: missing/invalid 'pruned' counters")
+    elif rows and all(isinstance(r, dict) for r in rows):
+        for kind, prefix in (("invalid", "build:"), ("check", "check:"),
+                             ("traffic", "traffic:"),
+                             ("dominated", "dominated:")):
+            n = sum(1 for r in rows
+                    if isinstance(r.get("pruned_by"), str)
+                    and r["pruned_by"].startswith(prefix))
+            if pruned.get(kind) != n:
+                errors.append(f"{w}.pruned[{kind!r}]: counter "
+                              f"{pruned.get(kind)!r} != {n} rows with "
+                              f"'{prefix}' reasons")
+
+    # --- registration block: exactly the finalists, ids > 100 ---------
+    reg = obj.get("registration")
+    if not isinstance(reg, dict) or not reg:
+        errors.append(f"{where}: missing/invalid 'registration' object")
+        reg = {}
+    mids = []
+    for mid_text, entry in reg.items():
+        try:
+            mid = int(mid_text)
+        except (TypeError, ValueError):
+            errors.append(f"{where}.registration: id {mid_text!r} is "
+                          f"not an int")
+            continue
+        mids.append(mid)
+        if mid <= 100:
+            errors.append(f"{where}.registration: id {mid} is outside "
+                          f"the reserved synthesized range (> 100)")
+        if not isinstance(entry, dict) \
+                or not isinstance(entry.get("composition"), str):
+            errors.append(f"{where}.registration[{mid_text}]: missing "
+                          f"composition")
+    reg_comps = [reg[str(m)].get("composition") for m in sorted(mids)
+                 if isinstance(reg.get(str(m)), dict)]
+    if finalists and reg_comps != finalists:
+        errors.append(f"{where}: registration compositions {reg_comps} "
+                      f"!= search finalists {finalists}")
+
+    # --- race block: the tune-v1 discipline ---------------------------
+    race = obj.get("race")
+    if not isinstance(race, dict):
+        errors.append(f"{where}: missing/invalid 'race' object")
+        return errors
+    w = f"{where}.race"
+    for k, types in (("seed", int), ("alpha", float), ("n_boot", int),
+                     ("max_batches", int), ("winner", str),
+                     ("batches_run", int)):
+        _require(race, k, types, errors, w)
+    samples = race.get("samples")
+    if not isinstance(samples, dict) or not samples:
+        errors.append(f"{w}: 'samples' must be a non-empty object "
+                      f"(cid -> list of batches)")
+        samples = {}
+    for cid, batches in samples.items():
+        if not isinstance(batches, list) or not all(
+                isinstance(b, list) and b and all(_is_num(x) for x in b)
+                for b in batches):
+            errors.append(f"{w}.samples[{cid!r}]: every batch must be "
+                          f"a non-empty list of numbers")
+    order = race.get("order")
+    if order is not None:
+        if not isinstance(order, list) \
+                or sorted(order) != sorted(samples):
+            errors.append(f"{w}: 'order' must list exactly the sampled "
+                          f"candidate ids")
+    winner_cid = race.get("winner")
+    if samples and isinstance(winner_cid, str) \
+            and winner_cid not in samples:
+        errors.append(f"{w}: winner {winner_cid!r} has no recorded "
+                      f"samples")
+    elims = race.get("eliminations")
+    eliminated: set = set()
+    if not isinstance(elims, list):
+        errors.append(f"{w}: 'eliminations' must be a list")
+        elims = []
+    for i, e in enumerate(elims):
+        if not isinstance(e, dict):
+            errors.append(f"{w}.eliminations[{i}]: must be an object")
+            continue
+        for k in ("batch", "candidate", "leader", "ci_pct"):
+            if k not in e:
+                errors.append(f"{w}.eliminations[{i}]: missing {k!r}")
+        eliminated.add(e.get("candidate"))
+        for k in ("candidate", "leader"):
+            if samples and e.get(k) is not None \
+                    and e.get(k) not in samples:
+                errors.append(f"{w}.eliminations[{i}]: {k} "
+                              f"{e.get(k)!r} has no recorded samples")
+
+    # --- winner: synthesized, verdicts carried, race-consistent -------
+    win = obj.get("winner")
+    if not isinstance(win, dict):
+        errors.append(f"{where}: missing/invalid 'winner' object")
+        return errors
+    w = f"{where}.winner"
+    for k, types in (("cid", str), ("method_id", int),
+                     ("median_s", (int, float)), ("synthesized", bool)):
+        _require(win, k, types, errors, w)
+    if isinstance(win.get("cid"), str) and isinstance(winner_cid, str) \
+            and win["cid"] != winner_cid:
+        errors.append(f"{w}: cid {win['cid']!r} disagrees with "
+                      f"race.winner {winner_cid!r}")
+    if win.get("synthesized") is not True:
+        errors.append(f"{w}: a committed artifact's winner must be "
+                      f"synthesized — a reference-method win is not an "
+                      f"artifact")
+    else:
+        mid = win.get("method_id")
+        entry = reg.get(str(mid)) if isinstance(mid, int) else None
+        if not isinstance(entry, dict):
+            errors.append(f"{w}: method_id {mid!r} is not in the "
+                          f"registration block")
+        elif entry.get("composition") != win.get("composition"):
+            errors.append(f"{w}: composition {win.get('composition')!r} "
+                          f"!= registration[{mid}] "
+                          f"{entry.get('composition')!r}")
+        if win.get("check_verdict") != "PROVEN":
+            errors.append(f"{w}: check_verdict must be 'PROVEN', got "
+                          f"{win.get('check_verdict')!r}")
+        if win.get("traffic_verdict") != "CONFORMS":
+            errors.append(f"{w}: traffic_verdict must be 'CONFORMS', "
+                          f"got {win.get('traffic_verdict')!r}")
+    # the race must actually support the winner: smallest pooled median
+    # among the non-eliminated candidates, and median_s must BE that
+    # pooled median of its own samples
+    if isinstance(winner_cid, str) and winner_cid in samples:
+        try:
+            meds = {cid: statistics.median(
+                        [x for b in batches for x in b])
+                    for cid, batches in samples.items()
+                    if isinstance(batches, list) and any(
+                        isinstance(b, list) and b for b in batches)}
+        except (TypeError, statistics.StatisticsError):
+            meds = {}
+        if meds:
+            if winner_cid in meds and _is_num(win.get("median_s")) \
+                    and abs(win["median_s"] - meds[winner_cid]) \
+                    > 1e-12 * max(1.0, abs(meds[winner_cid])):
+                errors.append(f"{w}: median_s {win.get('median_s')!r} "
+                              f"!= pooled sample median "
+                              f"{meds[winner_cid]!r}")
+            for cid, m in meds.items():
+                if cid in eliminated or cid == winner_cid:
+                    continue
+                if winner_cid in meds and m < meds[winner_cid]:
+                    errors.append(
+                        f"{w}: non-eliminated candidate {cid!r} has a "
+                        f"smaller pooled median ({m!r}) than the "
+                        f"winner ({meds[winner_cid]!r}) — the verdict "
+                        f"contradicts its own samples")
     return errors

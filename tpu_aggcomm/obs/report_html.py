@@ -278,6 +278,61 @@ def _tune_rows(root: str) -> list[dict]:
     return rows
 
 
+def _synth_rows(root: str) -> list[dict]:
+    """Synthesis pane data from every SYNTH_r*.json under the history
+    root — jax-free (obs/history.py discovery + statistics): the seeded
+    search funnel (evaluated vs pruned, by prune class), the finalist
+    compositions with their predicted ranks, and the measured race
+    outcome. Schema-invalid artifacts become error rows, not crashes."""
+    import statistics
+
+    from tpu_aggcomm.obs.history import load_history
+    from tpu_aggcomm.obs.regress import validate_synth
+
+    rows = []
+    load_errors: list[str] = []
+    for _rnd, path, blob in load_history(root, "SYNTH",
+                                         errors=load_errors):
+        name = os.path.basename(path)
+        errors = validate_synth(blob, name)
+        if errors:
+            rows.append({"file": name, "error": errors[0]})
+            continue
+        sr = blob["search"]
+        race = blob["race"]
+        medians = {cid: statistics.median([x for b in batches for x in b])
+                   for cid, batches in race["samples"].items()
+                   if any(batches)}
+        rank_of = {r["composition"]: r.get("rank")
+                   for r in sr["rows"] if r.get("rank") is not None}
+        reg = blob["registration"]
+        finalists = [{"method_id": int(m), "composition": c,
+                      "predicted_rank": rank_of.get(c)}
+                     for m, c in sorted(((m, e["composition"])
+                                         for m, e in reg.items()),
+                                        key=lambda t: int(t[0]))]
+        rows.append({
+            "file": name, "error": None, "config": blob["config"],
+            "backend": blob.get("backend"),
+            "synthetic": blob.get("synthetic"),
+            "seed": blob.get("seed"),
+            "space_size": sr.get("space_size"),
+            "evaluated": sr.get("evaluated"), "pruned": sr.get("pruned"),
+            "finalists": finalists,
+            "winner": blob["winner"],
+            "winner_cid": race["winner"],
+            "batches_run": race.get("batches_run"),
+            "order": race.get("order") or list(race["samples"]),
+            "medians": medians,
+            "eliminations": [
+                {"batch": e.get("batch"), "candidate": e.get("candidate"),
+                 "leader": e.get("leader"), "ci_pct": e.get("ci_pct")}
+                for e in race.get("eliminations", [])]})
+    for msg in load_errors:
+        rows.append({"file": msg.split(":", 1)[0], "error": msg})
+    return rows
+
+
 def _explain_rows(root: str) -> dict | None:
     """Cost-model pane data from the newest committed ``PREDICT_*.json``
     (model/artifact.py) — jax-free. None when no artifact exists (the
@@ -315,6 +370,7 @@ def build_payload(history_root: str = ".",
     runs = _trace_runs(list(trace_paths or []))
     return {"bench": bench, "multichip": multichip,
             "tune": _tune_rows(history_root),
+            "synth": _synth_rows(history_root),
             "runs": runs,
             "degradation": _degradation_rows(runs),
             "explain": _explain_rows(history_root),
@@ -354,6 +410,8 @@ time; lower is better everywhere (seconds per rep).</p>
 <div id="ledger"></div>
 <h2>Autotuner cache (winner per shape)</h2>
 <div id="tune"></div>
+<h2>Schedule synthesis (searched &rarr; proven &rarr; raced)</h2>
+<div id="synth"></div>
 <h2>Per-method skew table (trace runs)</h2>
 <div id="skew"></div>
 <h2>Straggler heatmaps (rank &times; round, mean seconds)</h2>
@@ -649,6 +707,83 @@ function fmtS(v) {{
       tbl.appendChild(tr);
     }});
     host.appendChild(tbl);
+  }});
+}})();
+
+(function synthPane() {{
+  var host = document.getElementById("synth");
+  var rows = DATA.synth || [];
+  if (!rows.length) {{
+    host.appendChild(el("p", {{class: "note"}},
+        "no SYNTH_r*.json artifacts under the history root " +
+        "(run `cli synth` to search, prove and race new schedules)"));
+    return;
+  }}
+  rows.forEach(function (s) {{
+    if (s.error) {{
+      host.appendChild(el("p", {{class: "err"}},
+          "synth artifact error: " + s.error));
+      return;
+    }}
+    var c = s.config;
+    var head = el("p", {{}});
+    head.appendChild(el("b", {{}}, s.file));
+    head.appendChild(document.createTextNode(
+        " — n=" + c.nprocs + " d=" + c.data_size + " a=" + c.cb_nodes +
+        " c=" + c.comm_size + " " + c.direction + " [" + s.backend + "]" +
+        (s.synthetic ? " (synthetic)" : "") +
+        "  seed " + s.seed));
+    host.appendChild(head);
+    var p = s.pruned || {{}};
+    host.appendChild(el("p", {{class: "note"}},
+        "search funnel: " + s.evaluated + "/" + s.space_size +
+        " compositions evaluated — pruned " +
+        (p.invalid || 0) + " invalid, " + (p.check || 0) +
+        " check-REFUTED, " + (p.traffic || 0) + " over traffic bound, " +
+        (p.dominated || 0) + " dominated; " + s.finalists.length +
+        " finalist(s) registered"));
+    var ftbl = el("table");
+    var fhr = el("tr");
+    ["method id", "composition", "predicted rank", "raced"]
+      .forEach(function (h, i) {{
+        fhr.appendChild(el("th", i === 1 ? {{class: "l"}} : {{}}, h)); }});
+    ftbl.appendChild(fhr);
+    // raced rank: order of pooled medians over the full field
+    var ranked = (s.order || []).slice().sort(function (a, b) {{
+      var ma = s.medians[a], mb = s.medians[b];
+      return (ma === undefined ? 1e99 : ma) -
+             (mb === undefined ? 1e99 : mb); }});
+    s.finalists.forEach(function (f) {{
+      var tr = el("tr");
+      tr.appendChild(el("td", {{}}, "m" + f.method_id));
+      tr.appendChild(el("td", {{class: "l"}}, f.composition));
+      tr.appendChild(el("td", {{}},
+          f.predicted_rank === null || f.predicted_rank === undefined
+            ? "-" : "#" + f.predicted_rank));
+      var cid = null;
+      (s.order || []).forEach(function (o) {{
+        if (o.indexOf("m" + f.method_id + ":") === 0) cid = o; }});
+      var pos = cid === null ? -1 : ranked.indexOf(cid);
+      tr.appendChild(el("td", {{}},
+          pos < 0 ? "-" : "#" + (pos + 1) + " of " + ranked.length +
+          (s.medians[cid] !== undefined
+             ? " (" + fmtS(s.medians[cid]) + ")" : "")));
+      ftbl.appendChild(tr);
+    }});
+    host.appendChild(ftbl);
+    var w = s.winner || {{}};
+    var wp = el("p", {{}});
+    wp.appendChild(el("b", {{}}, "race winner: " + s.winner_cid));
+    wp.appendChild(document.createTextNode(
+        " after " + s.batches_run + " batch(es)" +
+        (w.synthesized
+           ? " — SYNTHESIZED (" + w.composition + "), check " +
+             w.check_verdict + ", traffic " + w.traffic_verdict +
+             ", predicted rank #" + w.predicted_rank
+           : " — reference method") +
+        (w.median_s !== undefined
+           ? ", median " + fmtS(w.median_s) : "")));
+    host.appendChild(wp);
   }});
 }})();
 

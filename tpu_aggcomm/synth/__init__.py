@@ -1,0 +1,34 @@
+"""Schedule synthesizer: generate, prove, price, and race new
+aggregator schedules (ROADMAP item 2 — the HiCCL-style composition
+of primitives, arxiv 2408.05962).
+
+The package composes every prior subsystem and is jax-free end to end
+(``synth`` is in analysis/lint.py PURE_PACKAGES; tests/test_synth.py
+pins it with a poisoned-jax subprocess): primitives emit per-rank op
+programs in the existing Schedule IR, the search prunes with
+``analysis/check.py`` verdicts and ``obs/traffic.py`` bounds, prices
+with ``model/predict.py``, and the measured arbitration rides the
+tuner's seeded racing (``tune/race.py`` — the only jax on the path,
+and only at artifact-build time; ``synth --replay`` re-derives the
+whole search + race jax-free).
+"""
+
+from tpu_aggcomm.synth.artifact import (SYNTH_SCHEMA, load_artifact,
+                                        next_artifact_path,
+                                        reference_methods, replay_artifact,
+                                        run_synth, save_artifact)
+from tpu_aggcomm.synth.primitives import (Composition, CompositionError,
+                                          build_schedule,
+                                          parse_composition)
+from tpu_aggcomm.synth.register import (SYNTH_ID_BASE, RegisterError,
+                                        ensure_registered,
+                                        register_composition,
+                                        registered_synth_ids)
+from tpu_aggcomm.synth.search import SearchError, enumerate_space, search
+
+__all__ = ["Composition", "CompositionError", "parse_composition",
+           "build_schedule", "SearchError", "enumerate_space", "search",
+           "SYNTH_ID_BASE", "RegisterError", "register_composition",
+           "registered_synth_ids", "ensure_registered", "SYNTH_SCHEMA",
+           "run_synth", "save_artifact", "load_artifact",
+           "replay_artifact", "next_artifact_path", "reference_methods"]

@@ -1,0 +1,124 @@
+"""Registration: synthesized winners become first-class METHODS entries.
+
+Ids >= :data:`SYNTH_ID_BASE` are the reserved synthesized range; each
+registered id carries its canonical composition string in
+``MethodSpec.composition`` and compiles through the ordinary
+``compile_method`` path, so every downstream consumer —
+``schedule_shape_key``, the compiled/tuned/served caches, resume
+journals, ``inspect traffic``/``inspect check`` sweeps, fuse export,
+and the serve layer — works on a synthesized method with zero special
+cases.
+
+Registration is OPT-IN and side-effect-explicit: importing this module
+registers nothing, and the CLI only scans committed ``SYNTH_r*.json``
+artifacts when ``--synth-root`` is passed (or when a requested method
+id falls in the synthesized range), so every existing command's output
+stays byte-identical without the flag. Re-registering the same
+(id, composition, direction) is an idempotent no-op; a conflicting
+re-registration is a named error — an id that silently changed meaning
+would alias every cache keyed by it.
+
+jax-free: core.methods / core.pattern are numpy-only, so registration
+(and artifact replay through it) runs where a wedged tunnel hangs
+``import jax``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from tpu_aggcomm.core.methods import METHODS, MethodSpec
+from tpu_aggcomm.core.pattern import Direction
+from tpu_aggcomm.synth.primitives import build_schedule, parse_composition
+
+__all__ = ["SYNTH_ID_BASE", "RegisterError", "register_composition",
+           "registered_synth_ids", "ensure_registered"]
+
+#: First id of the reserved synthesized method range. 100 itself is the
+#: search-phase placeholder (synth/search.py UNREGISTERED_ID); winners
+#: get 101, 102, … from the committed artifact's registration block.
+SYNTH_ID_BASE = 100
+
+
+class RegisterError(ValueError):
+    """Refused registration (reserved-range violation or a conflicting
+    id reuse), with both sides named."""
+
+
+def register_composition(composition, *, method_id: int,
+                         direction: str = "a2m",
+                         name: str | None = None) -> MethodSpec:
+    """Install one composition as ``METHODS[method_id]`` and return the
+    spec. ``composition`` may be a canonical string or a Composition."""
+    comp = composition if hasattr(composition, "canonical") \
+        else parse_composition(composition)
+    canon = comp.canonical()
+    mid = int(method_id)
+    if mid <= SYNTH_ID_BASE:
+        raise RegisterError(
+            f"method id {mid} is outside the synthesized range "
+            f"(ids must be > SYNTH_ID_BASE={SYNTH_ID_BASE}; the base "
+            f"itself is the unregistered search placeholder)")
+    short = {"a2m": Direction.ALL_TO_MANY, "m2a": Direction.MANY_TO_ALL}
+    try:
+        direc = short.get(str(direction)) or Direction(direction)
+    except ValueError:
+        raise RegisterError(f"unknown direction {direction!r}") from None
+    existing = METHODS.get(mid)
+    if existing is not None:
+        if (existing.composition == canon
+                and existing.direction is direc):
+            return existing  # idempotent re-registration
+        raise RegisterError(
+            f"method id {mid} is already registered as "
+            f"{existing.composition or existing.name!r} "
+            f"({existing.direction.value}); refusing to rebind it to "
+            f"{canon!r} ({direc.value}) — a reused id would alias every "
+            f"shape-keyed cache")
+
+    def _generator(p, _comp=comp, _mid=mid, _name=name):
+        return build_schedule(_comp, p, method_id=_mid, name=_name)
+
+    spec = MethodSpec(mid, name or f"Synthesized {canon}", direc,
+                      _generator, composition=canon)
+    METHODS[mid] = spec
+    return spec
+
+
+def registered_synth_ids() -> list[int]:
+    """Currently-registered synthesized method ids, sorted."""
+    return sorted(m for m, s in METHODS.items()
+                  if m > SYNTH_ID_BASE and s.composition is not None)
+
+
+def ensure_registered(root: str = ".", *, quiet: bool = True) -> dict:
+    """Register every method recorded in the ``registration`` blocks of
+    the committed ``SYNTH_r*.json`` artifacts under ``root`` (sorted
+    path order, so later artifacts see earlier ids already bound).
+    Returns ``{method_id: composition}`` for everything registered or
+    already present. Unreadable artifacts are skipped with a named
+    stderr note (never silently) — a broken artifact must not take the
+    registry down with it."""
+    import sys
+
+    out: dict[int, str] = {}
+    for path in sorted(glob.glob(os.path.join(root, "SYNTH_r*.json"))):
+        try:
+            with open(path, encoding="utf-8") as f:
+                blob = json.load(f)
+            reg = blob.get("registration") or {}
+            for mid_text, entry in sorted(reg.items(),
+                                          key=lambda kv: int(kv[0])):
+                spec = register_composition(
+                    entry["composition"], method_id=int(mid_text),
+                    direction=entry.get("direction", "a2m"),
+                    name=entry.get("name"))
+                out[spec.method_id] = spec.composition
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"synth: skipping unreadable artifact {path}: {e}",
+                  file=sys.stderr)
+            if not quiet:
+                raise
+    return out

@@ -20,7 +20,7 @@ the same environment computes.
 from __future__ import annotations
 
 __all__ = ["record_device_facts", "make_jax_sim_sampler",
-           "make_pallas_fused_sampler"]
+           "make_pallas_fused_sampler", "make_jax_shard_sampler"]
 
 
 def record_device_facts() -> None:
@@ -51,6 +51,39 @@ def make_jax_sim_sampler(*, nprocs: int, data_size: int, proc_node: int,
 
     record_device_facts()
     backend = JaxSimBackend()
+    schedules: dict[str, object] = {}
+
+    def sampler(cid: str, batch: int) -> list[float]:
+        if cid not in schedules:
+            c = parse_cid(cid)
+            schedules[cid] = compile_method(c.method, AggregatorPattern(
+                nprocs=nprocs, cb_nodes=c.cb_nodes,
+                data_size=max(data_size, 1), proc_node=proc_node,
+                comm_size=c.comm_size, placement=c.agg_type))
+        return backend.measure_trial_samples(
+            schedules[cid], iters_small=iters_small, iters_big=iters_big,
+            trials=batch_trials, windows=windows)
+
+    return sampler
+
+
+def make_jax_shard_sampler(*, nprocs: int, data_size: int, proc_node: int,
+                           iters_small: int = 50, iters_big: int = 1050,
+                           batch_trials: int = 3, windows: int = 1):
+    """``sampler(cid, batch) -> list[float]`` over the XLA-partitioned
+    multi-device tier — the 16,384-rank-class scaffold: fresh chained
+    differenced trials through ``JaxShardBackend.measure_trial_samples``
+    (compiled chains memoized per candidate, samples never cached). The
+    backend's own refusals propagate by name: TAM candidates have no
+    round chain here, and staged (dead-link-repaired) schedules are
+    refused in the table lowering by design — race those on jax_sim."""
+    from tpu_aggcomm.backends.jax_shard import JaxShardBackend
+    from tpu_aggcomm.core.methods import compile_method
+    from tpu_aggcomm.core.pattern import AggregatorPattern
+    from tpu_aggcomm.tune.space import parse_cid
+
+    record_device_facts()
+    backend = JaxShardBackend()
     schedules: dict[str, object] = {}
 
     def sampler(cid: str, batch: int) -> list[float]:
