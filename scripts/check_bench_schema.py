@@ -30,7 +30,7 @@ from tpu_aggcomm.obs.regress import (parsed_schema_version, validate_bench,
                                      validate_compare, validate_multichip,
                                      validate_predict, validate_serve,
                                      validate_synth, validate_traffic,
-                                     validate_tune)
+                                     validate_tune, validate_workload)
 
 
 def check(root: str) -> int:
@@ -131,6 +131,31 @@ def check(root: str) -> int:
         n_synth += 1
         n_errors += 1
         print(f"FAIL {e}")
+    # WORKLOAD_r*.json workload profiles (obs/workload.py, workload-v1):
+    # discovered through load_history like the serve rounds; every
+    # aggregate must re-derive float-exactly from the artifact's own
+    # per_request rows, or it fails here
+    n_workload = 0
+    workload_errors: list[str] = []
+    for rnd, path, blob in load_history(root, "WORKLOAD",
+                                        errors=workload_errors):
+        n_files += 1
+        n_workload += 1
+        errors = validate_workload(blob, os.path.basename(path))
+        if errors:
+            n_errors += len(errors)
+            for e in errors:
+                print(f"FAIL {e}")
+        else:
+            req = blob.get("requests") or {}
+            print(f"ok   {os.path.basename(path)} "
+                  f"({blob.get('schema', '?')}, "
+                  f"{req.get('admitted', '?')} admitted)")
+    for e in workload_errors:
+        n_files += 1
+        n_workload += 1
+        n_errors += 1
+        print(f"FAIL {e}")
     from tpu_aggcomm.tune.cache import tune_paths
     for path in tune_paths(root):
         n_files += 1
@@ -185,8 +210,8 @@ def check(root: str) -> int:
         print(f"FAIL no BENCH_r*/MULTICHIP_r*.json found under {root}")
         return 1
     print(f"{n_files} artifact(s) ({n_tune} tune, {n_traffic} traffic, "
-          f"{n_model} model/compare, {n_serve} serve, {n_synth} synth), "
-          f"{n_errors} schema error(s)")
+          f"{n_model} model/compare, {n_serve} serve, {n_synth} synth, "
+          f"{n_workload} workload), {n_errors} schema error(s)")
     return 1 if n_errors else 0
 
 

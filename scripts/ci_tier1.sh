@@ -143,5 +143,21 @@ python scripts/chaos_smoke.py || post_rc=1
 # REPRODUCED carrying a drain record, and --recover must pre-warm the
 # cache so the first same-shape request is a HIT (scripts/serve_smoke.py).
 python scripts/serve_smoke.py || post_rc=1
+# workload-profiler gate (obs/workload.py, jax-free): the committed
+# serve-journal exemplar must profile cleanly (phase attribution
+# float-exact by construction — wall_s IS the sum of its recorded
+# boundary durations, validate_workload re-derives every aggregate from
+# the per_request rows), and every committed WORKLOAD_r*.json must
+# --replay to REPRODUCED from the journal named inside it — the same
+# replay discipline as tune/PREDICT/SYNTH. An artifact whose profile
+# cannot reproduce must not steer tuning or synthesis proposals.
+if [ -e serve_exemplar.journal.jsonl ]; then
+  python -m tpu_aggcomm.cli inspect workload serve_exemplar.journal.jsonl \
+    > /dev/null || post_rc=1
+fi
+for f in WORKLOAD_r*.json; do
+  [ -e "$f" ] || continue
+  python -m tpu_aggcomm.cli inspect workload --replay "$f" || post_rc=1
+done
 if [ "$rc" -eq 0 ]; then rc=$post_rc; fi
 exit $rc

@@ -44,6 +44,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import subprocess
 import sys
 import threading
@@ -95,6 +96,69 @@ def _quant(samples: list[float]) -> dict | None:
             "p99": percentile(samples, 99.0)}
 
 
+def shape_spec(shape: dict) -> str:
+    """Inverse of :func:`parse_shape`: a canonical spec string for the
+    records (letter order fixed so two runs spell one shape one way)."""
+    rev = {v: k for k, v in _LETTER.items()}
+    toks = [f"{rev[f]}{shape[f]}" for f in
+            ("method", "nprocs", "cb_nodes", "comm_size", "data_size",
+             "proc_node", "agg_type", "barrier_type") if f in shape]
+    if shape.get("fault"):
+        toks.append(f"fault={shape['fault']}")
+    return " ".join(toks)
+
+
+def build_plan(args) -> list[dict]:
+    """The seeded request plan: ``[{"i", "at_s", "shape"}, ...]``.
+
+    Pure function of the flags (and, with ``--workload``, of the
+    committed artifact): same inputs in ⟹ byte-identical plan out —
+    the open-loop schedule is decided HERE, up front, never inside the
+    firing threads. ``--workload WORKLOAD_r*.json`` replaces the
+    burst/gap menu with ``obs.workload.workload_scenario`` (the
+    measured shape mix + arrival process re-injected under the
+    artifact's seed unless ``--seed`` overrides); otherwise ``--seed``
+    drives per-burst shape picks and a bounded arrival jitter
+    (``uniform(0, gap/4)``) so ordering is reproducible run-to-run."""
+    if args.workload:
+        from tpu_aggcomm.obs.workload import (WORKLOAD_SCHEMA,
+                                              workload_scenario)
+        try:
+            with open(args.workload) as fh:
+                blob = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"serve_loadgen: unreadable --workload "
+                             f"artifact {args.workload!r}: {e}")
+        if blob.get("schema") != WORKLOAD_SCHEMA:
+            raise SystemExit(f"serve_loadgen: {args.workload!r} is not a "
+                             f"{WORKLOAD_SCHEMA} artifact (schema "
+                             f"{blob.get('schema')!r})")
+        try:
+            return workload_scenario(blob, seed=args.seed,
+                                     requests=args.requests)
+        except ValueError as e:
+            raise SystemExit(f"serve_loadgen: {e}")
+    shapes = [parse_shape(s) for s in args.shapes]
+    burst = max(1, args.burst)
+    gap_s = args.gap_ms / 1e3
+    n = 32 if args.requests is None else args.requests
+    rng = random.Random(args.seed) if args.seed is not None else None
+    plan: list[dict] = []
+    shape = shapes[0]
+    for i in range(n):
+        if i % burst == 0:
+            shape = (shapes[rng.randrange(len(shapes))] if rng is not None
+                     else shapes[(i // burst) % len(shapes)])
+        if args.rate is not None:
+            at = i / args.rate
+        else:
+            at = (i // burst) * gap_s
+            if rng is not None and gap_s > 0:
+                at += rng.uniform(0.0, gap_s / 4.0)
+        plan.append({"i": i, "at_s": at, "shape": dict(shape)})
+    return plan
+
+
 def spawn_server(args) -> tuple[subprocess.Popen, int]:
     """Start ``cli serve`` as a child and parse its ready line."""
     cmd = [sys.executable, "-m", "tpu_aggcomm.cli", "serve",
@@ -134,27 +198,22 @@ def probe_server(port: int, timeout: float) -> dict:
                          f"spent)")
 
 
-def run_load(args, port: int) -> dict:
-    """Fire the open-loop schedule; returns the summary record."""
-    shapes = [parse_shape(s) for s in args.shapes]
-    burst = max(1, args.burst)
-    gap_s = args.gap_ms / 1e3
-    n = args.requests
+def run_load(args, port: int, plan: list[dict]) -> dict:
+    """Fire the pre-built open-loop plan; returns the summary record.
+
+    The plan is fixed up front (:func:`build_plan`) — a slow server
+    eats queueing delay in its latency numbers, it does not slow the
+    offered load."""
+    n = len(plan)
     t_start = time.monotonic()
-    if args.rate is not None:
-        # fixed-rate open-loop train: request i at t0 + i/R, shapes
-        # cycling per-burst so same-shape batches still form
-        arrivals = [t_start + i / args.rate for i in range(n)]
-    else:
-        arrivals = [t_start + (i // burst) * gap_s for i in range(n)]
     records: list[dict | None] = [None] * n
 
     def fire(i: int) -> None:
-        shape = shapes[(i // burst) % len(shapes)]
-        delay = arrivals[i] - time.monotonic()
+        item = plan[i]
+        delay = t_start + item["at_s"] - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        fields = dict(shape, iter=i, verify=args.verify)
+        fields = dict(item["shape"], iter=i, verify=args.verify)
         if args.deadline_ms is not None:
             fields["deadline_ms"] = args.deadline_ms
         t0 = time.monotonic()
@@ -219,7 +278,14 @@ def run_load(args, port: int) -> dict:
         "cold": {"n": len(cold), "samples": cold, "p50":
                  percentile(cold, 50.0) if cold else None},
         "cache": stats["cache"], "batch": stats["batch"],
-        "shapes": list(args.shapes)}
+        # the seed + plan make the run a replayable scenario: same
+        # flags (and same --workload artifact) re-derive this plan
+        # byte-for-byte (serve_smoke.py pins it)
+        "seed": args.seed,
+        "workload": (os.path.basename(args.workload)
+                     if args.workload else None),
+        "plan": plan,
+        "shapes": sorted({shape_spec(p["shape"]) for p in plan})}
 
 
 def write_artifact(path: str, summary: dict) -> str:
@@ -245,7 +311,22 @@ def main(argv=None) -> int:
                           "(default when no --port is given)")
     ap.add_argument("--backend", default="jax_sim",
                     choices=("jax_sim", "pallas_fused"))
-    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--requests", type=int, default=None,
+                    help="request count (default 32; with --workload, "
+                         "the artifact's admitted count)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="seed the plan: per-burst shape picks and a "
+                         "bounded arrival jitter (uniform(0, gap/4)) "
+                         "become reproducible run-to-run; recorded in "
+                         "SERVE_r*.json (with --workload, overrides the "
+                         "artifact's seed)")
+    ap.add_argument("--workload", metavar="WORKLOAD_rNN.json",
+                    default=None,
+                    help="re-inject a measured workload: replace the "
+                         "burst/gap menu with the artifact's shape mix "
+                         "+ arrival process (obs.workload."
+                         "workload_scenario — same artifact + seed in "
+                         "⟹ byte-identical request sequence out)")
     ap.add_argument("--burst", type=int, default=8,
                     help="same-shape requests per open-loop arrival burst "
                          "(default 8 — the batching opportunity)")
@@ -283,6 +364,7 @@ def main(argv=None) -> int:
                      help="write ./SERVE_rNN.json")
     args = ap.parse_args(argv)
 
+    plan = build_plan(args)
     proc = None
     if args.port is None:
         proc, port = spawn_server(args)
@@ -290,7 +372,7 @@ def main(argv=None) -> int:
         port = args.port
         probe_server(port, min(args.timeout, 30.0))
     try:
-        summary = run_load(args, port)
+        summary = run_load(args, port, plan)
     finally:
         if proc is not None:
             try:
@@ -311,7 +393,7 @@ def main(argv=None) -> int:
         print(f"serve_loadgen: wrote {path}", file=sys.stderr)
 
     line = {k: v for k, v in summary.items()
-            if k not in ("samples",)}      # the one-line summary stays short
+            if k not in ("samples", "plan")}  # the one-line summary stays short
     line["warm"] = {"n": summary["warm"]["n"], "p50": summary["warm"]["p50"]}
     line["cold"] = {"n": summary["cold"]["n"], "p50": summary["cold"]["p50"]}
     print(json.dumps({"serve_loadgen": "v2", **line}))

@@ -3,23 +3,29 @@ compiles, batch correctly, survive overload by NAMED shedding, drain
 cleanly on SIGTERM, and recover from its own journal — CPU-only,
 auditable from its artifacts.
 
-Four legs, each driving the real entry points in subprocesses:
+Five legs, each driving the real entry points in subprocesses:
 
 1. **Warm/cold** (unchanged contract): 32 mixed-shape ``--verify``
    requests through ``scripts/serve_loadgen.py --spawn`` — all complete
    byte-exact, exactly 4 compiles serve 4 shapes, batching engages,
    warm p50 is >= 10x below cold p50, the serve-v2 artifact passes
    ``obs/regress.validate_serve``, exactly ONE stdout JSON line.
-2. **Overload**: a server bounded at ``--max-queue 4`` takes a burst of
+2. **Workload** (the PR 16 end-to-end pin): ``inspect workload`` over
+   leg 1's journal — every request's phase attribution sums
+   float-exactly to its wall, the WORKLOAD artifact passes
+   ``validate_workload`` and ``--replay``s to REPRODUCED, and
+   ``serve_loadgen --workload`` re-injects the measured mix with a
+   byte-identical seeded request sequence.
+3. **Overload**: a server bounded at ``--max-queue 4`` takes a burst of
    32 concurrent same-shape requests while the first cold compile
    blocks the executor — every request must come back (no hangs):
    either ``ok`` + verified byte-exact, or a framed ``SHED[...]``
    response naming the reason; at least one queue-full shed must occur
    (the bound is 4, the burst is 32).
-3. **Drain**: SIGTERM to that server — it must exit rc 0, and its
+4. **Drain**: SIGTERM to that server — it must exit rc 0, and its
    journal must ``replay_journal`` to REPRODUCED with a drain record
    whose counts the entries re-derive.
-4. **Recover**: a fresh ``cli serve --recover JOURNAL`` must report the
+5. **Recover**: a fresh ``cli serve --recover JOURNAL`` must report the
    replay on its ready line and pre-warm the compiled-chain cache, so
    the first same-shape request lands as a cache HIT.
 
@@ -165,6 +171,88 @@ def leg_warm_cold(tmp: str) -> int:
     return 0
 
 
+def leg_workload(tmp: str) -> int:
+    """The PR 16 end-to-end pin, over the warm/cold leg's journal:
+    ``inspect workload`` phase attribution sums float-exactly to each
+    request's wall, the WORKLOAD artifact validates + replays to
+    REPRODUCED, and ``serve_loadgen --workload`` re-injects it with a
+    byte-identical seeded request sequence."""
+    journal = os.path.join(tmp, "serve.journal.jsonl")
+    art = os.path.join(tmp, "WORKLOAD_r01.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "workload",
+         journal, "--seed", "0", "--json", art],
+        cwd=REPO, capture_output=True, text=True, env=cpu_env())
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-2000:])
+        return fail(f"inspect workload exited {r.returncode}:\n"
+                    f"{r.stdout[-2000:]}")
+    try:
+        with open(art) as fh:
+            blob = json.load(fh)
+    except (OSError, ValueError) as e:
+        return fail(f"workload artifact unreadable: {e}")
+
+    # -- phase attribution sums float-exactly to each request's wall -------
+    from tpu_aggcomm.obs.workload import BOUNDARIES, workload_scenario
+    rows = blob.get("per_request") or []
+    if len(rows) != 32:
+        return fail(f"profiled {len(rows)} requests, expected the "
+                    f"warm/cold leg's 32")
+    for row in rows:
+        phases = row["phases"]
+        want = sum(phases[b] for b in BOUNDARIES if b in phases)
+        if row["wall_s"] != want:
+            return fail(f"request {row['rid']}: wall_s {row['wall_s']!r} "
+                        f"!= canonical phase sum {want!r} — attribution "
+                        f"must be float-exact")
+        if row["status"] == "done" and set(phases) != set(BOUNDARIES[1:]):
+            return fail(f"completed request {row['rid']} missing phase "
+                        f"boundaries: {sorted(phases)}")
+
+    # -- the artifact validates and replays like committed history ---------
+    from tpu_aggcomm.obs.regress import validate_workload
+    errors = validate_workload(blob, os.path.basename(art))
+    if errors:
+        return fail("artifact failed validate_workload:\n  "
+                    + "\n  ".join(errors))
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "workload",
+         "--replay", art],
+        cwd=REPO, capture_output=True, text=True, env=cpu_env())
+    if r.returncode != 0 or "REPRODUCED" not in r.stdout:
+        return fail(f"workload replay not REPRODUCED (rc {r.returncode}):"
+                    f"\n{r.stdout[-2000:]}")
+
+    # -- re-inject the measured workload as a seeded scenario --------------
+    out2 = os.path.join(tmp, "SERVE_workload.json")
+    r = subprocess.run(
+        [sys.executable, "scripts/serve_loadgen.py", "--spawn",
+         "--workload", art, "--requests", "6", "--max-batch", "4",
+         "--batch-window-ms", "50", "--verify", "--out", out2],
+        cwd=REPO, capture_output=True, text=True, env=cpu_env())
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-2000:])
+        return fail(f"serve_loadgen --workload exited {r.returncode}")
+    try:
+        with open(out2) as fh:
+            reinject = json.load(fh)
+    except (OSError, ValueError) as e:
+        return fail(f"re-injection artifact unreadable: {e}")
+    want_plan = workload_scenario(blob, requests=6)
+    if json.dumps(reinject.get("plan")) != json.dumps(want_plan):
+        return fail("re-injected plan is not byte-identical to "
+                    "workload_scenario over the same artifact + seed")
+    if reinject.get("workload") != os.path.basename(art) \
+            or reinject.get("completed") != 6:
+        return fail(f"re-injection accounting off: {reinject.get('workload')!r}, "
+                    f"{reinject.get('completed')}/6 completed")
+    print(f"serve-smoke: workload leg PASS — 32 requests attributed "
+          f"float-exact, artifact valid + REPRODUCED, 6-request "
+          f"re-injection byte-identical", file=sys.stderr)
+    return 0
+
+
 def leg_overload_drain_recover(tmp: str) -> int:
     from tpu_aggcomm.serve.protocol import ServeClient
     from tpu_aggcomm.serve.recover import replay_journal
@@ -296,11 +384,14 @@ def main() -> int:
     rc = leg_warm_cold(tmp)
     if rc:
         return rc
+    rc = leg_workload(tmp)
+    if rc:
+        return rc
     rc = leg_overload_drain_recover(tmp)
     if rc:
         return rc
-    print("serve-smoke: PASS — warm/cold, overload, drain and recover "
-          "legs all hold", file=sys.stderr)
+    print("serve-smoke: PASS — warm/cold, workload, overload, drain "
+          "and recover legs all hold", file=sys.stderr)
     return 0
 
 

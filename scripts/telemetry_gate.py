@@ -17,6 +17,15 @@ Three checks over COMMITTED artifacts only (no backend, no sweep):
    and the ``trend`` block inside ``obs.regress.check_regression`` must
    agree verdict-for-verdict on the shared series (same artifacts, same
    seed ⟹ same verdict: the regression-gate seed discipline).
+4. **Serve batch gauges vs the workload profiler** — replay every
+   committed ``WORKLOAD_r*.json`` artifact's dispatched batches through
+   the server's own cumulative gauge arithmetic
+   (``tpu_aggcomm_serve_batch_fill_ratio`` /
+   ``tpu_aggcomm_serve_padding_waste_bytes`` — the identical
+   ``obs.workload`` helpers serve/server.py imports), render through a
+   fresh ``MetricsRegistry`` and demand the parsed final values equal
+   the profiler's batching block float-for-float: the /metrics numbers
+   ARE the profiler's numbers, never a reimplementation.
 
 Usage: ``python scripts/telemetry_gate.py [root]`` (default repo root).
 Prints one line per check; exits nonzero on any failure.
@@ -115,6 +124,69 @@ def check_trend_consistency(root: str) -> int:
     return bad
 
 
+def check_workload_gauges(root: str) -> int:
+    """Gauge parity: server batch gauges vs the workload profiler.
+
+    The server updates the two batch gauges cumulatively after every
+    dispatched batch; the profiler re-derives the same totals from the
+    journal. Replaying the committed artifact's ``per_batch`` rows in
+    seq order through a fresh registry must land the final gauge values
+    exactly on the artifact's batching block — ``==`` on floats, the
+    check-2 discipline."""
+    from tpu_aggcomm.obs.history import load_history
+    from tpu_aggcomm.obs.workload import batch_fill_ratio
+    errors: list[str] = []
+    hist = load_history(root, "WORKLOAD", errors=errors)
+    bad = 0
+    for e in errors:
+        print(f"FAIL workload: {e}")
+        bad += 1
+    if not hist:
+        print("ok   workload gauges: no committed WORKLOAD_r*.json — "
+              "check inactive")
+        return bad
+    for _rnd, path, blob in hist:
+        name = os.path.basename(path)
+        batching = blob.get("batching") or {}
+        per_batch = batching.get("per_batch") or []
+        if not per_batch:
+            print(f"ok   {name}: no dispatched batches — gauges never set")
+            continue
+        reg = export.MetricsRegistry()
+        req = slots = waste = 0
+        for b in sorted(per_batch, key=lambda b: b["seq"]):
+            req += b["n"]
+            slots += b["padded"]
+            waste += b["waste_bytes"]
+            ratio = batch_fill_ratio(req, slots)
+            if ratio is not None:
+                reg.gauge("tpu_aggcomm_serve_batch_fill_ratio", ratio)
+            reg.gauge("tpu_aggcomm_serve_padding_waste_bytes",
+                      float(waste))
+        text = reg.render()
+        errs = validate_openmetrics(text)
+        if errs:
+            for e in errs:
+                print(f"FAIL {name}: openmetrics: {e}")
+            bad += len(errs)
+            continue
+        samples = _sample_map(parse_openmetrics(text))
+        for gauge, want in (
+                ("tpu_aggcomm_serve_batch_fill_ratio",
+                 batching.get("fill_ratio")),
+                ("tpu_aggcomm_serve_padding_waste_bytes",
+                 float(batching.get("padding_waste_bytes", 0)))):
+            got = samples.get((gauge, ()))
+            if got != want:
+                print(f"FAIL {name}: {gauge} renders {got!r} but the "
+                      f"profiler's batching block says {want!r}")
+                bad += 1
+        if not bad:
+            print(f"ok   {name}: batch gauges float-exact vs profiler "
+                  f"({len(per_batch)} batches)")
+    return bad
+
+
 def main(root: str) -> int:
     traces = sorted(glob.glob(os.path.join(root, "*.trace.jsonl")))
     if not traces:
@@ -124,6 +196,7 @@ def main(root: str) -> int:
     for path in traces:
         n_bad += check_trace(path)
     n_bad += check_trend_consistency(root)
+    n_bad += check_workload_gauges(root)
     print(f"{len(traces)} trace(s) checked, {n_bad} failure(s)")
     return 1 if n_bad else 0
 

@@ -1,0 +1,367 @@
+"""Workload-profiler tests (obs/workload.py + the serve journal's
+phase-boundary stamps — ISSUE 16).
+
+The pins that define the subsystem:
+
+- **Phase stamps attribute float-exact**: every request the server
+  journals carries the full admit → queue → batch → cache → dispatch →
+  respond boundary set, and a row's ``wall_s`` IS the canonical sum of
+  its phase durations (the validate_serve discipline: float-exact by
+  identical computation, never tolerance).
+- **Artifacts are self-proving**: ``WORKLOAD_r*.json`` validates
+  (``obs.regress.validate_workload``), replays REPRODUCED from the
+  journal named inside it, and every corruption — a mutated wall, a
+  contradicted aggregate, a bogus status — is named, not absorbed.
+- **Seeded determinism**: same journal + seed ⟹ byte-identical profile
+  and byte-identical re-injection plan (the tune/regress seed
+  discipline).
+- **Crash honesty**: a SIGKILL-torn journal tail is skipped line-wise,
+  and an admitted request with no terminal record is named ``lost`` —
+  the serve/recover.py semantics, never silent.
+- **Monotone or named**: reordered phase stamps are refused by NAME
+  (rid + the offending boundaries) by the profiler AND by
+  ``serve/recover.replay_journal`` — one attribution arithmetic.
+- **jax-free**: obs/workload.py and ``cli inspect workload`` run where
+  ``import jax`` raises (poisoned-jax subprocess, the obs discipline —
+  profiling a journal must work exactly where a wedged tunnel hangs).
+"""
+
+import json
+import subprocess
+import sys
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+import _jaxfree
+
+REPO = _jaxfree.REPO
+
+from tpu_aggcomm.obs.regress import validate_workload
+from tpu_aggcomm.obs.workload import (BOUNDARIES, attribute_phases,
+                                      batch_fill_ratio, padded_slots,
+                                      profile_journal, replay_workload,
+                                      workload_scenario, write_workload)
+from tpu_aggcomm.resilience.journal import RunJournal
+from tpu_aggcomm.serve.protocol import ServeClient
+from tpu_aggcomm.serve.server import ScheduleServer
+
+_SHAPE = {"method": 3, "nprocs": 8, "cb_nodes": 2, "comm_size": 2,
+          "data_size": 64}
+
+
+@pytest.fixture
+def fake_executor(monkeypatch):
+    """The real serve/executor with instant fakes — the journal's phase
+    stamps come from the control plane, which is what's under test."""
+    from tpu_aggcomm.serve import executor
+
+    def fake_build(schedule, backend_name):
+        return object(), 1e-3
+
+    def fake_exec(chain, reqs):
+        return [{"verified": True if r.verify else None, "error": None}
+                for r in reqs]
+
+    monkeypatch.setattr(executor, "build_chain", fake_build)
+    monkeypatch.setattr(executor, "execute_batch", fake_exec)
+
+
+# ---------------------------------------------------------------------------
+# The server side: journal records carry the full boundary set.
+
+
+def test_server_journal_phases_attribute_float_exact(fake_executor,
+                                                     tmp_path):
+    jpath = tmp_path / "serve.journal.jsonl"
+    srv = ScheduleServer(port=0, max_batch=2, batch_window_s=0.01,
+                         journal_path=str(jpath))
+    srv.start()
+    try:
+        results = []
+
+        def fire(i):
+            with ServeClient(srv.port, timeout=120.0) as c:
+                results.append(c.run(**dict(_SHAPE, iter=i)))
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        assert len(results) == 6 and all(r["ok"] for r in results)
+    finally:
+        srv.stop()
+        srv.close()
+
+    profile = profile_journal([str(jpath)])
+    assert profile["problems"] == []
+    req = profile["requests"]
+    assert req["admitted"] == 6 and req["completed"] == 6
+    assert req["lost"] == []
+    for row in profile["per_request"]:
+        assert row["status"] == "done"
+        # every completed request traversed every boundary...
+        assert set(row["phases"]) == set(BOUNDARIES[1:])
+        assert all(d >= 0 for d in row["phases"].values())
+        # ...and wall_s IS the canonical sum — identical expression,
+        # so == on floats is the test
+        assert row["wall_s"] == sum(
+            row["phases"][b] for b in BOUNDARIES if b in row["phases"])
+        assert isinstance(row["queue_depth"], int)
+        assert row["batch"] is not None and row["batch"]["n"] >= 1
+    # batch accounting closes: the per-batch rows partition the requests
+    b = profile["batching"]
+    assert b["requests_batched"] == 6
+    assert b["padded_slots"] == sum(e["padded"] for e in b["per_batch"])
+    assert b["fill_ratio"] == batch_fill_ratio(6, b["padded_slots"])
+
+
+def test_padded_slots_mirrors_executor():
+    # jax_sim pads multi-request batches to the next power of two;
+    # singletons and pallas_fused execute unpadded (serve/executor.py)
+    assert [padded_slots(n, "jax_sim") for n in (1, 2, 3, 5, 8)] \
+        == [1, 2, 4, 8, 8]
+    assert padded_slots(5, "pallas_fused") == 5
+    assert batch_fill_ratio(0, 0) is None
+    assert batch_fill_ratio(3, 4) == 0.75
+
+
+# ---------------------------------------------------------------------------
+# Synthetic journals: deterministic stamps for artifact-level pins.
+
+
+def _write_journal(path, rows, *, torn_tail=False, lost_rid=None):
+    j = RunJournal(str(path))
+    fp = j.begin_session({"jax": "0.0-test"})
+    t0 = 1_700_000_000.0
+    for i, stamps in enumerate(rows):
+        j.record({"request": i}, fingerprint=fp, status="admitted",
+                 shape=dict(_SHAPE), backend="jax_sim", iter=i,
+                 t_unix=t0 + 0.05 * i, queue_depth=i % 3)
+        j.record({"request": i}, fingerprint=fp, status="done",
+                 latency_s=stamps.get("respond"), batch_n=1, cache="hit",
+                 phases=dict(stamps), batch_seq=i, batch_padded=1,
+                 queue_depth=None)
+    if lost_rid is not None:
+        j.record({"request": lost_rid}, fingerprint=fp,
+                 status="admitted", shape=dict(_SHAPE),
+                 backend="jax_sim", t_unix=t0 + 99.0, queue_depth=0)
+    if torn_tail:
+        with open(path, "a") as fh:
+            fh.write('{"key": {"request": 500}, "status": "don')
+    return path
+
+
+def _stamps(scale=1.0):
+    return {"admit": 0.0, "queue": 0.001 * scale, "batch": 0.002 * scale,
+            "cache": 0.0021 * scale, "dispatch": 0.004 * scale,
+            "respond": 0.0042 * scale}
+
+
+def test_artifact_validates_replays_and_names_corruption(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl",
+                           [_stamps(1 + 0.3 * i) for i in range(9)])
+    profile = profile_journal([str(jpath)])
+    assert profile["problems"] == []
+    art = tmp_path / "WORKLOAD_r07.json"
+    blob = write_workload(str(art), profile)
+    assert validate_workload(blob) == []
+    rep = replay_workload(str(art))
+    assert rep["verdict"] == "REPRODUCED", rep["problems"]
+
+    # corruption probes: every self-contradiction must be NAMED
+    def probe(mutate, want):
+        bad = json.loads(json.dumps(blob))
+        mutate(bad)
+        errs = validate_workload(bad)
+        assert errs and any(want in e for e in errs), (want, errs)
+
+    probe(lambda b: b["per_request"][0].__setitem__("wall_s", 1.0),
+          "wall_s")
+    probe(lambda b: b["per_request"][0].__setitem__("status", "bogus"),
+          "status")
+    probe(lambda b: b["batching"].__setitem__("fill_ratio", 0.5),
+          "batching")
+    probe(lambda b: b.__setitem__("problems", ["oops"]),
+          "must not be committed")
+    # ...and a doctored artifact must fail --replay with the key named
+    doctored = json.loads(json.dumps(blob))
+    doctored["arrivals"]["rps"] = 1e9
+    with open(tmp_path / "WORKLOAD_r08.json", "w") as fh:
+        json.dump(doctored, fh)
+    rep = replay_workload(str(tmp_path / "WORKLOAD_r08.json"))
+    assert rep["verdict"] == "MISMATCH"
+    assert any("arrivals" in p for p in rep["problems"])
+
+
+def test_seeded_determinism_profile_and_scenario(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl",
+                           [_stamps(1 + 0.5 * i) for i in range(8)])
+    a = profile_journal([str(jpath)], seed=3)
+    b = profile_journal([str(jpath)], seed=3)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    blob = write_workload(str(tmp_path / "WORKLOAD_r01.json"), a)
+    # the re-injection plan is a pure function of (artifact, seed)
+    p1 = workload_scenario(blob, seed=5, requests=12)
+    p2 = workload_scenario(blob, seed=5, requests=12)
+    assert json.dumps(p1) == json.dumps(p2)
+    assert len(p1) == 12 and p1[0]["at_s"] == 0.0
+    assert all(x["at_s"] <= y["at_s"] for x, y in zip(p1, p1[1:]))
+    # default request count = the artifact's admitted count
+    assert len(workload_scenario(blob)) == 8
+
+
+def test_torn_tail_skipped_and_lost_named(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl",
+                           [_stamps(), _stamps(2.0)],
+                           torn_tail=True, lost_rid=99)
+    profile = profile_journal([str(jpath)])
+    # the torn line vanished (line-granular crash safety), the admitted-
+    # but-never-finished request is named lost — never silently dropped
+    req = profile["requests"]
+    assert req["admitted"] == 3 and req["completed"] == 2
+    assert req["lost"] == [99]
+    lost_row = [r for r in profile["per_request"] if r["rid"] == 99][0]
+    assert lost_row["status"] == "lost" and lost_row["phases"] == {}
+
+
+def test_non_monotone_phases_named_by_profiler_and_recover(tmp_path):
+    bad = {"admit": 0.0, "queue": 0.05, "cache": 0.02, "respond": 0.06}
+    phases, problems = attribute_phases(bad)
+    assert any("monotone" in p for p in problems)
+    # the recorded prefix still attributes (honest partial accounting)
+    assert phases["queue"] == 0.05
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl",
+                           [_stamps(), bad])
+    profile = profile_journal([str(jpath)])
+    assert any("request 1" in p and "monotone" in p
+               for p in profile["problems"])
+    # serve/recover runs the SAME arithmetic and refuses by name too
+    from tpu_aggcomm.serve.recover import replay_journal
+    rep = replay_journal(str(jpath))
+    assert rep["verdict"] == "MISMATCH"
+    assert any("request 1" in p and "monotone" in p
+               for p in rep["problems"])
+
+
+# ---------------------------------------------------------------------------
+# The loadgen plan (scripts/serve_loadgen.py): pure and seeded.
+
+
+def _loadgen():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "serve_loadgen", f"{REPO}/scripts/serve_loadgen.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _args(**over):
+    base = dict(workload=None, seed=None, requests=None, rate=None,
+                burst=8, gap_ms=30.0,
+                shapes=["m3 n8 a2 c4 d64", "m11 n8 a2 c8 d64"])
+    base.update(over)
+    return SimpleNamespace(**base)
+
+
+def test_loadgen_plan_seeded_and_reinjects_workload(tmp_path):
+    lg = _loadgen()
+    # seeded normal mode: byte-identical plans, jittered arrivals
+    p1 = lg.build_plan(_args(seed=7, requests=16))
+    p2 = lg.build_plan(_args(seed=7, requests=16))
+    assert json.dumps(p1) == json.dumps(p2)
+    assert len(p1) == 16
+    # unseeded mode cycles shapes deterministically with no jitter
+    p0 = lg.build_plan(_args(requests=16))
+    assert [it["at_s"] for it in p0] == \
+        [(i // 8) * 0.03 for i in range(16)]
+    # --workload mode IS workload_scenario — same artifact + seed in,
+    # byte-identical sequence out
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl",
+                           [_stamps(1 + i) for i in range(8)])
+    blob = write_workload(str(tmp_path / "WORKLOAD_r01.json"),
+                          profile_journal([str(jpath)]))
+    plan = lg.build_plan(_args(workload=str(tmp_path / "WORKLOAD_r01.json"),
+                               seed=None, requests=6))
+    assert json.dumps(plan) == json.dumps(
+        workload_scenario(blob, requests=6))
+    wrong = tmp_path / "not_a_workload.json"
+    wrong.write_text(json.dumps({"schema": "serve-v1"}))
+    with pytest.raises(SystemExit, match="workload-v1"):
+        lg.build_plan(_args(workload=str(wrong)))
+
+
+def test_shape_spec_roundtrips_parse_shape():
+    lg = _loadgen()
+    for spec in ("m3 n8 a2 c4 d64", "m11 n8 a2 c8 d64 p1"):
+        assert lg.shape_spec(lg.parse_shape(spec)) == spec
+
+
+# ---------------------------------------------------------------------------
+# Discovery + the jax-free pins.
+
+
+def test_history_discovers_workload_series(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl",
+                           [_stamps(1 + i) for i in range(8)])
+    write_workload(str(tmp_path / "WORKLOAD_r02.json"),
+                   profile_journal([str(jpath)]))
+    from tpu_aggcomm.obs.history import build_index, workload_series
+    series = workload_series(str(tmp_path))
+    pts = series["workload padding waste"]
+    assert len(pts) == 1 and pts[0]["round"] == 2
+    assert pts[0]["unit"] == "B" and pts[0]["samples_n"] == 8
+    idx = build_index(str(tmp_path))
+    assert [w["file"] for w in idx["workload"]] == ["WORKLOAD_r02.json"]
+    assert "workload padding waste" in idx["workload_series"]
+    from tpu_aggcomm.obs.history import check_trends
+    assert "workload padding waste" in check_trends(str(tmp_path))["series"]
+
+
+def test_workload_profiler_is_jaxfree(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl",
+                           [_stamps(1 + i) for i in range(8)])
+    code = (
+        _jaxfree.pure_import_code("tpu_aggcomm.obs.workload") +
+        "; from tpu_aggcomm.obs.workload import profile_journal, "
+        "write_workload, replay_workload"
+        f"; p = profile_journal([{str(jpath)!r}])"
+        "; assert p['problems'] == [] and p['requests']['admitted'] == 8"
+        f"; write_workload({str(tmp_path / 'WORKLOAD_r01.json')!r}, p)"
+        f"; r = replay_workload({str(tmp_path / 'WORKLOAD_r01.json')!r})"
+        "; assert r['verdict'] == 'REPRODUCED', r['problems']"
+        "; import sys; assert 'jax' not in sys.modules")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=str(tmp_path),
+        env=_jaxfree.poisoned_env(
+            tmp_path, "the workload profiler must run where a wedged "
+                      "tunnel hangs import jax"),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_cli_inspect_workload_is_jaxfree(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl",
+                           [_stamps(1 + i) for i in range(8)])
+    env = _jaxfree.poisoned_env(
+        tmp_path, "inspect workload must answer on a wedged tunnel")
+    art = tmp_path / "WORKLOAD_r03.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "workload",
+         str(jpath), "--seed", "0", "--json", str(art)],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "workload profile over" in proc.stdout
+    assert "workload artifact written" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "workload",
+         "--replay", str(art)],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "REPRODUCED" in proc.stdout

@@ -475,7 +475,7 @@ def build_parser() -> argparse.ArgumentParser:
                                                  "report", "ledger",
                                                  "traffic", "check",
                                                  "live", "history",
-                                                 "explain"],
+                                                 "explain", "workload"],
                      default=None,
                      help="'trace' to summarize *.trace.jsonl files, "
                           "'compare' to diff two of them, 'report' for "
@@ -498,7 +498,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "(tpu_aggcomm/model/, jax-free): "
                           "predicted-vs-measured round walls with NAMED "
                           "divergence verdicts over flight-recorder "
-                          "traces — instead of a compiled schedule")
+                          "traces — instead of a compiled schedule, "
+                          "'workload' for the serve-journal workload "
+                          "profiler (obs/workload.py, jax-free): "
+                          "per-request phase attribution, shape mix, "
+                          "arrival process, batch efficiency, advisory "
+                          "hot-shape/skew proposals")
     ins.add_argument("trace_file", nargs="*", default=[],
                      help="trace files: one or more to summarize "
                           "('trace'), exactly two files or directories to "
@@ -506,7 +511,8 @@ def build_parser() -> argparse.ArgumentParser:
                           "dashboard ('report'); for 'ledger': "
                           "BENCH_r*.json and/or *.trace.jsonl artifacts "
                           "(default: every BENCH_r*.json under "
-                          "--history-root)")
+                          "--history-root); for 'workload': one or more "
+                          "serve journals (*.journal.jsonl)")
     ins.add_argument("--by", choices=["rank", "round", "phase"],
                      default="rank",
                      help="compare grouping key (default: rank)")
@@ -570,14 +576,24 @@ def build_parser() -> argparse.ArgumentParser:
                           "history-v1 index (atomic_write); 'explain': "
                           "write the calibrated predict-v1 artifact "
                           "(PREDICT_*.json); 'compare': write the "
-                          "machine-readable compare-v1 delta")
-    ins.add_argument("--replay", metavar="PREDICT_JSON", default=None,
-                     help="'explain' only: re-derive the committed "
+                          "machine-readable compare-v1 delta; "
+                          "'workload': write the workload-v1 profile "
+                          "(WORKLOAD_*.json)")
+    ins.add_argument("--replay", metavar="ARTIFACT_JSON", default=None,
+                     help="'explain': re-derive the committed "
                           "predict-v1 artifact from its recorded inputs "
                           "+ seed and byte-compare (REPRODUCED or "
                           "MISMATCH naming the divergent keys — the "
                           "same contract as tune --replay; ci_tier1.sh "
-                          "gates every committed PREDICT_*.json)")
+                          "gates every committed PREDICT_*.json); "
+                          "'workload': re-derive WORKLOAD_r*.json from "
+                          "the journals recorded next to it (same "
+                          "contract; ci_tier1.sh gates the committed "
+                          "exemplar)")
+    ins.add_argument("--seed", type=int, default=0,
+                     help="'workload' only: seed recorded in the "
+                          "profile and used by the advisory detector + "
+                          "scenario re-injection (default: 0)")
     ins.add_argument("--results-csv", default="results.csv",
                      help="'live' only: the running sweep's results CSV "
                           "— its crash-safe journal "
@@ -1860,6 +1876,54 @@ def _run_inspect_explain(args) -> int:
     return 0
 
 
+def _run_inspect_workload(args) -> int:
+    """The serve-journal workload profiler (obs/workload.py, jax-free).
+
+    Two modes: ``--replay WORKLOAD_r*.json`` re-derives a committed
+    artifact from the journals recorded next to it (REPRODUCED or
+    MISMATCH with the diverging keys named — the ci_tier1.sh gate);
+    ``workload JOURNAL...`` profiles one or more serve journals
+    (``--json PATH`` writes the workload-v1 artifact, refused while the
+    journal disagrees with itself). Detection is advisory: proposals
+    name tune/synth targets, nothing changes behavior. Exit 1 on any
+    profiler problem — a journal that contradicts itself must fail
+    loudly, never average the contradiction away."""
+    from tpu_aggcomm.obs.workload import (profile_journal, render_workload,
+                                          replay_workload, write_workload)
+    if args.replay:
+        try:
+            res = replay_workload(args.replay)
+        except (OSError, ValueError, KeyError) as e:
+            raise SystemExit(f"inspect workload --replay: {e}")
+        if res["verdict"] == "REPRODUCED":
+            print(f"workload replay: REPRODUCED ({args.replay})")
+            return 0
+        print(f"workload replay: MISMATCH vs {args.replay}")
+        for p in res["problems"]:
+            print(f"  {p}")
+        return 1
+
+    if not args.trace_file:
+        raise SystemExit("inspect workload: missing serve journal(s) "
+                         "(*.journal.jsonl written by `cli serve "
+                         "--journal` / serve_loadgen.py)")
+    try:
+        profile = profile_journal(args.trace_file, seed=args.seed)
+    except OSError as e:
+        raise SystemExit(f"inspect workload: unreadable journal: {e}")
+    print(render_workload(profile), end="")
+    if profile["problems"]:
+        # never commit an artifact its own journal contradicts
+        if args.json:
+            print(f"workload artifact NOT written ({args.json}): "
+                  f"{len(profile['problems'])} problem(s) above")
+        return 1
+    if args.json:
+        write_workload(args.json, profile)
+        print(f"workload artifact written: {args.json}")
+    return 0
+
+
 def _run_inspect(args) -> int:
     """Schedule-shape report: what the -c/-m/-t choices actually compile
     to. This is the question the per-phase timers approximate at runtime,
@@ -1907,6 +1971,8 @@ def _run_inspect(args) -> int:
         return 0
     if args.what == "explain":
         return _run_inspect_explain(args)
+    if args.what == "workload":
+        return _run_inspect_workload(args)
     if args.what == "traffic":
         return _run_inspect_traffic(args)
     if args.what == "check":

@@ -27,6 +27,13 @@ capture batch live. Three pieces:
   127.0.0.1 only — telemetry is for the operator's terminal, not the
   network.
 
+The serve layer (behind the same import gate) additionally exports the
+batch-efficiency gauges ``tpu_aggcomm_serve_batch_fill_ratio`` and
+``tpu_aggcomm_serve_padding_waste_bytes`` — computed with the
+``obs.workload`` helpers the profiler itself uses, so the /metrics
+numbers equal the ``inspect workload`` batching block float-for-float
+(scripts/telemetry_gate.py cross-checks over committed artifacts).
+
 jax-free, stdlib only (obs discipline).
 """
 

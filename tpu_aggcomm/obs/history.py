@@ -43,7 +43,8 @@ import re
 import statistics
 
 __all__ = ["load_history", "build_index", "write_index", "trend_gate",
-           "check_trends", "bench_series", "render_history",
+           "check_trends", "bench_series", "workload_series",
+           "render_history",
            "MIN_TREND_ROUNDS", "TREND_TOLERANCE", "HISTORY_SCHEMA"]
 
 #: Schema tag of the persisted index artifact (versioned like
@@ -171,6 +172,33 @@ def serve_series(root: str = ".", *,
     return series
 
 
+def workload_series(root: str = ".", *,
+                    errors: list[str] | None = None
+                    ) -> dict[str, list[dict]]:
+    """The padding-waste time series from the committed
+    ``WORKLOAD_r*.json`` history (obs/workload.py): bytes of padded-slot
+    waste per profiled round — the power-of-two batching overhead the
+    profiler accounts. Keyed ``"workload padding waste"`` (one series;
+    a profile spans whatever the server served), fed to the same seeded
+    trend gate as bench/serve: padding waste drifting UP means the
+    served shape mix is fragmenting against the batch axis, and the
+    gate fails the build on a confirmed trajectory."""
+    series: dict[str, list[dict]] = {}
+    for rnd, path, blob in load_history(root, "WORKLOAD", errors=errors):
+        b = blob.get("batching") if isinstance(blob.get("batching"),
+                                               dict) else {}
+        waste = b.get("padding_waste_bytes")
+        if not isinstance(waste, (int, float)) or isinstance(waste, bool):
+            continue
+        series.setdefault("workload padding waste", []).append({
+            "round": rnd, "value": float(waste), "unit": "B",
+            "samples_n": b.get("requests_batched") or 0,
+            "compile_seconds": None, "hbm_peak_bytes": None,
+            "fill_ratio": b.get("fill_ratio"),
+            "file": os.path.basename(path)})
+    return series
+
+
 def _tail_jsonl(path: str) -> list[dict]:
     """Torn-line-tolerant JSONL read (a live trace may be mid-append)."""
     out: list[dict] = []
@@ -264,10 +292,23 @@ def build_index(root: str = ".") -> dict:
                       "composition": win.get("composition"),
                       "median_s": win.get("median_s"),
                       "predicted_rank": win.get("predicted_rank")})
+    workload = []
+    for rnd, path, blob in load_history(root, "WORKLOAD", errors=errors):
+        req = blob.get("requests") or {}
+        b = blob.get("batching") or {}
+        workload.append({"round": rnd, "file": os.path.basename(path),
+                         "admitted": req.get("admitted"),
+                         "completed": req.get("completed"),
+                         "shed": req.get("shed"),
+                         "fill_ratio": b.get("fill_ratio"),
+                         "padding_waste_bytes": b.get(
+                             "padding_waste_bytes"),
+                         "proposals": len(blob.get("proposals") or [])})
     return {"schema": HISTORY_SCHEMA, "root": os.path.abspath(root),
             "bench": bench, "multichip": multichip, "tune": tune,
             "traffic": traffic, "serve": serve_series(root, errors=errors),
-            "synth": synth,
+            "synth": synth, "workload": workload,
+            "workload_series": workload_series(root, errors=errors),
             "traces": _trace_rows(root), "errors": errors}
 
 
@@ -374,15 +415,17 @@ def trend_gate(points, *, tolerance: float = TREND_TOLERANCE,
 
 def check_trends(root: str = ".", *, tolerance: float = TREND_TOLERANCE,
                  seed: int = 0) -> dict:
-    """The trend gate over every per-(metric, platform) bench series
-    AND every per-backend serve series under ``root``. ``ok`` is False
-    only on a confirmed ``drifting-up`` verdict — improvement and
-    insufficient history are not failures. (Key formats cannot collide:
-    bench keys are ``"<metric> | <platform>"``, serve keys
-    ``"serve warm p50 | <backend>"``.)"""
+    """The trend gate over every per-(metric, platform) bench series,
+    every per-backend serve series AND the workload padding-waste
+    series under ``root``. ``ok`` is False only on a confirmed
+    ``drifting-up`` verdict — improvement and insufficient history are
+    not failures. (Key formats cannot collide: bench keys are
+    ``"<metric> | <platform>"``, serve keys ``"serve warm p50 |
+    <backend>"``, the workload key is ``"workload padding waste"``.)"""
     errors: list[str] = []
     series = dict(bench_series(root, errors=errors))
     series.update(serve_series(root, errors=errors))
+    series.update(workload_series(root, errors=errors))
     gates = {key: trend_gate([(r["round"], r["value"]) for r in rows],
                              tolerance=tolerance, seed=seed)
              for key, rows in sorted(series.items())}
@@ -457,6 +500,37 @@ def render_history(root: str = ".") -> str:
                      + ", ".join(detail))
         if gate.get("note"):
             lines.append(f"  note: {gate['note']}")
+    for key, rows in sorted(index["workload_series"].items()):
+        gate = trends["series"].get(key, {})
+        lines.append(f"== {key} ({len(rows)} profiled rounds) ==")
+        for r in rows:
+            extras = []
+            if r["samples_n"]:
+                extras.append(f"{r['samples_n']} batched requests")
+            if isinstance(r.get("fill_ratio"), (int, float)):
+                extras.append(f"fill {r['fill_ratio']:.2f}")
+            ex = f"  [{', '.join(extras)}]" if extras else ""
+            lines.append(f"  r{r['round']:02d}: "
+                         f"{_fmt_val(r['value'], r['unit'])}{ex}")
+        detail = []
+        if gate.get("slope_pct_per_round") is not None:
+            detail.append(f"slope {gate['slope_pct_per_round']:+.1f}%"
+                          f"/round")
+        if gate.get("ci_pct_per_round") is not None:
+            ci = gate["ci_pct_per_round"]
+            detail.append(f"95% CI [{ci[0]:+.1f}%, {ci[1]:+.1f}%]")
+        detail.append(f"tolerance {gate.get('tolerance_pct', 0):.0f}%"
+                      f"/round (seed {gate.get('seed')})")
+        lines.append(f"  trend: {gate.get('verdict', '?').upper()} — "
+                     + ", ".join(detail))
+        if gate.get("note"):
+            lines.append(f"  note: {gate['note']}")
+    for w in index["workload"]:
+        props = f", {w['proposals']} advisory proposal(s)" \
+            if w["proposals"] else ""
+        lines.append(f"workload: {w['file']} — {w['admitted']} admitted, "
+                     f"{w['completed']} completed, {w['shed']} shed"
+                     f"{props}")
     mc = index["multichip"]
     if mc:
         ok = sum(1 for m in mc if m.get("ok"))

@@ -24,6 +24,11 @@ form), loadable in ``ui.perfetto.dev`` or ``chrome://tracing``:
   reconstructed cell means — p50/p95 are ``round_stats`` VERBATIM, p99
   the same percentile arithmetic), one sample per (run, round) at the
   round's first slice timestamp, so the tail shows ON the timeline.
+- pid 3, "serve requests (journal-derived)": one thread per serve
+  request, phase slices (queue/batch/cache/dispatch/respond) between
+  the boundary stamps each ``serve.request`` instant carries
+  (obs/workload.py BOUNDARIES order) — the ``inspect workload``
+  attribution projected onto the timeline, never ad-hoc host timing.
 
 Multi-run legibility: the process names carry the backend(s) and the
 ``process_labels`` metadata lists every run (``m<id> <method name>
@@ -38,10 +43,17 @@ tests). Timestamps are microseconds (the format's unit).
 
 from __future__ import annotations
 
-__all__ = ["to_chrome_trace", "HOST_PID", "RANKS_PID", "HBM_TID"]
+__all__ = ["to_chrome_trace", "HOST_PID", "RANKS_PID", "SERVE_PID",
+           "HBM_TID"]
 
 HOST_PID = 1
 RANKS_PID = 2
+
+#: Serve request-flow tracks: one thread per request id, phase slices
+#: synthesized from the ``serve.request`` instants' recorded boundary
+#: stamps (obs/workload.py BOUNDARIES order) — journal-derived timing,
+#: never ad-hoc host callbacks.
+SERVE_PID = 3
 
 #: Host-process thread id of the HBM counter tracks (tid 1 is the host
 #: span/instant timeline).
@@ -173,6 +185,47 @@ def to_chrome_trace(events: list[dict]) -> dict:
                     "ph": "C", "pid": RANKS_PID, "tid": 0,
                     "name": name, "ts": ts,
                     "args": {"value": v * 1e3}})
+
+    # serve request-flow tracks: each `serve.request` instant carries
+    # the request's full boundary-stamp dict (relative to admission);
+    # the instant itself was emitted at the respond boundary, so
+    # admit_ts = instant_ts - respond_stamp re-anchors the request on
+    # the host clock. One slice per consecutive recorded boundary pair,
+    # one thread per request id — the same journal-derived attribution
+    # `inspect workload` prints, projected onto the timeline.
+    from tpu_aggcomm.obs.workload import BOUNDARIES
+    serve_seen: set[int] = set()
+    for e in events:
+        if e["ev"] != "instant" or e.get("name") != "serve.request":
+            continue
+        args = e.get("args", {})
+        phases = args.get("phases")
+        rid = args.get("rid")
+        if not isinstance(phases, dict) or not isinstance(rid, int):
+            continue
+        stamps = [(b, phases[b]) for b in BOUNDARIES
+                  if isinstance(phases.get(b), (int, float))]
+        if len(stamps) < 2:
+            continue
+        t0 = e["ts"] - stamps[-1][1] * 1e6
+        serve_seen.add(rid)
+        for (_b0, s0), (b1, s1) in zip(stamps, stamps[1:]):
+            slices.append({
+                "ph": "X", "pid": SERVE_PID, "tid": rid + 1,
+                "name": b1, "cat": "serve",
+                "ts": t0 + s0 * 1e6, "dur": (s1 - s0) * 1e6,
+                "args": {"rid": rid, "phase": b1, "dur_s": s1 - s0,
+                         "ok": args.get("ok"),
+                         "backend": args.get("backend"),
+                         "cache": args.get("cache"),
+                         "batch_seq": args.get("batch_seq"),
+                         "batch_n": args.get("batch_n")}})
+    if serve_seen:
+        out.append(_meta(SERVE_PID, 0, "process_name",
+                         "serve requests (journal-derived)"))
+        for rid in sorted(serve_seen):
+            out.append(_meta(SERVE_PID, rid + 1, "thread_name",
+                             f"request {rid}"))
 
     if hbm_seen:
         out.append(_meta(HOST_PID, HBM_TID, "thread_name", "hbm"))

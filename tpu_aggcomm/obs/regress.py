@@ -72,8 +72,9 @@ __all__ = ["validate_bench", "validate_multichip", "validate_tune",
            "parsed_schema_version", "DEFAULT_TOLERANCE",
            "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE", "TUNE_SCHEMAS",
            "TRAFFIC_SCHEMAS", "PREDICT_SCHEMAS", "COMPARE_SCHEMAS",
-           "SERVE_SCHEMAS", "SYNTH_SCHEMAS", "validate_predict",
-           "validate_compare", "validate_serve", "validate_synth"]
+           "SERVE_SCHEMAS", "SYNTH_SCHEMAS", "WORKLOAD_SCHEMAS",
+           "validate_predict", "validate_compare", "validate_serve",
+           "validate_synth", "validate_workload"]
 
 #: Relative slowdown vs the best prior same-platform round that counts as
 #: a regression. Differenced-chain numbers jitter a few percent
@@ -1456,4 +1457,173 @@ def validate_synth(obj, where: str = "SYNTH") -> list[str]:
                         f"smaller pooled median ({m!r}) than the "
                         f"winner ({meds[winner_cid]!r}) — the verdict "
                         f"contradicts its own samples")
+    return errors
+
+
+#: Accepted WORKLOAD artifact schema tags (obs/workload.py, the
+#: ``cli inspect workload --json`` output) — versioned like TUNE_SCHEMAS.
+WORKLOAD_SCHEMAS = ("workload-v1",)
+
+_WORKLOAD_STATUSES = ("done", "fail", "shed", "lost")
+
+
+def validate_workload(obj, where: str = "WORKLOAD") -> list[str]:
+    """Schema errors (empty list = valid) for one ``WORKLOAD_r*.json``
+    workload-profile artifact (obs/workload.py).
+
+    The self-consistency bar is the strongest in the repo: every
+    aggregate block (phase totals, arrival process, queue depth, shape
+    mix, batching) is RE-DERIVED from the artifact's own ``per_request``
+    rows through the same ``obs.workload.aggregate_rows`` arithmetic and
+    compared float-exactly, each request's ``wall_s`` must equal the sum
+    of its phase durations in canonical boundary order (the identical-
+    computation discipline — never a tolerance), and the advisory
+    proposals must re-derive from the aggregates + seed. An artifact its
+    own rows contradict is schema-invalid. Freshness against the source
+    journal is the separate ``replay_workload`` gate."""
+    import json as _json
+
+    from tpu_aggcomm.obs import workload as _wl
+
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    schema = obj.get("schema")
+    if schema not in WORKLOAD_SCHEMAS:
+        errors.append(f"{where}: unknown schema tag {schema!r} "
+                      f"(expected one of {list(WORKLOAD_SCHEMAS)})")
+        return errors
+    _require(obj, "created_unix", (int, float), errors, where)
+    _require(obj, "seed", int, errors, where)
+    man = obj.get("manifest")
+    if man is not None and not isinstance(man, dict):
+        errors.append(f"{where}: 'manifest' must be an object or null")
+    journals = obj.get("journals")
+    if not isinstance(journals, list) or not journals \
+            or not all(isinstance(j, str) for j in journals):
+        errors.append(f"{where}: 'journals' must be a non-empty list of "
+                      f"journal basenames")
+    probs = obj.get("problems")
+    if not isinstance(probs, list):
+        errors.append(f"{where}: 'problems' must be a list")
+    elif probs:
+        errors.append(f"{where}: artifact carries {len(probs)} profiler "
+                      f"problem(s) (first: {probs[0]!r}) — a journal "
+                      f"that disagrees with itself must not be "
+                      f"committed as an artifact")
+
+    rows = obj.get("per_request")
+    if not isinstance(rows, list):
+        return errors + [f"{where}: 'per_request' must be a list"]
+    counts = {"done": 0, "fail": 0, "shed": 0}
+    lost_rows: list = []
+    shaped = 0
+    prev_rid = None
+    for i, r in enumerate(rows):
+        w = f"{where}.per_request[{i}]"
+        if not isinstance(r, dict):
+            errors.append(f"{w}: must be an object")
+            continue
+        _require(r, "rid", int, errors, w)
+        rid = r.get("rid")
+        if isinstance(rid, int) and prev_rid is not None \
+                and rid <= prev_rid:
+            errors.append(f"{w}: rows must be sorted by rid "
+                          f"({rid} after {prev_rid})")
+        prev_rid = rid if isinstance(rid, int) else prev_rid
+        status = r.get("status")
+        if status not in _WORKLOAD_STATUSES:
+            errors.append(f"{w}: status {status!r} not in "
+                          f"{_WORKLOAD_STATUSES}")
+        elif status == "lost":
+            lost_rows.append(rid)
+        else:
+            counts[status] += 1
+        if isinstance(r.get("shape"), dict):
+            shaped += 1
+        phases = r.get("phases")
+        if not isinstance(phases, dict):
+            errors.append(f"{w}: 'phases' must be an object")
+            continue
+        for b, v in phases.items():
+            if b not in _wl.BOUNDARIES[1:]:
+                errors.append(f"{w}: unknown phase boundary {b!r}")
+            elif not _is_num(v) or v < 0:
+                errors.append(f"{w}: phase {b!r} duration must be a "
+                              f"non-negative number, got {v!r}")
+        # wall_s is DEFINED as the canonical-order sum — re-derive the
+        # identical expression (float-exact by identical computation)
+        want_wall = [phases[b] for b in _wl.BOUNDARIES if b in phases]
+        want_wall = sum(want_wall) if want_wall else None
+        if r.get("wall_s") != want_wall:
+            errors.append(f"{w}: wall_s {r.get('wall_s')!r} != sum of "
+                          f"phase durations in canonical order "
+                          f"== {want_wall!r}")
+
+    req = obj.get("requests")
+    if not isinstance(req, dict):
+        errors.append(f"{where}: 'requests' must be an object")
+    else:
+        for k in ("admitted", "completed", "failed", "shed"):
+            _require(req, k, int, errors, f"{where}.requests")
+            v = req.get(k)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                errors.append(f"{where}.requests: {k!r} must be "
+                              f"non-negative, got {v}")
+        for k, have in (("completed", counts["done"]),
+                        ("failed", counts["fail"]),
+                        ("shed", counts["shed"])):
+            want = req.get(k)
+            if isinstance(want, int) and want != have:
+                errors.append(f"{where}: requests.{k} claims {want} but "
+                              f"the per_request rows re-derive {have}")
+        lost = req.get("lost")
+        if not isinstance(lost, list):
+            errors.append(f"{where}.requests: 'lost' must be a list")
+        elif sorted(lost, key=repr) != sorted(lost_rows, key=repr):
+            errors.append(f"{where}: requests.lost claims {lost} but the "
+                          f"per_request rows re-derive {sorted(lost_rows, key=repr)}")
+        adm = req.get("admitted")
+        if isinstance(adm, int) and adm != shaped:
+            errors.append(f"{where}: requests.admitted claims {adm} but "
+                          f"{shaped} rows carry an admission shape — "
+                          f"every admitted request records its shape")
+
+    # -- re-derive every aggregate block from the rows themselves ----------
+    fences = {}
+    for m in (obj.get("shape_mix") or []):
+        if isinstance(m, dict) and isinstance(m.get("shape"), dict):
+            sig = _json.dumps({"shape": m["shape"],
+                               "backend": m.get("backend")},
+                              sort_keys=True)
+            fences[sig] = m.get("fences_per_request")
+    try:
+        agg = _wl.aggregate_rows(rows, fences=fences)
+    except Exception as e:  # lint: broad-ok (validation must report malformed rows as schema errors, not crash the checker)
+        return errors + [f"{where}: per_request rows do not aggregate: "
+                         f"{type(e).__name__}: {e}"]
+    for p in agg.pop("problems"):
+        errors.append(f"{where}: rows are self-contradictory: {p}")
+    for key, want in agg.items():
+        got = obj.get(key)
+        if _json.dumps(got, sort_keys=True) \
+                != _json.dumps(want, sort_keys=True):
+            errors.append(f"{where}: '{key}' does not re-derive from "
+                          f"per_request rows float-exactly (the "
+                          f"aggregate_rows arithmetic)")
+
+    # -- proposals must re-derive from the aggregates + seed ---------------
+    props = obj.get("proposals")
+    if not isinstance(props, list):
+        errors.append(f"{where}: 'proposals' must be a list")
+    elif isinstance(req, dict) and not errors:
+        pseudo = {"seed": obj.get("seed", 0), "requests": req,
+                  "shape_mix": agg.get("shape_mix", []),
+                  "arrivals": agg.get("arrivals", {})}
+        want = _wl._detect(pseudo)
+        if _json.dumps(props, sort_keys=True) \
+                != _json.dumps(want, sort_keys=True):
+            errors.append(f"{where}: 'proposals' do not re-derive from "
+                          f"the aggregates + seed (detection must be "
+                          f"deterministic and advisory)")
     return errors

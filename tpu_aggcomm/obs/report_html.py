@@ -360,6 +360,36 @@ def _explain_rows(root: str) -> dict | None:
             "explain": blob["explain"]}
 
 
+def _workload_rows(root: str, errors: list[str]) -> list[dict]:
+    """Workload pane data from every ``WORKLOAD_r*.json`` under the
+    history root (obs/workload.py, discovered via load_history like
+    every other family) — jax-free. A schema-invalid profile becomes
+    an error payload, never a silently trusted number."""
+    from tpu_aggcomm.obs.history import load_history
+    from tpu_aggcomm.obs.regress import validate_workload
+
+    rows: list[dict] = []
+    for rnd, path, blob in load_history(root, "WORKLOAD", errors=errors):
+        name = os.path.basename(path)
+        errs = validate_workload(blob, name)
+        if errs:
+            rows.append({"round": rnd, "file": name, "error": errs[0]})
+            continue
+        rows.append({"round": rnd, "file": name, "error": None,
+                     "seed": blob.get("seed"),
+                     "requests": blob.get("requests"),
+                     "phase_totals": blob.get("phase_totals"),
+                     "arrivals": {k: v for k, v in
+                                  (blob.get("arrivals") or {}).items()
+                                  if k != "interarrival_s"},
+                     "shape_mix": blob.get("shape_mix"),
+                     "batching": {k: v for k, v in
+                                  (blob.get("batching") or {}).items()
+                                  if k != "per_batch"},
+                     "proposals": blob.get("proposals")})
+    return rows
+
+
 def build_payload(history_root: str = ".",
                   trace_paths: list[str] | None = None) -> dict:
     """The dashboard's inlined data: bench/multichip history + tuner
@@ -374,6 +404,7 @@ def build_payload(history_root: str = ".",
             "runs": runs,
             "degradation": _degradation_rows(runs),
             "explain": _explain_rows(history_root),
+            "workload": _workload_rows(history_root, errors),
             "trend": check_trends(history_root),
             "errors": errors}
 
@@ -422,6 +453,8 @@ time; lower is better everywhere (seconds per rep).</p>
 <div id="degradation"></div>
 <h2>Cost model (predicted vs measured, named verdicts)</h2>
 <div id="explain"></div>
+<h2>Workload profile (serve request flow)</h2>
+<div id="workload"></div>
 <script id="data" type="application/json">{payload}</script>
 <script>
 "use strict";
@@ -1070,6 +1103,86 @@ function fmtS(v) {{
       "(tpu_aggcomm/model/, jax-free); verdicts name the dominant " +
       "modeled cost within the calibrated tolerance — advisory only, " +
       "measured rounds stay the source of truth"));
+}})();
+
+(function workloadPane() {{
+  var host = document.getElementById("workload");
+  var rows = DATA.workload || [];
+  if (!rows.length) {{
+    host.appendChild(el("p", {{class: "note"}},
+        "no WORKLOAD_*.json under the history root (run `cli inspect " +
+        "workload serve.journal.jsonl --json WORKLOAD_rNN.json` over a " +
+        "serve journal)"));
+    return;
+  }}
+  rows.forEach(function (w) {{
+    var cap = el("p", {{}});
+    cap.appendChild(el("b", {{}}, w.file));
+    if (w.error) {{
+      host.appendChild(cap);
+      host.appendChild(el("p", {{class: "err"}},
+          "workload artifact error: " + w.error));
+      return;
+    }}
+    var req = w.requests || {{}};
+    var arr = w.arrivals || {{}};
+    cap.appendChild(document.createTextNode(
+        " (seed " + w.seed + ") — " + req.admitted + " admitted: " +
+        req.completed + " done, " + req.failed + " fail, " +
+        req.shed + " shed, " + (req.lost || []).length + " lost; " +
+        (arr.rps === null || arr.rps === undefined ?
+         "single arrival" :
+         arr.rps.toFixed(1) + " req/s, interarrival CV " +
+         (arr.cv === null || arr.cv === undefined ?
+          "-" : arr.cv.toFixed(2)))));
+    host.appendChild(cap);
+    var tbl = el("table");
+    var hr = el("tr");
+    ["phase", "n", "mean", "p50", "p95", "max", "total"]
+      .forEach(function (h, i) {{
+        hr.appendChild(el("th", i === 0 ? {{class: "l"}} : {{}}, h)); }});
+    tbl.appendChild(hr);
+    var pt = w.phase_totals || {{}};
+    ["queue", "batch", "cache", "dispatch", "respond"]
+      .forEach(function (ph) {{
+        var s = pt[ph];
+        if (!s) return;
+        var tr = el("tr");
+        tr.appendChild(el("td", {{class: "l"}}, ph));
+        tr.appendChild(el("td", {{}}, String(s.n)));
+        [s.mean_s, s.p50_s, s.p95_s, s.max_s, s.total_s]
+          .forEach(function (v) {{
+            tr.appendChild(el("td", {{}}, fmtS(v))); }});
+        tbl.appendChild(tr);
+      }});
+    host.appendChild(tbl);
+    var mix = (w.shape_mix || []).map(function (m) {{
+      var sh = m.shape || {{}};
+      return "m" + sh.method + " n=" + sh.nprocs + " d=" + sh.data_size +
+          " [" + m.backend + "]: " + m.count + " (" +
+          (m.fraction * 100).toFixed(0) + "%)";
+    }});
+    if (mix.length)
+      host.appendChild(el("p", {{class: "note"}},
+          "shape mix — " + mix.join("; ")));
+    var b = w.batching || {{}};
+    if (b.batches)
+      host.appendChild(el("p", {{class: "note"}},
+          "batching — " + b.batches + " batch(es), " +
+          b.requests_batched + " requests in " + b.padded_slots +
+          " padded slots (fill " +
+          (b.fill_ratio === null || b.fill_ratio === undefined ?
+           "-" : (b.fill_ratio * 100).toFixed(0) + "%") +
+          ", padding waste " + b.padding_waste_bytes + " B)"));
+    (w.proposals || []).forEach(function (p) {{
+      host.appendChild(el("p", {{class: "note"}},
+          "advisory [" + p.kind + "]: " + p.reason));
+    }});
+  }});
+  host.appendChild(el("p", {{class: "note"}},
+      "phase attribution is journal-derived (obs/workload.py over the " +
+      "serve journal's boundary stamps, float-exact vs `inspect " +
+      "workload`) — proposals are advisory only, nothing here gates"));
 }})();
 </script></body></html>
 """

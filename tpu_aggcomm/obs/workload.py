@@ -1,0 +1,637 @@
+"""Workload profiler: see the traffic the serve layer actually serves.
+
+The serve journal (resilience/journal.py, written by serve/server.py)
+records every request's life as phase-boundary timestamps — admit →
+queue → batch → cache → dispatch → respond, all ``time.monotonic()``
+stamps relative to the request's admission — plus the queue depth at
+admission and the batch membership/padded-slot counts. This module is
+the read side: a **jax-free**, torn-line-tolerant profiler that
+re-derives from those records alone
+
+- per-request **phase attribution** — durations between consecutive
+  recorded boundaries, float-exact against the journal stamps: a
+  request's ``wall_s`` is DEFINED as the sum of its phase durations in
+  canonical boundary order, and ``validate_workload`` recomputes that
+  identical sum (the validate_serve percentile discipline: float-exact
+  by identical computation, never by tolerance);
+- **shape-mix and arrival-process statistics** — per-shape req/s,
+  interarrival quantiles (``obs.metrics.percentile`` arithmetic, like
+  every exposition in this repo), burstiness (the coefficient of
+  variation of interarrivals), hot-shape ranking;
+- **batch-efficiency accounting** — fill ratio, padding-waste bytes
+  from the power-of-two batch padding, static fence counts per request
+  (``len(schedule.rounds())`` over the SAME jax-free compile path the
+  server admits through);
+- seeded **hot-shape/skew detection** — the ``resilience/detect.py``
+  pattern applied to request streams: ADVISORY ONLY, proposes tune/
+  synth targets by name, never changes what ran.
+
+Everything here derives from the journal/trace streams — never from
+host callbacks, never from ad-hoc timing added for the profiler's
+benefit (the flight-recorder discipline one level up). The server-side
+counters behind the ``/metrics`` fill-ratio and padding-waste gauges
+use :func:`padded_slots` / :func:`payload_bytes` /
+:func:`batch_fill_ratio` from THIS module, so the exported numbers and
+the profiler's re-derivation cannot drift (telemetry_gate.py holds the
+line float-exactly).
+
+``WORKLOAD_r*.json`` (workload-v1) is written atomically, schema-
+validated by ``obs.regress.validate_workload`` (self-contradiction =
+invalid), discovered by ``obs.history.load_history``, and replays to
+REPRODUCED from the recorded journals alone (:func:`replay_workload`).
+:func:`workload_scenario` closes the loop: the measured shape mix and
+arrival process become a seeded synthetic scenario for
+``serve_loadgen.py --workload`` — same artifact + seed in ⟹ same
+request sequence out.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import statistics
+import time
+
+from tpu_aggcomm.obs.atomic import atomic_write
+from tpu_aggcomm.obs.metrics import percentile
+from tpu_aggcomm.resilience.journal import RunJournal
+
+__all__ = ["WORKLOAD_SCHEMA", "BOUNDARIES", "attribute_phases",
+           "padded_slots", "payload_bytes", "batch_fill_ratio",
+           "aggregate_rows", "profile_journal", "workload_scenario",
+           "write_workload", "replay_workload", "render_workload"]
+
+WORKLOAD_SCHEMA = "workload-v1"
+
+#: Canonical phase-boundary order, as stamped by serve/server.py
+#: (_Pending.marks). "admit" is always 0.0 (stamps are relative to the
+#: admission monotonic clock read); each later boundary's phase
+#: duration is the time since the PREVIOUS RECORDED boundary, so a
+#: request shed mid-flight attributes honestly over the prefix it
+#: actually traversed.
+BOUNDARIES = ("admit", "queue", "batch", "cache", "dispatch", "respond")
+
+#: What each boundary's duration means (the interval ENDING at it).
+PHASE_MEANING = {
+    "queue": "waiting in the admission queue",
+    "batch": "batch formation (the --batch-window-ms gather)",
+    "cache": "cache lookup + compile (zero-ish on a warm hit)",
+    "dispatch": "device dispatch (execute_batch wall)",
+    "respond": "result post-processing + response assembly",
+}
+
+# -- detection thresholds (the resilience/detect.py discipline:
+# conservative, named, advisory) ------------------------------------------
+#: A shape is "hot" when it exceeds this fraction of admitted requests.
+HOT_SHARE = 0.5
+#: Interarrival coefficient of variation above this = bursty arrivals
+#: (a Poisson process has CV 1.0; 2x that is unambiguous burstiness).
+SKEW_CV = 2.0
+#: Below this many admitted requests every verdict is "insufficient".
+MIN_REQUESTS = 8
+
+
+# ---------------------------------------------------------------------------
+# The shared batch arithmetic (server gauges == profiler re-derivation).
+
+def padded_slots(n: int, backend_name: str) -> int:
+    """Padded batch size for an ``n``-request batch on ``backend_name``
+    — MUST mirror serve/executor.py exactly: jax_sim batches >1 pad to
+    the next power of two; pallas_fused (and singletons) execute
+    unpadded."""
+    if backend_name != "jax_sim" or n <= 1:
+        return n
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def payload_bytes(shape: dict) -> int:
+    """Declared per-request payload bytes for one shape-fields dict
+    (``nprocs * data_size`` — the global send footprint, a documented
+    PROXY for the padded device slab, not an HBM measurement)."""
+    return int(shape.get("nprocs", 0) or 0) * \
+        int(shape.get("data_size", 2048) or 0)
+
+
+def batch_fill_ratio(batched: int, padded: int) -> float | None:
+    """Requests per padded slot (1.0 = no padding waste); None when
+    nothing has been dispatched yet."""
+    if padded <= 0:
+        return None
+    return batched / padded
+
+
+# ---------------------------------------------------------------------------
+# Phase attribution.
+
+def attribute_phases(stamps) -> tuple[dict, list[str]]:
+    """``(phases, problems)`` for one request's boundary stamps.
+
+    ``phases`` maps each recorded boundary (after the first) to the
+    seconds since the PREVIOUS recorded boundary, in canonical
+    :data:`BOUNDARIES` order. Problems (non-monotone stamps, unknown
+    boundary names, non-numeric values) are named, never silently
+    absorbed — serve/recover.py uses the same check to refuse
+    reordered journal lines."""
+    problems: list[str] = []
+    phases: dict = {}
+    if not isinstance(stamps, dict):
+        return phases, ["phase stamps are not a dict"]
+    for k in stamps:
+        if k not in BOUNDARIES:
+            problems.append(f"unknown phase boundary {k!r} (canonical "
+                            f"order: {', '.join(BOUNDARIES)})")
+    prev_name = prev_t = None
+    for b in BOUNDARIES:
+        if b not in stamps:
+            continue
+        t = stamps[b]
+        if isinstance(t, bool) or not isinstance(t, (int, float)):
+            problems.append(f"boundary {b!r} stamp {t!r} is not a number")
+            continue
+        if prev_t is not None:
+            d = t - prev_t
+            if d < 0:
+                problems.append(
+                    f"boundary {b!r} at {t!r} precedes {prev_name!r} at "
+                    f"{prev_t!r} — phase stamps must be monotone")
+            phases[b] = d
+        prev_name, prev_t = b, t
+    return phases, problems
+
+
+def _wall_of(phases: dict) -> float | None:
+    """The request wall as THE canonical sum (validate_workload
+    recomputes this identical expression — float-exactness by identical
+    computation)."""
+    vals = [phases[b] for b in BOUNDARIES if b in phases]
+    return sum(vals) if vals else None
+
+
+# ---------------------------------------------------------------------------
+# The profiler.
+
+def _shape_sig(shape: dict | None, backend) -> str:
+    return json.dumps({"shape": shape, "backend": backend},
+                      sort_keys=True)
+
+
+def _fence_count(shape: dict) -> int | None:
+    """Static per-request fence count: the schedule's data-edge round
+    count through the SAME jax-free compile path the server admits
+    through (serve/protocol.request_schedule)."""
+    try:
+        from tpu_aggcomm.serve.protocol import parse_request, \
+            request_schedule
+        return len(request_schedule(parse_request(dict(shape))).rounds())
+    except Exception:  # lint: broad-ok (fence counts are advisory static enrichment; a recorded shape that no longer compiles must not sink the profile)
+        return None
+
+
+def _stats_block(vals: list) -> dict:
+    return {"n": len(vals), "total_s": sum(vals),
+            "mean_s": sum(vals) / len(vals),
+            "p50_s": percentile(vals, 50.0),
+            "p95_s": percentile(vals, 95.0),
+            "max_s": max(vals)}
+
+
+def profile_journal(paths, *, seed: int = 0) -> dict:
+    """Re-derive the workload profile from serve journal(s).
+
+    Torn lines were already skipped by the journal reader; admitted
+    requests with no terminal record are named ``lost`` (the crash ate
+    them — serve/recover.py semantics). The returned dict is the
+    workload-v1 body minus the artifact envelope (schema/manifest/
+    created_unix, added by :func:`write_workload`); ``problems`` names
+    every self-contradiction found (a non-empty list should fail the
+    caller, the journal disagrees with itself)."""
+    paths = list(paths)
+    admitted: dict = {}
+    terminal: dict = {}
+    problems: list[str] = []
+    for path in paths:
+        for rec in RunJournal(path).entries():
+            key = rec.get("key") or {}
+            rid = key.get("request")
+            if rid is None:
+                continue
+            status = rec.get("status")
+            if status == "admitted":
+                admitted.setdefault(rid, rec)
+            elif status in ("done", "fail", "shed"):
+                terminal.setdefault(rid, rec)
+
+    rows: list[dict] = []
+    counts = {"done": 0, "fail": 0, "shed": 0}
+    lost: list = []
+    for rid in sorted(set(admitted) | set(terminal)):
+        adm = admitted.get(rid)
+        term = terminal.get(rid)
+        status = term.get("status") if term is not None else "lost"
+        if term is None:
+            lost.append(rid)
+        else:
+            counts[status] += 1
+        phases: dict = {}
+        wall = None
+        if term is not None and "phases" in term:
+            phases, pp = attribute_phases(term.get("phases"))
+            for p in pp:
+                problems.append(f"request {rid}: {p}")
+            wall = _wall_of(phases)
+        batch = None
+        if term is not None and term.get("batch_seq") is not None:
+            batch = {"seq": term["batch_seq"],
+                     "n": term.get("batch_n"),
+                     "padded": term.get("batch_padded")}
+        rows.append({
+            "rid": rid, "status": status,
+            "shape": (adm or {}).get("shape"),
+            "backend": (adm or {}).get("backend")
+            or (term or {}).get("backend"),
+            "arrival_unix": (adm or {}).get("t_unix"),
+            "queue_depth": (adm or {}).get("queue_depth"),
+            "phases": phases, "wall_s": wall,
+            "latency_s": (term or {}).get("latency_s"),
+            "cache": (term or {}).get("cache"),
+            "shed_reason": (term or {}).get("reason")
+            if status == "shed" else None,
+            "batch": batch,
+        })
+
+    agg = aggregate_rows(rows)
+    problems.extend(agg.pop("problems"))
+    profile = {
+        "seed": int(seed),
+        "journals": [os.path.basename(p) for p in paths],
+        "requests": {"admitted": len(admitted),
+                     "completed": counts["done"],
+                     "failed": counts["fail"],
+                     "shed": counts["shed"],
+                     "lost": lost},
+        "per_request": rows,
+        **agg,
+        "proposals": [],
+        "problems": problems,
+    }
+    profile["proposals"] = _detect(profile)
+    return profile
+
+
+def aggregate_rows(rows: list[dict], *, fences: dict | None = None) -> dict:
+    """The aggregate blocks (phase_totals / arrivals / queue_depth /
+    shape_mix / batching) re-derived from per-request rows alone.
+
+    This is THE one aggregation arithmetic: :func:`profile_journal`
+    builds artifacts through it, and ``obs.regress.validate_workload``
+    re-runs it over a committed artifact's ``per_request`` rows and
+    demands float-exact agreement — an aggregate its own rows
+    contradict is schema-invalid. ``fences`` (shape sig -> fence count)
+    skips the static schedule compile when the caller already has the
+    counts (the validator trusts the recorded ones; freshness is the
+    replay gate's job)."""
+    problems: list[str] = []
+
+    # -- phase totals (rid order, so the sums re-derive byte-for-byte)
+    phase_totals: dict = {}
+    for b in BOUNDARIES[1:]:
+        vals = [r["phases"][b] for r in rows if b in r["phases"]]
+        if vals:
+            phase_totals[b] = _stats_block(vals)
+
+    # -- arrival process ---------------------------------------------------
+    arr = sorted((r["arrival_unix"], r["rid"]) for r in rows
+                 if isinstance(r["arrival_unix"], (int, float)))
+    inter = [b[0] - a[0] for a, b in zip(arr, arr[1:])]
+    duration = arr[-1][0] - arr[0][0] if len(arr) > 1 else None
+    mean_ia = sum(inter) / len(inter) if inter else None
+    cv = None
+    if inter and mean_ia and mean_ia > 0:
+        cv = statistics.pstdev(inter) / mean_ia
+    arrivals = {
+        "n": len(arr),
+        "duration_s": duration,
+        "rps": (len(arr) / duration if duration else None),
+        "interarrival_s": inter,
+        "quantiles": ({"p50": percentile(inter, 50.0),
+                       "p95": percentile(inter, 95.0),
+                       "p99": percentile(inter, 99.0)} if inter else None),
+        "mean_s": mean_ia,
+        "cv": cv,
+    }
+
+    # -- queue depth at admission ------------------------------------------
+    depths = [r["queue_depth"] for r in rows
+              if isinstance(r["queue_depth"], int)]
+    queue_depth = ({"n": len(depths), "mean": sum(depths) / len(depths),
+                    "max": max(depths), "p95": percentile(depths, 95.0)}
+                   if depths else None)
+
+    # -- shape mix (hot-shape ranking: count desc, then canonical sig) -----
+    groups: dict = {}
+    for r in rows:
+        if not isinstance(r["shape"], dict):
+            continue
+        sig = _shape_sig(r["shape"], r["backend"])
+        g = groups.setdefault(sig, {"shape": r["shape"],
+                                    "backend": r["backend"],
+                                    "count": 0, "arrivals": []})
+        g["count"] += 1
+        if isinstance(r["arrival_unix"], (int, float)):
+            g["arrivals"].append(r["arrival_unix"])
+    n_shaped = sum(g["count"] for g in groups.values())
+    fences = dict(fences or {})
+    shape_mix: list[dict] = []
+    for sig in sorted(groups, key=lambda s: (-groups[s]["count"], s)):
+        g = groups[sig]
+        if sig not in fences:
+            fences[sig] = _fence_count(g["shape"])
+        ts = sorted(g["arrivals"])
+        ia = [b - a for a, b in zip(ts, ts[1:])]
+        shape_mix.append({
+            "shape": g["shape"], "backend": g["backend"],
+            "count": g["count"],
+            "fraction": g["count"] / n_shaped,
+            "rps": (g["count"] / duration if duration else None),
+            "fences_per_request": fences[sig],
+            "interarrival_s": ({"n": len(ia),
+                                "p50": percentile(ia, 50.0),
+                                "p95": percentile(ia, 95.0)}
+                               if ia else None),
+        })
+
+    # -- batch efficiency (only batches that reached dispatch carry a
+    # padded count; a compile-fail batch has batch_padded null) ------------
+    by_seq: dict = {}
+    for r in rows:
+        b = r["batch"]
+        if not b or b.get("padded") is None:
+            continue
+        seq = b["seq"]
+        e = by_seq.get(seq)
+        if e is None:
+            by_seq[seq] = {"seq": seq, "n": b["n"], "padded": b["padded"],
+                           "payload_bytes": payload_bytes(r["shape"] or {}),
+                           "members": 1}
+        else:
+            e["members"] += 1
+            if (b["n"], b["padded"]) != (e["n"], e["padded"]):
+                problems.append(
+                    f"batch {seq}: request {r['rid']} records "
+                    f"n={b['n']}/padded={b['padded']} but an earlier "
+                    f"member recorded n={e['n']}/padded={e['padded']}")
+    per_batch = []
+    for seq in sorted(by_seq):
+        e = by_seq[seq]
+        if e["members"] != e["n"]:
+            problems.append(
+                f"batch {seq}: {e['members']} member records vs "
+                f"recorded batch_n={e['n']} — the journal disagrees "
+                f"with itself")
+        e = dict(e)
+        e.pop("members")
+        e["waste_bytes"] = (e["padded"] - e["n"]) * e["payload_bytes"]
+        per_batch.append(e)
+    req_batched = sum(e["n"] for e in per_batch)
+    slots = sum(e["padded"] for e in per_batch)
+    batching = {
+        "batches": len(per_batch),
+        "requests_batched": req_batched,
+        "padded_slots": slots,
+        "fill_ratio": batch_fill_ratio(req_batched, slots),
+        "padding_waste_bytes": sum(e["waste_bytes"] for e in per_batch),
+        "per_batch": per_batch,
+    }
+
+    return {"phase_totals": phase_totals, "arrivals": arrivals,
+            "queue_depth": queue_depth, "shape_mix": shape_mix,
+            "batching": batching, "problems": problems}
+
+
+# ---------------------------------------------------------------------------
+# Seeded hot-shape / skew detection (advisory; resilience/detect.py).
+
+def _shape_flags(shape: dict, backend) -> str:
+    return (f"-n {shape.get('nprocs')} -d {shape.get('data_size')} "
+            f"--methods {shape.get('method')} "
+            f"--cb-nodes {shape.get('cb_nodes')} "
+            f"--comm-sizes {shape.get('comm_size')} "
+            f"--backend {backend or 'jax_sim'}")
+
+
+def _detect(profile: dict) -> list[dict]:
+    """Advisory proposals from the measured stream — named tune/synth
+    targets, never a behavior change. Conservative by construction:
+    below MIN_REQUESTS everything is insufficient evidence."""
+    out: list[dict] = []
+    n = profile["requests"]["admitted"]
+    if n < MIN_REQUESTS:
+        return out
+    mix = profile["shape_mix"]
+    if mix and mix[0]["count"] > HOT_SHARE * n:
+        top = mix[0]
+        out.append({
+            "kind": "hot-shape", "target": "tune",
+            "shape": top["shape"], "backend": top["backend"],
+            "share": top["fraction"],
+            "reason": (f"one shape serves {top['count']}/{n} requests "
+                       f"({top['fraction']:.0%} > {HOT_SHARE:.0%}) — "
+                       f"worth a tuned winner"),
+            "cli": ("python -m tpu_aggcomm.cli tune "
+                    + _shape_flags(top["shape"], top["backend"])),
+        })
+    cv = (profile["arrivals"] or {}).get("cv")
+    if mix and cv is not None and cv > SKEW_CV:
+        top = mix[0]
+        shape = top["shape"]
+        out.append({
+            "kind": "bursty-arrivals", "target": "synth",
+            "shape": shape, "backend": top["backend"], "cv": cv,
+            "reason": (f"interarrival CV {cv:.2f} > {SKEW_CV:.1f} — "
+                       f"bursty incast on the hot shape; a synthesized "
+                       f"schedule tuned for the burst window may beat "
+                       f"the reference"),
+            "cli": (f"python -m tpu_aggcomm.cli synth "
+                    f"-n {shape.get('nprocs')} "
+                    f"-a {shape.get('cb_nodes')} "
+                    f"-c {shape.get('comm_size')} "
+                    f"-d {shape.get('data_size')} "
+                    f"--seed {profile['seed']}"),
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The replay scenario (serve_loadgen --workload).
+
+def workload_scenario(blob: dict, *, seed=None, requests=None) -> list[dict]:
+    """The measured mix + arrival process as a seeded synthetic request
+    plan: ``[{"i", "at_s", "shape", "backend"}, ...]``.
+
+    Shapes are drawn weighted by measured count; interarrival gaps are
+    resampled from the measured samples — both through ONE
+    ``random.Random(seed)``, so the same artifact + seed yields the
+    byte-identical sequence (the tune/regress seed discipline)."""
+    mix = [m for m in (blob.get("shape_mix") or [])
+           if isinstance(m.get("shape"), dict) and m.get("count", 0) > 0]
+    if not mix:
+        raise ValueError("workload artifact has no shape mix to replay "
+                         "(profile a journal with admitted requests)")
+    samples = [s for s in ((blob.get("arrivals") or {})
+                           .get("interarrival_s") or [])
+               if isinstance(s, (int, float)) and s >= 0]
+    if seed is None:
+        seed = blob.get("seed", 0)
+    if requests is None:
+        requests = (blob.get("requests") or {}).get("admitted") \
+            or sum(m["count"] for m in mix)
+    rng = random.Random(int(seed))
+    weights = [m["count"] for m in mix]
+    plan: list[dict] = []
+    at = 0.0
+    for i in range(int(requests)):
+        if i and samples:
+            at += samples[rng.randrange(len(samples))]
+        m = rng.choices(mix, weights=weights)[0]
+        plan.append({"i": i, "at_s": at, "shape": dict(m["shape"]),
+                     "backend": m.get("backend")})
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Artifact I/O.
+
+def write_workload(path: str, profile: dict) -> dict:
+    """Write one workload-v1 artifact atomically (manifest records env
+    var NAMES only, the ledger discipline) and return the blob."""
+    from tpu_aggcomm.obs import ledger
+    blob = dict(profile)
+    blob["schema"] = WORKLOAD_SCHEMA
+    blob["manifest"] = ledger.manifest()
+    blob["created_unix"] = time.time()
+    with atomic_write(path) as fh:
+        json.dump(blob, fh, indent=1)
+        fh.write("\n")
+    return blob
+
+
+#: Envelope keys excluded from the replay comparison (environment-
+#: dependent by design; everything else must re-derive byte-for-byte).
+_ENVELOPE = ("schema", "manifest", "created_unix")
+
+
+def replay_workload(path: str) -> dict:
+    """Re-derive a committed WORKLOAD_r*.json from its recorded
+    journals alone and byte-compare (minus the envelope).
+
+    Journal paths resolve relative to the artifact's directory (the
+    artifact records basenames). Returns ``{"verdict": "REPRODUCED" |
+    "MISMATCH", "problems": [...]}`` with every diverging top-level key
+    named."""
+    with open(path) as fh:
+        blob = json.load(fh)
+    problems: list[str] = []
+    if blob.get("schema") != WORKLOAD_SCHEMA:
+        return {"verdict": "MISMATCH",
+                "problems": [f"schema {blob.get('schema')!r} != "
+                             f"{WORKLOAD_SCHEMA!r}"]}
+    root = os.path.dirname(os.path.abspath(path))
+    journals = []
+    for name in blob.get("journals", []):
+        jp = name if os.path.isabs(name) else os.path.join(root, name)
+        if not os.path.exists(jp):
+            problems.append(f"recorded journal {name!r} not found "
+                            f"next to the artifact ({root})")
+        journals.append(jp)
+    if problems:
+        return {"verdict": "MISMATCH", "problems": problems}
+    rederived = profile_journal(journals, seed=blob.get("seed", 0))
+    want = {k: v for k, v in blob.items() if k not in _ENVELOPE}
+    for k in sorted(set(want) | set(rederived)):
+        a = json.dumps(want.get(k), sort_keys=True)
+        b = json.dumps(rederived.get(k), sort_keys=True)
+        if a != b:
+            problems.append(f"key {k!r} does not re-derive from the "
+                            f"journal (artifact {a[:120]}... vs "
+                            f"re-derived {b[:120]}...)"
+                            if max(len(a), len(b)) > 120 else
+                            f"key {k!r}: artifact {a} vs re-derived {b}")
+    return {"verdict": "REPRODUCED" if not problems else "MISMATCH",
+            "problems": problems}
+
+
+# ---------------------------------------------------------------------------
+# Rendering.
+
+def _fmt_s(v) -> str:
+    return f"{v * 1e3:9.3f} ms" if isinstance(v, (int, float)) else "      -  "
+
+
+def render_workload(profile: dict) -> str:
+    """The ``inspect workload`` text view."""
+    r = profile["requests"]
+    lines = [f"workload profile over {', '.join(profile['journals'])} "
+             f"(seed {profile['seed']})",
+             f"  requests: {r['admitted']} admitted — {r['completed']} "
+             f"completed, {r['failed']} failed, {r['shed']} shed"
+             + (f", LOST in flight: {r['lost']}" if r["lost"] else "")]
+    a = profile["arrivals"]
+    if a["n"] > 1 and a["duration_s"] is not None:
+        cv = f"{a['cv']:.2f}" if a["cv"] is not None else "-"
+        q = a["quantiles"] or {}
+        lines.append(
+            f"  arrivals: {a['n']} over {a['duration_s']:.3f} s "
+            f"({a['rps']:.1f} req/s), interarrival p50 "
+            f"{_fmt_s(q.get('p50')).strip()} p95 "
+            f"{_fmt_s(q.get('p95')).strip()}, burstiness CV {cv}")
+    qd = profile.get("queue_depth")
+    if qd:
+        lines.append(f"  queue depth at admit: mean {qd['mean']:.1f}, "
+                     f"p95 {qd['p95']:.1f}, max {qd['max']}")
+    if profile["phase_totals"]:
+        lines.append("  phase attribution (mean over requests that "
+                     "reached the boundary):")
+        for b in BOUNDARIES[1:]:
+            st = profile["phase_totals"].get(b)
+            if st is None:
+                continue
+            lines.append(f"    {b:>9}: {_fmt_s(st['mean_s'])} mean  "
+                         f"{_fmt_s(st['p95_s'])} p95  "
+                         f"(n={st['n']}; {PHASE_MEANING.get(b, '')})")
+    if profile["shape_mix"]:
+        lines.append("  shape mix (hot first):")
+        for m in profile["shape_mix"][:8]:
+            s = m["shape"]
+            rps = f"{m['rps']:.1f} req/s" if m["rps"] is not None else "-"
+            fen = (f", {m['fences_per_request']} fences/req"
+                   if m["fences_per_request"] is not None else "")
+            lines.append(
+                f"    m={s.get('method')} n={s.get('nprocs')} "
+                f"a={s.get('cb_nodes')} c={s.get('comm_size')} "
+                f"d={s.get('data_size')} [{m['backend']}]: "
+                f"{m['count']} ({m['fraction']:.0%}), {rps}{fen}")
+        if len(profile["shape_mix"]) > 8:
+            lines.append(f"    ... {len(profile['shape_mix']) - 8} more")
+    b = profile["batching"]
+    if b["batches"]:
+        fill = f"{b['fill_ratio']:.2f}" if b["fill_ratio"] is not None \
+            else "-"
+        lines.append(
+            f"  batching: {b['batches']} dispatched batches, "
+            f"{b['requests_batched']} requests in {b['padded_slots']} "
+            f"padded slots (fill {fill}), padding waste "
+            f"{b['padding_waste_bytes']} B")
+    for p in profile["proposals"]:
+        lines.append(f"  ADVISORY [{p['kind']} -> {p['target']}]: "
+                     f"{p['reason']}")
+        lines.append(f"    {p['cli']}")
+    if not profile["proposals"] and r["admitted"] >= MIN_REQUESTS:
+        lines.append("  detection: no hot-shape/skew proposals "
+                     "(balanced mix, steady arrivals)")
+    for p in profile["problems"]:
+        lines.append(f"  PROBLEM: {p}")
+    return "\n".join(lines) + "\n"

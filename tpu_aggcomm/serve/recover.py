@@ -33,6 +33,7 @@ from __future__ import annotations
 import json
 
 from tpu_aggcomm.obs.ledger import diff_manifests
+from tpu_aggcomm.obs.workload import attribute_phases
 from tpu_aggcomm.resilience.journal import RunJournal
 
 __all__ = ["replay_journal", "prewarm_plan", "render_recovery"]
@@ -76,6 +77,15 @@ def replay_journal(path: str) -> dict:
                 if status in ("done", "fail") and rid not in admitted:
                     problems.append(f"request {rid}: {status} without an "
                                     f"admission record")
+                # phase stamps (when present) must be monotone in the
+                # canonical admit -> ... -> respond order: a reordered
+                # or hand-mangled journal line is named here, never
+                # silently accepted (obs/workload.py is the one
+                # attribution arithmetic)
+                if "phases" in rec:
+                    _, pproblems = attribute_phases(rec.get("phases"))
+                    for p in pproblems:
+                        problems.append(f"request {rid}: {p}")
                 terminal[rid] = rec
                 counts[status] += 1
         elif "state" in key and status == "state":

@@ -87,6 +87,8 @@ from collections import deque
 from tpu_aggcomm.faults import FaultSpecError, RepairError
 from tpu_aggcomm.obs import ledger, trace
 from tpu_aggcomm.obs.metrics import percentile
+from tpu_aggcomm.obs.workload import (batch_fill_ratio, padded_slots,
+                                      payload_bytes)
 from tpu_aggcomm.resilience.journal import RunJournal
 from tpu_aggcomm.resilience.policy import (RetryPolicy, retries_exhausted,
                                            retry_call)
@@ -118,7 +120,8 @@ class _Pending:
     """One enqueued request awaiting its batch."""
 
     __slots__ = ("req", "rid", "schedule", "shape_key", "backend_name",
-                 "t0", "deadline", "event", "response")
+                 "t0", "deadline", "event", "response", "marks",
+                 "depth_at_admit")
 
     def __init__(self, req, rid, schedule, shape_key, backend_name):
         self.req = req
@@ -131,6 +134,15 @@ class _Pending:
                          if req.deadline_ms is not None else None)
         self.event = threading.Event()
         self.response: dict = {}
+        # phase-boundary stamps relative to t0, in obs/workload.py's
+        # canonical BOUNDARIES order; the journal carries them verbatim
+        # and the profiler's phase attribution is their consecutive
+        # differences — never a separate host timing
+        self.marks: dict = {"admit": 0.0}
+        self.depth_at_admit: int | None = None
+
+    def mark(self, boundary: str) -> None:
+        self.marks[boundary] = time.monotonic() - self.t0
 
 
 class ScheduleServer:
@@ -197,6 +209,13 @@ class ScheduleServer:
         self._n_batches = 0
         self._n_batched_requests = 0
         self._max_batch_seen = 0
+        # batch-efficiency counters (cumulative over dispatched batches)
+        # — the SAME obs/workload.py arithmetic the profiler re-derives
+        # from the journal, so /metrics and WORKLOAD_r*.json cannot
+        # drift (telemetry_gate.py cross-checks float-exact)
+        self._fill_requests = 0   # requests dispatched
+        self._fill_slots = 0      # padded slots those requests occupied
+        self._waste_bytes = 0     # (padded - n) * payload bytes
         self._warm_s: list[float] = []
         self._cold_s: list[float] = []
         self._shed: dict[str, int] = {}
@@ -505,10 +524,13 @@ class ScheduleServer:
 
     # -- load shedding -----------------------------------------------------
     def _record_shed(self, rid: int | None, reason: str, detail: str,
-                     *, site: str | None = None, **extra) -> dict:
+                     *, site: str | None = None, phases: dict | None = None,
+                     **extra) -> dict:
         """One shed decision: counter + ledger + trace + journal +
         metrics, and the framed response the client gets — always by
-        name, never a silent drop."""
+        name, never a silent drop. ``phases`` (the boundary stamps the
+        request traversed before the shed) lands in the journal record
+        only — the profiler attributes honestly over the prefix."""
         with self._cv:
             self._shed[reason] = self._shed.get(reason, 0) + 1
             if rid is not None:
@@ -524,17 +546,19 @@ class ScheduleServer:
         if self._journal is not None and rid is not None:
             self._journal.record({"request": rid}, fingerprint=self._fp,
                                  status="shed", reason=reason,
-                                 detail=detail[:500], **extra)
+                                 detail=detail[:500], phases=phases,
+                                 **extra)
         return {"ok": False, "shed": reason, "request_id": rid,
                 "error": f"SHED[{reason}]: {detail}"}
 
     def _shed_pending(self, p: _Pending, reason: str, detail: str,
                       **extra) -> None:
         """Shed an already-queued request at a batch boundary."""
+        p.mark("respond")
         p.response = self._record_shed(
             p.rid, reason, detail, site=f"serve:dispatch:r{p.rid}",
-            **extra)
-        p.response["latency_s"] = time.monotonic() - p.t0
+            phases=dict(p.marks), **extra)
+        p.response["latency_s"] = p.marks["respond"]
         p.event.set()
 
     def _shed_conn(self, conn) -> None:
@@ -711,17 +735,21 @@ class ScheduleServer:
                 depth=depth, limit=self._max_queue))
             return
         pending = _Pending(req, rid, schedule, shape_key, backend_name)
+        pending.depth_at_admit = depth
         try:
             # admission journal record BEFORE the executor can see the
             # pending: a done/fail always follows its admitted record
             # (serve/recover.replay_journal pins the ordering), and the
-            # shape dict is what --recover pre-warms from
+            # shape dict is what --recover pre-warms from; t_unix +
+            # queue_depth feed the workload profiler's arrival-process
+            # and congestion statistics (obs/workload.py)
             if self._journal is not None:
                 shape = {f: getattr(req, f) for f in req.shape_fields}
                 self._journal.record(
                     {"request": rid}, fingerprint=self._fp,
                     status="admitted", shape=shape, backend=backend_name,
-                    iter=req.iter_, deadline_ms=req.deadline_ms)
+                    iter=req.iter_, deadline_ms=req.deadline_ms,
+                    t_unix=time.time(), queue_depth=depth)
         finally:
             with self._cv:
                 self._reserved -= 1
@@ -747,6 +775,7 @@ class ScheduleServer:
             p = self._queue.popleft()
             if (p.shape_key == head.shape_key
                     and p.backend_name == head.backend_name):
+                p.mark("queue")
                 out.append(p)
             else:
                 keep.append(p)
@@ -763,6 +792,7 @@ class ScheduleServer:
                 if not self._queue and self._stop:
                     return
                 head = self._queue.popleft()
+                head.mark("queue")
             batch = [head]
             deadline = time.monotonic() + self._batch_window_s
             while len(batch) < self._max_batch:
@@ -781,10 +811,12 @@ class ScheduleServer:
                                      depth)
             self._run_batch(batch)
 
-    def _fail_batch(self, batch, disposition: str, err: str) -> None:
+    def _fail_batch(self, batch, disposition: str, err: str, *,
+                    seq: int, padded: int | None = None) -> None:
         for p in batch:
             self._finish(p, batch_n=len(batch), disposition=disposition,
-                         compile_s=None, verified=None, error=err)
+                         compile_s=None, verified=None, error=err,
+                         batch_seq=seq, batch_padded=padded)
 
     def _run_batch(self, batch: list[_Pending]) -> None:
         # deadline sweep BEFORE compile: an expired request must not pay
@@ -800,6 +832,8 @@ class ScheduleServer:
             self._max_batch_seen = max(self._max_batch_seen, len(batch))
             if len(batch) > 1:
                 self._n_batched_requests += len(batch)
+        for p in batch:   # the --batch-window-ms gather is over
+            p.mark("batch")
         from tpu_aggcomm.serve import executor
 
         entry, reason = self._cache.lookup(
@@ -823,7 +857,8 @@ class ScheduleServer:
                         f"retry budget exhausted at serve:compile:b{seq}: "
                         f"{type(e).__name__}: {e}")
                 self._fail_batch(batch, disposition,
-                                 f"compile failed: {type(e).__name__}: {e}")
+                                 f"compile failed: {type(e).__name__}: {e}",
+                                 seq=seq)
                 return
             ledger.record_compile(
                 f"serve:{head.backend_name}:b{seq}", seconds=compile_s,
@@ -833,6 +868,8 @@ class ScheduleServer:
                 manifest=self._man, chain=chain, compile_s=compile_s)
             with self._cv:
                 self._n_compiles += 1
+        for p in batch:   # cache lookup (+ compile, on a miss) resolved
+            p.mark("cache")
         # deadline sweep again AFTER compile, BEFORE dispatch: the
         # compile wall may have outlived a budget, and shedding here is
         # still a fenced boundary (nothing dispatched yet)
@@ -840,11 +877,33 @@ class ScheduleServer:
                                              "dispatch")
         if not batch:
             return
+        # batch-efficiency accounting at the dispatch boundary, through
+        # the SAME obs/workload.py arithmetic the profiler re-derives —
+        # a dispatch-failed batch still occupied its padded slab
+        padded = padded_slots(len(batch), head.backend_name)
+        head_shape = {f: getattr(head.req, f)
+                      for f in head.req.shape_fields}
+        waste = (padded - len(batch)) * payload_bytes(head_shape)
+        with self._cv:
+            self._fill_requests += len(batch)
+            self._fill_slots += padded
+            self._waste_bytes += waste
+            fill_req, fill_slots = self._fill_requests, self._fill_slots
+            waste_total = self._waste_bytes
+        if self._registry is not None:
+            ratio = batch_fill_ratio(fill_req, fill_slots)
+            if ratio is not None:
+                self._registry.gauge("tpu_aggcomm_serve_batch_fill_ratio",
+                                     ratio)
+            self._registry.gauge("tpu_aggcomm_serve_padding_waste_bytes",
+                                 float(waste_total))
         chain = entry["chain"]
         try:
             with trace.span("serve.batch", seq=seq, n=len(batch),
                             backend=head.backend_name,
-                            method=head.schedule.method_id):
+                            method=head.schedule.method_id,
+                            padded=padded,
+                            rids=[p.rid for p in batch]):
                 results = retry_call(
                     lambda: executor.execute_batch(
                         chain, [p.req for p in batch]),
@@ -856,16 +915,22 @@ class ScheduleServer:
                     f"retry budget exhausted at serve:dispatch:b{seq}: "
                     f"{type(e).__name__}: {e}")
             self._fail_batch(batch, disposition,
-                             f"dispatch failed: {type(e).__name__}: {e}")
+                             f"dispatch failed: {type(e).__name__}: {e}",
+                             seq=seq, padded=padded)
             return
+        for p in batch:
+            p.mark("dispatch")
         for p, res in zip(batch, results):
             self._finish(p, batch_n=len(batch), disposition=disposition,
                          compile_s=compile_s, verified=res["verified"],
-                         error=res["error"])
+                         error=res["error"], batch_seq=seq,
+                         batch_padded=padded)
 
     def _finish(self, p: _Pending, *, batch_n: int, disposition: str,
-                compile_s, verified, error) -> None:
-        latency = time.monotonic() - p.t0
+                compile_s, verified, error, batch_seq: int,
+                batch_padded: int | None = None) -> None:
+        p.mark("respond")
+        latency = p.marks["respond"]   # same clock read as the stamp
         ok = error is None
         p.response = {"ok": ok, "request_id": p.rid,
                       "verified": verified, "error": error,
@@ -888,13 +953,19 @@ class ScheduleServer:
             self._registry.counter("tpu_aggcomm_serve_requests",
                                    backend=p.backend_name,
                                    outcome="ok" if ok else "error")
+        trace.instant("serve.request", rid=p.rid, ok=ok,
+                      backend=p.backend_name, cache=disposition,
+                      batch_seq=batch_seq, batch_n=batch_n,
+                      wall_s=latency, phases=dict(p.marks))
         if self._journal is not None:
             self._journal.record(
                 {"request": p.rid}, fingerprint=self._fp,
                 status="done" if ok else "fail",
                 shape_keys=[repr(p.shape_key)], backend=p.backend_name,
                 iter=p.req.iter_, latency_s=latency, batch_n=batch_n,
-                cache=disposition, error=error)
+                cache=disposition, error=error, phases=dict(p.marks),
+                batch_seq=batch_seq, batch_padded=batch_padded,
+                queue_depth=p.depth_at_admit)
         p.event.set()
 
     # -- stats -------------------------------------------------------------
@@ -939,7 +1010,12 @@ class ScheduleServer:
                                  compiles=self._n_compiles),
                    "batch": {"batches": self._n_batches,
                              "max_batch": self._max_batch_seen,
-                             "batched_requests": self._n_batched_requests}}
+                             "batched_requests": self._n_batched_requests,
+                             "dispatched_requests": self._fill_requests,
+                             "padded_slots": self._fill_slots,
+                             "fill_ratio": batch_fill_ratio(
+                                 self._fill_requests, self._fill_slots),
+                             "padding_waste_bytes": self._waste_bytes}}
         out["latency_s"] = self._quantiles(warm + cold)
         out["warm"] = {"n": len(warm),
                        "quantiles": self._quantiles(warm)}
