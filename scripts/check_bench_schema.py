@@ -30,7 +30,8 @@ from tpu_aggcomm.obs.regress import (parsed_schema_version, validate_bench,
                                      validate_compare, validate_multichip,
                                      validate_predict, validate_serve,
                                      validate_synth, validate_traffic,
-                                     validate_tune, validate_workload)
+                                     validate_tune, validate_watch,
+                                     validate_workload)
 
 
 def check(root: str) -> int:
@@ -156,6 +157,32 @@ def check(root: str) -> int:
         n_workload += 1
         n_errors += 1
         print(f"FAIL {e}")
+    # WATCH_r*.json watchtower artifacts (obs/watch.py, watch-v1):
+    # discovered through load_history like the workload rounds; an SLO
+    # evaluation or root-cause verdict the artifact's own rows +
+    # evidence blocks contradict must fail here
+    n_watch = 0
+    watch_errors: list[str] = []
+    for rnd, path, blob in load_history(root, "WATCH",
+                                        errors=watch_errors):
+        n_files += 1
+        n_watch += 1
+        errors = validate_watch(blob, os.path.basename(path))
+        if errors:
+            n_errors += len(errors)
+            for e in errors:
+                print(f"FAIL {e}")
+        else:
+            ev = blob.get("evaluation") or {}
+            tag = "compliant" if ev.get("compliant") else "VIOLATED"
+            print(f"ok   {os.path.basename(path)} "
+                  f"({blob.get('schema', '?')}, SLO {tag}, "
+                  f"{len(blob.get('anomalies') or [])} anomaly(ies))")
+    for e in watch_errors:
+        n_files += 1
+        n_watch += 1
+        n_errors += 1
+        print(f"FAIL {e}")
     from tpu_aggcomm.tune.cache import tune_paths
     for path in tune_paths(root):
         n_files += 1
@@ -211,7 +238,8 @@ def check(root: str) -> int:
         return 1
     print(f"{n_files} artifact(s) ({n_tune} tune, {n_traffic} traffic, "
           f"{n_model} model/compare, {n_serve} serve, {n_synth} synth, "
-          f"{n_workload} workload), {n_errors} schema error(s)")
+          f"{n_workload} workload, {n_watch} watch), "
+          f"{n_errors} schema error(s)")
     return 1 if n_errors else 0
 
 

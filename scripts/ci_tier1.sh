@@ -159,5 +159,21 @@ for f in WORKLOAD_r*.json; do
   [ -e "$f" ] || continue
   python -m tpu_aggcomm.cli inspect workload --replay "$f" || post_rc=1
 done
+# watchtower gate (obs/watch.py + obs/slo.py, jax-free): the committed
+# serve-journal exemplar must watch cleanly (SLO evaluation + seeded
+# changepoint detection + named root-cause attribution over the
+# already-recorded evidence streams — a bare "ANOMALY" is a
+# regression), and every committed WATCH_r*.json must --replay to
+# REPRODUCED from the stream basenames named inside it — the same
+# replay discipline as tune/PREDICT/SYNTH/WORKLOAD. An SLO verdict
+# that cannot reproduce must not be cited as monitoring evidence.
+if [ -e serve_exemplar.journal.jsonl ]; then
+  python -m tpu_aggcomm.cli inspect watch serve_exemplar.journal.jsonl \
+    > /dev/null || post_rc=1
+fi
+for f in WATCH_r*.json; do
+  [ -e "$f" ] || continue
+  python -m tpu_aggcomm.cli inspect watch --replay "$f" || post_rc=1
+done
 if [ "$rc" -eq 0 ]; then rc=$post_rc; fi
 exit $rc

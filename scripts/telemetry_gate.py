@@ -26,6 +26,13 @@ Three checks over COMMITTED artifacts only (no backend, no sweep):
    fresh ``MetricsRegistry`` and demand the parsed final values equal
    the profiler's batching block float-for-float: the /metrics numbers
    ARE the profiler's numbers, never a reimplementation.
+5. **Watchtower SLO gauges vs the committed artifact** — fold every
+   committed ``WATCH_r*.json`` through ``obs.watch.watch_registry``
+   (the same gauge names + ``measure_window`` burn arithmetic the live
+   server exports), render through a fresh ``MetricsRegistry`` and
+   demand the parsed burn-rate / compliance / anomaly-count values
+   equal the artifact's own evaluation block float-for-float (the
+   check-4 batching-gauge precedent).
 
 Usage: ``python scripts/telemetry_gate.py [root]`` (default repo root).
 Prints one line per check; exits nonzero on any failure.
@@ -187,6 +194,84 @@ def check_workload_gauges(root: str) -> int:
     return bad
 
 
+def check_watch_gauges(root: str) -> int:
+    """Gauge parity: the watchtower's /metrics fold vs the artifact.
+
+    ``watch_registry`` sets one burn-rate gauge per (objective, window)
+    from the artifact's own evaluation block VERBATIM — rendering and
+    re-parsing must land exactly on those numbers (``==`` on floats),
+    plus the compliance flags and the anomaly count."""
+    from tpu_aggcomm.obs.history import load_history
+    from tpu_aggcomm.obs.watch import watch_registry
+    errors: list[str] = []
+    hist = load_history(root, "WATCH", errors=errors)
+    bad = 0
+    for e in errors:
+        print(f"FAIL watch: {e}")
+        bad += 1
+    if not hist:
+        print("ok   watch gauges: no committed WATCH_r*.json — "
+              "check inactive")
+        return bad
+    for _rnd, path, blob in hist:
+        name = os.path.basename(path)
+        reg = export.MetricsRegistry()
+        watch_registry(blob, reg)
+        text = reg.render()
+        errs = validate_openmetrics(text)
+        if errs:
+            for e in errs:
+                print(f"FAIL {name}: openmetrics: {e}")
+            bad += len(errs)
+            continue
+        samples = _sample_map(parse_openmetrics(text))
+        n_checked = 0
+        ev = blob.get("evaluation") or {}
+        for obj in ev.get("objectives", []):
+            oname = obj["name"]
+            wants = {}
+            for wname, entries in (obj.get("windows") or {}).items():
+                live = [e["burn"] for e in entries
+                        if e.get("burn") is not None]
+                if live:
+                    wants[wname] = live[-1]
+            overall = (obj.get("overall") or {}).get("burn")
+            if overall is not None:
+                wants["overall"] = overall
+            for wname, want in wants.items():
+                got = samples.get(
+                    ("tpu_aggcomm_slo_burn_rate",
+                     tuple(sorted({"objective": oname,
+                                   "window": wname}.items()))))
+                if got != want:
+                    print(f"FAIL {name}: burn gauge "
+                          f"[{oname}/{wname}] renders {got!r} but the "
+                          f"artifact's evaluation says {want!r}")
+                    bad += 1
+                n_checked += 1
+            want_c = None if obj.get("compliant") is None \
+                else (1.0 if obj["compliant"] else 0.0)
+            got_c = samples.get(
+                ("tpu_aggcomm_slo_compliant",
+                 tuple(sorted({"objective": oname}.items()))))
+            if got_c != want_c:
+                print(f"FAIL {name}: compliance gauge [{oname}] "
+                      f"renders {got_c!r} but the artifact says "
+                      f"{want_c!r}")
+                bad += 1
+        want_n = float(len(blob.get("anomalies") or []))
+        got_n = samples.get(("tpu_aggcomm_watch_anomalies", ()))
+        if got_n != want_n:
+            print(f"FAIL {name}: anomaly-count gauge renders {got_n!r} "
+                  f"but the artifact records {want_n!r}")
+            bad += 1
+        if not bad:
+            print(f"ok   {name}: SLO gauges float-exact vs artifact "
+                  f"({n_checked} burn window(s), "
+                  f"{len(ev.get('objectives', []))} objective(s))")
+    return bad
+
+
 def main(root: str) -> int:
     traces = sorted(glob.glob(os.path.join(root, "*.trace.jsonl")))
     if not traces:
@@ -197,6 +282,7 @@ def main(root: str) -> int:
         n_bad += check_trace(path)
     n_bad += check_trend_consistency(root)
     n_bad += check_workload_gauges(root)
+    n_bad += check_watch_gauges(root)
     print(f"{len(traces)} trace(s) checked, {n_bad} failure(s)")
     return 1 if n_bad else 0
 

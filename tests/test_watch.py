@@ -1,0 +1,624 @@
+"""Watchtower tests (obs/watch.py + obs/slo.py — ISSUE 18).
+
+The pins that define the subsystem:
+
+- **One SLO arithmetic**: ``burn_rate``/``measure_window`` are shared
+  verbatim between the evaluator, the server's live gauges
+  (``LiveSlo``) and the artifact fold (``watch_registry``) — rendered
+  gauge values equal re-computed window values float-exactly.
+- **Seeded detection**: the changepoint scan is the regression-gate
+  double gate (point step beyond tolerance AND seeded-bootstrap CI
+  excluding zero); same streams + same seed ⟹ the same anomalies
+  byte-for-byte.
+- **Named causes**: every attribution verdict cites a stream from
+  ``EVIDENCE_STREAMS`` and the fallback is UNEXPLAINED with the
+  residual quantified — a bare "ANOMALY" is a regression, and
+  ``validate_watch`` rejects it.
+- **Artifacts are self-proving**: ``WATCH_r*.json`` validates,
+  replays REPRODUCED from the stream basenames recorded inside it,
+  and every corruption is named, not absorbed.
+- **Crash honesty**: torn journal/trace lines are COUNTED into the
+  integrity block (never silently skipped), admitted-but-unterminated
+  requests are named lost — and ``inspect live`` surfaces the same
+  counters.
+- **jax-free**: obs/watch.py, obs/slo.py and ``cli inspect watch``
+  run where ``import jax`` raises (poisoned-jax subprocess, the obs
+  discipline — monitoring must answer on a wedged tunnel).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+import _jaxfree
+
+REPO = _jaxfree.REPO
+
+from tpu_aggcomm.obs.regress import validate_watch
+from tpu_aggcomm.obs.slo import (DEFAULT_SLO, SloError, burn_rate, load_slo,
+                                 validate_slo)
+from tpu_aggcomm.obs.watch import (CHANGE_TOLERANCE, EVIDENCE_STREAMS,
+                                   LiveSlo, attribute_anomaly,
+                                   detect_changepoint, evaluate_slo,
+                                   measure_window, replay_watch,
+                                   tail_journal, watch_registry,
+                                   watch_streams, write_watch)
+from tpu_aggcomm.resilience.journal import RunJournal
+
+_SHAPE = {"method": 3, "nprocs": 8, "cb_nodes": 2, "comm_size": 2,
+          "data_size": 64}
+
+
+# ---------------------------------------------------------------------------
+# Synthetic journals (the test_workload_profile recipe, plus cache/shed
+# dispositions and lifecycle records the watchtower consumes).
+
+
+def _stamps(scale=1.0):
+    return {"admit": 0.0, "queue": 0.001 * scale, "batch": 0.002 * scale,
+            "cache": 0.0021 * scale, "dispatch": 0.004 * scale,
+            "respond": 0.0042 * scale}
+
+
+def _write_journal(path, rows, *, torn_tail=False, lost_rid=None,
+                   states=(), manifest=None):
+    """``rows`` entries: {"stamps": ..., "cache": ..., "status": ...,
+    "reason": ..., "deadline_ms": ...} — journal-field shaped."""
+    j = RunJournal(str(path))
+    fp = j.begin_session(manifest if manifest is not None
+                         else {"jax": "0.0-test"})
+    t0 = 1_700_000_000.0
+    for i, row in enumerate(rows):
+        j.record({"request": i}, fingerprint=fp, status="admitted",
+                 shape=dict(_SHAPE), backend="jax_sim", iter=i,
+                 t_unix=t0 + 0.05 * i, queue_depth=i % 3,
+                 deadline_ms=row.get("deadline_ms"))
+        status = row.get("status", "done")
+        if status == "shed":
+            j.record({"request": i}, fingerprint=fp, status="shed",
+                     reason=row.get("reason", "queue-full"))
+            continue
+        stamps = row["stamps"]
+        j.record({"request": i}, fingerprint=fp, status=status,
+                 latency_s=stamps.get("respond"), batch_n=1,
+                 cache=row.get("cache", "hit"), phases=dict(stamps),
+                 batch_seq=i, batch_padded=row.get("padded", 1),
+                 queue_depth=None)
+    for st in states:
+        j.record({"state": 1}, fingerprint=fp, status="state", **st)
+    if lost_rid is not None:
+        j.record({"request": lost_rid}, fingerprint=fp,
+                 status="admitted", shape=dict(_SHAPE),
+                 backend="jax_sim", t_unix=t0 + 99.0, queue_depth=0)
+    if torn_tail:
+        with open(path, "a") as fh:
+            fh.write('{"key": {"request": 500}, "status": "don')
+    return path
+
+
+def _step_rows(n_before=6, n_after=6, after_scale=2.0, **over):
+    rows = [dict({"stamps": _stamps(1.0)}, **over) for _ in range(n_before)]
+    rows += [dict({"stamps": _stamps(after_scale)}, **over)
+             for _ in range(n_after)]
+    return rows
+
+
+def _write_trace(path, walls_by_round):
+    """A minimal trace stream: one run, one rep, two ranks per round —
+    round_stats' wall (max over ranks) lands exactly on the given
+    values."""
+    events = [{"ev": "run", "id": 0, "method": 3, "name": "theta",
+               "backend": "jax_sim", "nprocs": 8, "data_size": 64}]
+    for rnd, wall in enumerate(walls_by_round):
+        for rank in (0, 1):
+            events.append({"ev": "span", "run": 0, "rep": 0, "rank": rank,
+                           "round": rnd, "bucket": "sendrecv",
+                           "dur_s": wall if rank == 0 else wall * 0.5})
+    with open(path, "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# The SLO spec + window arithmetic.
+
+
+def test_slo_spec_validation_and_load(tmp_path):
+    assert validate_slo(DEFAULT_SLO) == []
+    bad = json.loads(json.dumps(DEFAULT_SLO))
+    bad["objectives"][0]["target"] = 1.0  # zero error budget: refused
+    errs = validate_slo(bad)
+    assert errs and any("target" in e for e in errs)
+    bad2 = json.loads(json.dumps(DEFAULT_SLO))
+    bad2["objectives"][0]["kind"] = "vibes"
+    assert any("kind" in e for e in validate_slo(bad2))
+    # load_slo: parse/validate errors raise SloError naming the file
+    p = tmp_path / "slo.json"
+    p.write_text("{not json")
+    with pytest.raises(SloError, match="slo.json"):
+        load_slo(str(p))
+    p.write_text(json.dumps(DEFAULT_SLO))
+    assert load_slo(str(p))["schema"] == DEFAULT_SLO["schema"]
+
+
+def test_burn_rate_is_the_one_arithmetic():
+    assert burn_rate(0, 10, 0.1) == 0.0
+    assert burn_rate(1, 10, 0.1) == 1.0   # exactly on budget
+    assert burn_rate(2, 10, 0.1) == 2.0   # burning 2x
+    assert burn_rate(0, 0, 0.1) is None   # vacuous, not compliant
+
+
+def test_measure_window_kinds_and_vacuous_windows():
+    rows = [{"rid": 0, "status": "done", "cache": "hit", "wall_s": 0.01,
+             "phases": {}, "deadline_ms": 100, "batch": {"seq": 0, "n": 3,
+                                                         "padded": 4}},
+            {"rid": 1, "status": "done", "cache": "miss", "wall_s": 5.0,
+             "phases": {}, "deadline_ms": None, "batch": {"seq": 0, "n": 3,
+                                                          "padded": 4}},
+            {"rid": 2, "status": "shed", "shed_reason": "deadline",
+             "wall_s": None, "phases": {}, "deadline_ms": 50,
+             "batch": None}]
+    warm = measure_window(rows, {"kind": "warm-latency", "target": 0.9,
+                                 "threshold_s": 2.0})
+    # only the done+hit request qualifies; its wall is under threshold
+    assert (warm["total"], warm["bad"], warm["sli"]) == (1, 0, 0.01)
+    good = measure_window(rows, {"kind": "goodput", "target": 0.9})
+    assert (good["total"], good["bad"]) == (3, 1)
+    assert good["burn"] == burn_rate(1, 3, 1.0 - 0.9)  # SAME arithmetic
+    assert not good["compliant"]
+    shed = measure_window(rows, {"kind": "shed-rate", "target": 0.9})
+    assert (shed["total"], shed["bad"]) == (3, 1)
+    dl = measure_window(rows, {"kind": "deadline-miss", "target": 0.9})
+    # rid 0 inside its deadline; rid 2 is a deadline shed
+    assert (dl["total"], dl["bad"]) == (2, 1)
+    pad = measure_window(rows, {"kind": "padding-waste", "target": 0.5})
+    # one unique batch: 3 of 4 padded slots filled
+    assert (pad["total"], pad["bad"], pad["sli"]) == (4, 1, 0.75)
+    # vacuous window: burn None, compliant None — not a violation
+    vac = measure_window([], {"kind": "goodput", "target": 0.9})
+    assert vac["burn"] is None and vac["compliant"] is None
+    with pytest.raises(ValueError, match="vibes"):
+        measure_window(rows, {"kind": "vibes", "target": 0.9})
+
+
+def test_evaluate_slo_tumbling_windows_include_the_tail():
+    rows = [{"rid": i, "status": "done", "cache": "hit",
+             "wall_s": 0.01, "phases": {}, "deadline_ms": None,
+             "batch": None} for i in range(10)]
+    ev = evaluate_slo(rows, DEFAULT_SLO)
+    assert ev["compliant"] is True
+    good = [o for o in ev["objectives"] if o["kind"] == "goodput"][0]
+    fast = good["windows"]["fast"]
+    # 10 rows over 8-request tumbling windows = one full + one partial
+    assert [e["n"] for e in fast] == [8, 2]
+    assert (fast[0]["start_rid"], fast[1]["end_rid"]) == (0, 9)
+
+
+# ---------------------------------------------------------------------------
+# Seeded changepoint detection.
+
+
+def test_detect_changepoint_seeded_and_double_gated():
+    flat = [1.0] * 16
+    assert detect_changepoint(flat) is None
+    short = [1.0] * 3 + [5.0] * 4      # < 2 * MIN_SEGMENT
+    assert detect_changepoint(short) is None
+    step = [1.0] * 6 + [2.0] * 6
+    det = detect_changepoint(step, seed=0)
+    assert det is not None and det["index"] == 6
+    assert det["direction"] == "up" and det["delta_rel"] > CHANGE_TOLERANCE
+    lo, hi = det["ci_rel"]
+    assert lo > 0  # CI excludes zero
+    # seeded: byte-identical on re-run; a different seed changes only
+    # the bootstrap CI, never the split
+    again = detect_changepoint(step, seed=0)
+    assert json.dumps(det) == json.dumps(again)
+    other = detect_changepoint(step, seed=7)
+    assert other["index"] == det["index"] and other["seed"] == 7
+    # a step under tolerance is discarded by the point gate
+    assert detect_changepoint([1.0] * 6 + [1.1] * 6) is None
+    down = detect_changepoint([2.0] * 6 + [1.0] * 6)
+    assert down["direction"] == "down" and down["ci_rel"][1] < 0
+
+
+# ---------------------------------------------------------------------------
+# Root-cause attribution: a fixed chain of NAMED verdicts.
+
+
+_DET = {"index": 6, "delta_rel": 0.5, "direction": "up"}
+
+
+def _rows_for(split=6, n=12, **after_over):
+    rows = []
+    for i in range(n):
+        r = {"rid": i, "status": "done", "cache": "hit", "wall_s": 0.01,
+             "phases": {"cache": 0.001}, "shed_reason": None,
+             "deadline_ms": None, "batch": None}
+        if i >= split:
+            r.update(after_over)
+        rows.append(r)
+    return rows
+
+
+_NO_EVIDENCE = {"sessions": [], "states": [], "resilience_retries":
+                {"count": 0, "sites": []}, "explain": {}}
+
+
+def test_attribution_chain_every_verdict_named():
+    rows = _rows_for()
+    # ledger: manifest drift between journal sessions
+    ev = dict(_NO_EVIDENCE, sessions=[
+        {"fingerprint": "a", "drift": []},
+        {"fingerprint": "b", "drift": ["versions.jax: 1 -> 2"]}])
+    v = attribute_anomaly(_DET, rows=rows, evidence=ev, split_rid=6)
+    assert v["cause"] == "cache-eviction/compile-storm"
+    assert v["evidence"] == "ledger" and "versions.jax" in v["detail"]
+    # ledger: evictions after the step
+    v = attribute_anomaly(_DET, rows=_rows_for(cache="evict"),
+                          evidence=_NO_EVIDENCE, split_rid=6)
+    assert v["evidence"] == "ledger" and "eviction" in v["detail"]
+    # ledger: miss-fraction rise
+    v = attribute_anomaly(_DET, rows=_rows_for(cache="miss"),
+                          evidence=_NO_EVIDENCE, split_rid=6)
+    assert v["evidence"] == "ledger" and "miss fraction" in v["detail"]
+    # resilience: DEGRADED lifecycle
+    ev = dict(_NO_EVIDENCE, states=[{"state": "degraded", "prev": "ready",
+                                     "reason": "retries_exhausted"}])
+    v = attribute_anomaly(_DET, rows=rows, evidence=ev, split_rid=6)
+    assert v["cause"] == "tunnel-degradation"
+    assert v["evidence"] == "resilience"
+    # resilience: retry attempts in the trace records
+    ev = dict(_NO_EVIDENCE, resilience_retries={"count": 3,
+                                                "sites": ["dispatch"]})
+    v = attribute_anomaly(_DET, rows=rows, evidence=ev, split_rid=6)
+    assert v["evidence"] == "resilience" and "dispatch" in v["detail"]
+    # shed: cascade with the reasons named
+    v = attribute_anomaly(_DET, evidence=_NO_EVIDENCE, split_rid=6,
+                          rows=_rows_for(status="shed", wall_s=None,
+                                         shed_reason="queue-full"))
+    assert v["cause"] == "shed-cascade" and v["evidence"] == "shed"
+    assert "queue-full" in v["detail"]
+    # explain: the cost model names the bound
+    v = attribute_anomaly(
+        _DET, rows=rows, evidence=_NO_EVIDENCE,
+        explain_rounds=[{"round": 7, "verdict": "incast-bound",
+                         "deviation_rel": 0.0}])
+    assert v["cause"] == "incast-bound" and v["evidence"] == "explain"
+    # fallback: UNEXPLAINED with the residual QUANTIFIED — never bare
+    v = attribute_anomaly(_DET, rows=rows, evidence=_NO_EVIDENCE,
+                          split_rid=6)
+    assert v["cause"] == "UNEXPLAINED" and v["evidence"] == "none"
+    assert "%" in v["detail"]
+    # every verdict above named a stream from the contract enum
+    assert all(e in EVIDENCE_STREAMS for e in
+               ("ledger", "resilience", "shed", "explain", "none"))
+
+
+# ---------------------------------------------------------------------------
+# The pipeline: tail → evaluate → detect → attribute.
+
+
+def test_tail_journal_counts_torn_lines(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl",
+                           [{"stamps": _stamps()}], torn_tail=True)
+    with open(jpath, "a") as fh:
+        fh.write("\n[1, 2]\n")  # parseable but not a record: counted too
+    tail = tail_journal(str(jpath))
+    assert tail["skipped_lines"] == 2
+    assert len(tail["sessions"]) == 1 and len(tail["records"]) == 2
+    # a missing journal is empty, not an exception
+    assert tail_journal(str(tmp_path / "nope.jsonl"))["records"] == []
+
+
+def test_watch_streams_detects_and_stays_deterministic(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl", _step_rows())
+    body = watch_streams([str(jpath)])
+    assert body["problems"] == []
+    assert body["requests"]["admitted"] == 12
+    # wall_s is the canonical phase sum (identical computation)
+    for r in body["per_request"]:
+        assert r["wall_s"] == sum(r["phases"].values())
+    assert body["evaluation"]["compliant"] is True
+    # the engineered step is found, located, and honestly UNEXPLAINED
+    [a] = body["anomalies"]
+    assert a["stream"] == "request-walls" and a["at_rid"] == 6
+    assert a["cause"] == "UNEXPLAINED" and a["evidence"] == "none"
+    assert "%" in a["detail"]
+    # deterministic: same streams + seed ⟹ byte-identical body
+    again = watch_streams([str(jpath)])
+    assert json.dumps(body, sort_keys=True) == \
+        json.dumps(again, sort_keys=True)
+    # an invalid SLO spec is refused by name, not absorbed
+    bad = json.loads(json.dumps(DEFAULT_SLO))
+    bad["objectives"][0]["target"] = 2.0
+    with pytest.raises(ValueError, match="invalid SLO spec"):
+        watch_streams([str(jpath)], slo=bad)
+
+
+def test_watch_streams_attributes_miss_storm_to_ledger(tmp_path):
+    rows = _step_rows()
+    for r in rows[6:]:
+        r["cache"] = "miss"
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl", rows)
+    [a] = watch_streams([str(jpath)])["anomalies"]
+    assert a["cause"] == "cache-eviction/compile-storm"
+    assert a["evidence"] == "ledger"
+
+
+def test_watch_streams_round_walls_and_degraded(tmp_path):
+    jpath = _write_journal(
+        tmp_path / "serve.journal.jsonl",
+        [{"stamps": _stamps()}] * 2,
+        states=({"state": "degraded", "prev": "ready",
+                 "reason": "retries_exhausted"},))
+    tpath = _write_trace(tmp_path / "run.trace.jsonl",
+                         [1e-3] * 6 + [3e-3] * 6)
+    body = watch_streams([str(jpath)], [str(tpath)])
+    [a] = body["anomalies"]
+    assert a["stream"] == "round-walls:run.trace.jsonl#run0"
+    assert a["at_round"] == 6
+    # the DEGRADED lifecycle record wins the attribution chain
+    assert a["cause"] == "tunnel-degradation"
+    assert a["evidence"] == "resilience"
+    assert body["evidence"]["states"][0]["state"] == "degraded"
+
+
+def test_integrity_counts_torn_and_lost(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl",
+                           [{"stamps": _stamps()}] * 2,
+                           torn_tail=True, lost_rid=99)
+    tpath = tmp_path / "run.trace.jsonl"
+    _write_trace(tpath, [1e-3] * 4)
+    with open(tpath, "a") as fh:
+        fh.write('{"ev": "span", "run": 0, "re')
+    body = watch_streams([str(jpath)], [str(tpath)])
+    assert body["integrity"] == {"journal_torn_lines": 1,
+                                 "trace_torn_lines": 1,
+                                 "lost_requests": [99]}
+    assert body["requests"]["lost"] == [99]
+
+
+# ---------------------------------------------------------------------------
+# Artifacts: validate, replay, and name every corruption.
+
+
+def test_artifact_validates_replays_and_names_corruption(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl", _step_rows())
+    body = watch_streams([str(jpath)])
+    art = tmp_path / "WATCH_r07.json"
+    blob = write_watch(str(art), body)
+    assert validate_watch(blob) == []
+    rep = replay_watch(str(art))
+    assert rep["verdict"] == "REPRODUCED", rep["problems"]
+
+    def probe(mutate, want):
+        bad = json.loads(json.dumps(blob))
+        mutate(bad)
+        errs = validate_watch(bad)
+        assert errs and any(want in e for e in errs), (want, errs)
+
+    probe(lambda b: b["per_request"][0].__setitem__("wall_s", 1.0),
+          "canonical")
+    probe(lambda b: b["evaluation"].__setitem__("compliant", False),
+          "re-derive")
+    probe(lambda b: b["anomalies"][0].__setitem__("cause", "ANOMALY"),
+          "re-derive")
+    probe(lambda b: b["anomalies"][0].__setitem__("evidence", "vibes"),
+          "evidence stream")
+    probe(lambda b: b.__setitem__("anomalies", []), "omits")
+    probe(lambda b: b["requests"].__setitem__("completed", 99), "rows")
+    probe(lambda b: b.__setitem__("problems", ["oops"]),
+          "must not be committed")
+    # ...and a doctored artifact must fail --replay with the key named
+    doctored = json.loads(json.dumps(blob))
+    doctored["requests"]["completed"] = 99
+    with open(tmp_path / "WATCH_r08.json", "w") as fh:
+        json.dump(doctored, fh)
+    rep = replay_watch(str(tmp_path / "WATCH_r08.json"))
+    assert rep["verdict"] == "MISMATCH"
+    assert any("'requests'" in p for p in rep["problems"])
+    # a replay whose streams went missing names THEM
+    os.rename(jpath, tmp_path / "gone.jsonl")
+    rep = replay_watch(str(art))
+    assert rep["verdict"] == "MISMATCH"
+    assert any("not found" in p for p in rep["problems"])
+
+
+def test_committed_exemplar_artifact_accepts():
+    path = os.path.join(REPO, "WATCH_r01.json")
+    with open(path) as fh:
+        blob = json.load(fh)
+    assert validate_watch(blob, "WATCH_r01.json") == []
+    rep = replay_watch(path)
+    assert rep["verdict"] == "REPRODUCED", rep["problems"]
+    # the committed exemplar's one anomaly is the honest kind: a step
+    # with no matching evidence, quantified — never a bare "ANOMALY"
+    [a] = blob["anomalies"]
+    assert a["cause"] == "UNEXPLAINED" and a["evidence"] in EVIDENCE_STREAMS
+
+
+# ---------------------------------------------------------------------------
+# The live side: gauges share measure_window, the hook is gated.
+
+
+def test_live_slo_gauges_match_measure_window():
+    from tpu_aggcomm.obs.export import MetricsRegistry
+    from tpu_aggcomm.obs.regress import parse_openmetrics
+    reg = MetricsRegistry()
+    live = LiveSlo(reg)
+    rows = []
+    for i in range(10):
+        wall = 0.01 if i < 7 else 5.0
+        live.record(status="done", wall_s=wall, cache="hit")
+        rows.append({"rid": i, "status": "done", "wall_s": wall,
+                     "phases": {}, "cache": "hit", "shed_reason": None,
+                     "deadline_ms": None, "batch": None})
+    parsed = parse_openmetrics(reg.render())
+    samples = {(s["name"], tuple(sorted(s["labels"].items()))): s["value"]
+               for s in parsed["samples"]}
+    warm = [o for o in DEFAULT_SLO["objectives"]
+            if o["kind"] == "warm-latency"][0]
+    for w in DEFAULT_SLO["windows"]:
+        want = measure_window(rows[-w["requests"]:], warm)["burn"]
+        got = samples.get(("tpu_aggcomm_slo_burn_rate",
+                           (("objective", warm["name"]),
+                            ("window", w["name"]))))
+        assert got == want  # identical arithmetic ⟹ == on floats
+    with pytest.raises(ValueError, match="invalid SLO spec"):
+        LiveSlo(MetricsRegistry(), slo={"schema": "slo-v1", "windows": [],
+                                        "objectives": []})
+
+
+def test_watch_registry_folds_artifact_numbers_verbatim(tmp_path):
+    from tpu_aggcomm.obs.export import MetricsRegistry
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl", _step_rows())
+    blob = write_watch(str(tmp_path / "WATCH_r01.json"),
+                       watch_streams([str(jpath)]))
+    reg = MetricsRegistry()
+    watch_registry(blob, reg)
+    text = reg.render()
+    assert "tpu_aggcomm_slo_burn_rate" in text
+    assert "tpu_aggcomm_slo_compliant_all 1.0" in text
+    assert "tpu_aggcomm_watch_anomalies 1.0" in text
+
+
+def test_serve_hook_is_import_gated(tmp_path, monkeypatch):
+    """An unarmed server never constructs LiveSlo (nor loads
+    obs.export/obs.watch on its account); an armed one records terminal
+    requests through it."""
+    from tpu_aggcomm.serve import executor
+    from tpu_aggcomm.serve.protocol import ServeClient
+    from tpu_aggcomm.serve.server import ScheduleServer
+    monkeypatch.setattr(executor, "build_chain",
+                        lambda schedule, backend_name: (object(), 1e-3))
+    monkeypatch.setattr(
+        executor, "execute_batch",
+        lambda chain, reqs: [{"verified": None, "error": None}
+                             for _ in reqs])
+    monkeypatch.delenv("TPU_AGGCOMM_METRICS_PORT", raising=False)
+    srv = ScheduleServer(port=0, max_batch=2, batch_window_s=0.01)
+    assert srv._slo is None  # OFF by default: the hot path stays bare
+    srv.close()
+    srv = ScheduleServer(port=0, max_batch=2, batch_window_s=0.01,
+                         metrics_port=0)
+    assert srv._slo is not None
+    srv.start()
+    try:
+        with ServeClient(srv.port, timeout=120.0) as c:
+            assert c.run(**dict(_SHAPE, iter=0))["ok"]
+    finally:
+        srv.stop()
+        srv.close()
+    text = srv._registry.render()
+    assert "tpu_aggcomm_slo_burn_rate" in text
+    assert 'tpu_aggcomm_slo_compliant{objective="goodput"} 1.0' in text
+
+
+# ---------------------------------------------------------------------------
+# Satellites: inspect live integrity + history discovery.
+
+
+def test_live_surfaces_torn_and_lost_by_name(tmp_path):
+    from tpu_aggcomm.obs.live import (render_live, sweep_status,
+                                      tail_events_counted)
+    tpath = tmp_path / "x.trace.jsonl"
+    _write_trace(tpath, [1e-3])
+    with open(tpath, "a") as fh:
+        fh.write('{"ev": "span", "tor')
+    events, skipped = tail_events_counted(str(tpath))
+    assert skipped == 1 and events[0]["ev"] == "run"
+    # a serve journal pointed at inspect live: torn lines + the
+    # admitted-but-never-terminal request land in the integrity block
+    csv = tmp_path / "r.csv"
+    _write_journal(str(csv) + ".journal.jsonl",
+                   [{"stamps": _stamps()}], torn_tail=True, lost_rid=42)
+    status = sweep_status(str(csv), trace_paths=[str(tpath)])
+    assert status["integrity"]["journal_torn_lines"] == 1
+    assert status["integrity"]["trace_torn_lines"] == 1
+    assert status["integrity"]["lost_requests"] == [42]
+    text = render_live(status)
+    assert "torn journal line" in text and "LOST in flight" in text
+    assert "[42]" in text
+
+
+def test_history_discovers_watch_series(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl", _step_rows())
+    write_watch(str(tmp_path / "WATCH_r02.json"),
+                watch_streams([str(jpath)]))
+    from tpu_aggcomm.obs.history import (build_index, check_trends,
+                                         watch_series)
+    series = watch_series(str(tmp_path))
+    pts = series["slo worst burn"]
+    assert len(pts) == 1 and pts[0]["round"] == 2
+    assert pts[0]["unit"] == "x" and pts[0]["samples_n"] == 12
+    assert pts[0]["compliant"] is True and pts[0]["anomalies"] == 1
+    idx = build_index(str(tmp_path))
+    assert [w["file"] for w in idx["watch"]] == ["WATCH_r02.json"]
+    assert idx["watch"][0]["causes"] == ["UNEXPLAINED"]
+    assert "slo worst burn" in check_trends(str(tmp_path))["series"]
+
+
+# ---------------------------------------------------------------------------
+# The jax-free pins (the obs discipline, subprocess-enforced).
+
+
+def test_watchtower_is_jaxfree(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl", _step_rows())
+    code = (
+        _jaxfree.pure_import_code("tpu_aggcomm.obs.watch") +
+        "; " + _jaxfree.pure_import_code("tpu_aggcomm.obs.slo") +
+        "; from tpu_aggcomm.obs.watch import watch_streams, write_watch, "
+        "replay_watch"
+        f"; b = watch_streams([{str(jpath)!r}])"
+        "; assert b['problems'] == [] and len(b['anomalies']) == 1"
+        "; assert b['anomalies'][0]['cause'] == 'UNEXPLAINED'"
+        f"; write_watch({str(tmp_path / 'WATCH_r01.json')!r}, b)"
+        f"; r = replay_watch({str(tmp_path / 'WATCH_r01.json')!r})"
+        "; assert r['verdict'] == 'REPRODUCED', r['problems']"
+        "; import sys; assert 'jax' not in sys.modules")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=str(tmp_path),
+        env=_jaxfree.poisoned_env(
+            tmp_path, "the watchtower must answer where a wedged tunnel "
+                      "hangs import jax"),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_cli_inspect_watch_is_jaxfree(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl", _step_rows())
+    env = _jaxfree.poisoned_env(
+        tmp_path, "inspect watch must answer on a wedged tunnel")
+    art = tmp_path / "WATCH_r03.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "watch",
+         str(jpath), "--seed", "0", "--json", str(art)],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "watchtower over" in proc.stdout
+    assert "ANOMALY [request-walls]" in proc.stdout
+    assert "watch artifact written" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "watch",
+         "--replay", str(art)],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "REPRODUCED" in proc.stdout
+
+
+def test_cli_follow_refuses_json(tmp_path):
+    jpath = _write_journal(tmp_path / "serve.journal.jsonl",
+                           [{"stamps": _stamps()}])
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "watch",
+         str(jpath), "--follow", "--json", str(tmp_path / "w.json")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert "--follow" in proc.stderr and "--json" in proc.stderr

@@ -475,7 +475,8 @@ def build_parser() -> argparse.ArgumentParser:
                                                  "report", "ledger",
                                                  "traffic", "check",
                                                  "live", "history",
-                                                 "explain", "workload"],
+                                                 "explain", "workload",
+                                                 "watch"],
                      default=None,
                      help="'trace' to summarize *.trace.jsonl files, "
                           "'compare' to diff two of them, 'report' for "
@@ -503,7 +504,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "profiler (obs/workload.py, jax-free): "
                           "per-request phase attribution, shape mix, "
                           "arrival process, batch efficiency, advisory "
-                          "hot-shape/skew proposals")
+                          "hot-shape/skew proposals, 'watch' for the "
+                          "streaming SLO watchtower (obs/watch.py, "
+                          "jax-free): error-budget burn rates over the "
+                          "serve journal, seeded changepoint anomalies "
+                          "over request + round walls, NAMED root-cause "
+                          "verdicts joined from ledger/resilience/shed/"
+                          "explain evidence")
     ins.add_argument("trace_file", nargs="*", default=[],
                      help="trace files: one or more to summarize "
                           "('trace'), exactly two files or directories to "
@@ -512,7 +519,9 @@ def build_parser() -> argparse.ArgumentParser:
                           "BENCH_r*.json and/or *.trace.jsonl artifacts "
                           "(default: every BENCH_r*.json under "
                           "--history-root); for 'workload': one or more "
-                          "serve journals (*.journal.jsonl)")
+                          "serve journals (*.journal.jsonl); for "
+                          "'watch': serve journals plus optional "
+                          "*.trace.jsonl (split by suffix)")
     ins.add_argument("--by", choices=["rank", "round", "phase"],
                      default="rank",
                      help="compare grouping key (default: rank)")
@@ -589,11 +598,19 @@ def build_parser() -> argparse.ArgumentParser:
                           "'workload': re-derive WORKLOAD_r*.json from "
                           "the journals recorded next to it (same "
                           "contract; ci_tier1.sh gates the committed "
-                          "exemplar)")
+                          "exemplar); 'watch': re-derive WATCH_r*.json "
+                          "from the streams + embedded SLO spec + seed "
+                          "recorded inside it (same contract; "
+                          "ci_tier1.sh gates the committed exemplar)")
     ins.add_argument("--seed", type=int, default=0,
-                     help="'workload' only: seed recorded in the "
-                          "profile and used by the advisory detector + "
-                          "scenario re-injection (default: 0)")
+                     help="'workload'/'watch': seed recorded in the "
+                          "artifact and used by the advisory detector / "
+                          "changepoint bootstrap (default: 0)")
+    ins.add_argument("--slo", metavar="FILE", default=None,
+                     help="'watch' only: slo-v1 spec file (objectives + "
+                          "windows); default: the built-in lenient spec "
+                          "(obs/slo.DEFAULT_SLO), embedded verbatim in "
+                          "the artifact either way")
     ins.add_argument("--results-csv", default="results.csv",
                      help="'live' only: the running sweep's results CSV "
                           "— its crash-safe journal "
@@ -1924,6 +1941,89 @@ def _run_inspect_workload(args) -> int:
     return 0
 
 
+def _run_inspect_watch(args) -> int:
+    """The streaming SLO watchtower (obs/watch.py, jax-free).
+
+    Three modes: ``--replay WATCH_r*.json`` re-derives a committed
+    artifact from the stream basenames + embedded SLO spec + seed
+    recorded inside it (REPRODUCED or MISMATCH with the diverging keys
+    named — the ci_tier1.sh gate); ``watch JOURNAL... [TRACE...]``
+    runs one tail→evaluate→detect→attribute pass (``--json PATH``
+    writes the watch-v1 artifact, refused while the journal disagrees
+    with itself); ``--follow`` re-renders every ``--interval`` seconds
+    (read-only, Ctrl-C to detach — the live tail the SLO windows were
+    built for). Verdicts are advisory (the resilience/detect.py
+    pattern): anomalies name suspects, nothing changes what runs."""
+    import os
+    import time as _time
+
+    from tpu_aggcomm.obs.slo import DEFAULT_SLO, SloError, load_slo
+    from tpu_aggcomm.obs.watch import (render_watch, replay_watch,
+                                       watch_streams, write_watch)
+    if args.replay:
+        try:
+            res = replay_watch(args.replay)
+        except (OSError, ValueError, KeyError) as e:
+            raise SystemExit(f"inspect watch --replay: {e}")
+        if res["verdict"] == "REPRODUCED":
+            print(f"watch replay: REPRODUCED ({args.replay})")
+            return 0
+        print(f"watch replay: MISMATCH vs {args.replay}")
+        for p in res["problems"]:
+            print(f"  {p}")
+        return 1
+
+    journals = [p for p in args.trace_file
+                if not p.endswith(".trace.jsonl")]
+    traces = [p for p in args.trace_file if p.endswith(".trace.jsonl")]
+    if not journals:
+        raise SystemExit("inspect watch: missing serve journal(s) "
+                         "(*.journal.jsonl written by `cli serve "
+                         "--journal` / serve_loadgen.py; *.trace.jsonl "
+                         "files join as round-wall streams)")
+    if args.follow and args.json:
+        raise SystemExit("inspect watch: --follow with --json is "
+                         "refused — an artifact is one deterministic "
+                         "pass over closed streams, not a moving tail "
+                         "(run --json after the workload completes)")
+    slo, slo_source = DEFAULT_SLO, "default"
+    if args.slo:
+        try:
+            slo = load_slo(args.slo)
+        except SloError as e:
+            raise SystemExit(f"inspect watch: {e}")
+        slo_source = os.path.basename(args.slo)
+
+    def one_pass():
+        try:
+            return watch_streams(journals, traces, slo=slo,
+                                 slo_source=slo_source, seed=args.seed)
+        except OSError as e:
+            raise SystemExit(f"inspect watch: unreadable stream: {e}")
+
+    body = one_pass()
+    print(render_watch(body), end="")
+    while args.follow:
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            print("watch: detached (read-only; the workload is "
+                  "unaffected)")
+            return 0
+        body = one_pass()
+        print(render_watch(body), end="")
+    if body["problems"]:
+        # never commit an artifact its own journal contradicts
+        if args.json:
+            print(f"watch artifact NOT written ({args.json}): "
+                  f"{len(body['problems'])} problem(s) above")
+        return 1
+    if args.json:
+        write_watch(args.json, body)
+        print(f"watch artifact written: {args.json}")
+    return 0
+
+
 def _run_inspect(args) -> int:
     """Schedule-shape report: what the -c/-m/-t choices actually compile
     to. This is the question the per-phase timers approximate at runtime,
@@ -1973,6 +2073,8 @@ def _run_inspect(args) -> int:
         return _run_inspect_explain(args)
     if args.what == "workload":
         return _run_inspect_workload(args)
+    if args.what == "watch":
+        return _run_inspect_watch(args)
     if args.what == "traffic":
         return _run_inspect_traffic(args)
     if args.what == "check":

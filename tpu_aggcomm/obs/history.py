@@ -44,7 +44,7 @@ import statistics
 
 __all__ = ["load_history", "build_index", "write_index", "trend_gate",
            "check_trends", "bench_series", "workload_series",
-           "render_history",
+           "watch_series", "render_history",
            "MIN_TREND_ROUNDS", "TREND_TOLERANCE", "HISTORY_SCHEMA"]
 
 #: Schema tag of the persisted index artifact (versioned like
@@ -199,6 +199,37 @@ def workload_series(root: str = ".", *,
     return series
 
 
+def watch_series(root: str = ".", *,
+                 errors: list[str] | None = None
+                 ) -> dict[str, list[dict]]:
+    """The SLO-compliance time series from the committed
+    ``WATCH_r*.json`` history (obs/watch.py): the worst error-budget
+    burn rate across every objective and window per watched round.
+    Keyed ``"slo worst burn"`` (cannot collide with bench
+    ``"<metric> | <platform>"``, serve or workload keys), fed to the
+    same seeded trend gate: burn drifting UP means the serve layer is
+    spending its error budgets faster round over round, and the gate
+    fails the build on a confirmed trajectory."""
+    series: dict[str, list[dict]] = {}
+    for rnd, path, blob in load_history(root, "WATCH", errors=errors):
+        ev = blob.get("evaluation") if isinstance(blob.get("evaluation"),
+                                                  dict) else {}
+        burns = [o.get("worst_burn") for o in ev.get("objectives", [])
+                 if isinstance(o, dict)
+                 and isinstance(o.get("worst_burn"), (int, float))]
+        if not burns:
+            continue
+        req = blob.get("requests") or {}
+        series.setdefault("slo worst burn", []).append({
+            "round": rnd, "value": float(max(burns)), "unit": "x",
+            "samples_n": req.get("admitted") or 0,
+            "compile_seconds": None, "hbm_peak_bytes": None,
+            "compliant": ev.get("compliant"),
+            "anomalies": len(blob.get("anomalies") or []),
+            "file": os.path.basename(path)})
+    return series
+
+
 def _tail_jsonl(path: str) -> list[dict]:
     """Torn-line-tolerant JSONL read (a live trace may be mid-append)."""
     out: list[dict] = []
@@ -304,11 +335,24 @@ def build_index(root: str = ".") -> dict:
                          "padding_waste_bytes": b.get(
                              "padding_waste_bytes"),
                          "proposals": len(blob.get("proposals") or [])})
+    watch = []
+    for rnd, path, blob in load_history(root, "WATCH", errors=errors):
+        ev = blob.get("evaluation") or {}
+        req = blob.get("requests") or {}
+        watch.append({"round": rnd, "file": os.path.basename(path),
+                      "admitted": req.get("admitted"),
+                      "compliant": ev.get("compliant"),
+                      "anomalies": len(blob.get("anomalies") or []),
+                      "causes": sorted({a.get("cause") for a in
+                                        blob.get("anomalies") or []
+                                        if isinstance(a, dict)})})
     return {"schema": HISTORY_SCHEMA, "root": os.path.abspath(root),
             "bench": bench, "multichip": multichip, "tune": tune,
             "traffic": traffic, "serve": serve_series(root, errors=errors),
             "synth": synth, "workload": workload,
             "workload_series": workload_series(root, errors=errors),
+            "watch": watch,
+            "watch_series": watch_series(root, errors=errors),
             "traces": _trace_rows(root), "errors": errors}
 
 
@@ -416,16 +460,18 @@ def trend_gate(points, *, tolerance: float = TREND_TOLERANCE,
 def check_trends(root: str = ".", *, tolerance: float = TREND_TOLERANCE,
                  seed: int = 0) -> dict:
     """The trend gate over every per-(metric, platform) bench series,
-    every per-backend serve series AND the workload padding-waste
-    series under ``root``. ``ok`` is False only on a confirmed
-    ``drifting-up`` verdict — improvement and insufficient history are
-    not failures. (Key formats cannot collide: bench keys are
-    ``"<metric> | <platform>"``, serve keys ``"serve warm p50 |
-    <backend>"``, the workload key is ``"workload padding waste"``.)"""
+    every per-backend serve series, the workload padding-waste series
+    AND the watchtower SLO burn series under ``root``. ``ok`` is False
+    only on a confirmed ``drifting-up`` verdict — improvement and
+    insufficient history are not failures. (Key formats cannot collide:
+    bench keys are ``"<metric> | <platform>"``, serve keys ``"serve
+    warm p50 | <backend>"``, the workload key is ``"workload padding
+    waste"``, the watch key is ``"slo worst burn"``.)"""
     errors: list[str] = []
     series = dict(bench_series(root, errors=errors))
     series.update(serve_series(root, errors=errors))
     series.update(workload_series(root, errors=errors))
+    series.update(watch_series(root, errors=errors))
     gates = {key: trend_gate([(r["round"], r["value"]) for r in rows],
                              tolerance=tolerance, seed=seed)
              for key, rows in sorted(series.items())}
@@ -525,12 +571,45 @@ def render_history(root: str = ".") -> str:
                      + ", ".join(detail))
         if gate.get("note"):
             lines.append(f"  note: {gate['note']}")
+    for key, rows in sorted(index["watch_series"].items()):
+        gate = trends["series"].get(key, {})
+        lines.append(f"== {key} ({len(rows)} watched rounds) ==")
+        for r in rows:
+            extras = []
+            if r["samples_n"]:
+                extras.append(f"{r['samples_n']} requests")
+            extras.append("compliant" if r.get("compliant")
+                          else "VIOLATED")
+            if r.get("anomalies"):
+                extras.append(f"{r['anomalies']} anomaly(ies)")
+            ex = f"  [{', '.join(extras)}]" if extras else ""
+            lines.append(f"  r{r['round']:02d}: "
+                         f"{_fmt_val(r['value'], r['unit'])}{ex}")
+        detail = []
+        if gate.get("slope_pct_per_round") is not None:
+            detail.append(f"slope {gate['slope_pct_per_round']:+.1f}%"
+                          f"/round")
+        if gate.get("ci_pct_per_round") is not None:
+            ci = gate["ci_pct_per_round"]
+            detail.append(f"95% CI [{ci[0]:+.1f}%, {ci[1]:+.1f}%]")
+        detail.append(f"tolerance {gate.get('tolerance_pct', 0):.0f}%"
+                      f"/round (seed {gate.get('seed')})")
+        lines.append(f"  trend: {gate.get('verdict', '?').upper()} — "
+                     + ", ".join(detail))
+        if gate.get("note"):
+            lines.append(f"  note: {gate['note']}")
     for w in index["workload"]:
         props = f", {w['proposals']} advisory proposal(s)" \
             if w["proposals"] else ""
         lines.append(f"workload: {w['file']} — {w['admitted']} admitted, "
                      f"{w['completed']} completed, {w['shed']} shed"
                      f"{props}")
+    for w in index["watch"]:
+        causes = f" — causes: {', '.join(w['causes'])}" \
+            if w["causes"] else ""
+        lines.append(f"watch: {w['file']} — {w['admitted']} requests, "
+                     f"SLO {'compliant' if w['compliant'] else 'VIOLATED'}"
+                     f", {w['anomalies']} anomaly(ies){causes}")
     mc = index["multichip"]
     if mc:
         ok = sum(1 for m in mc if m.get("ok"))

@@ -26,8 +26,8 @@ import json
 import os
 import time
 
-__all__ = ["tail_events", "sweep_status", "render_live", "attach",
-           "THETA_COMM_SIZES"]
+__all__ = ["tail_events", "tail_events_counted", "sweep_status",
+           "render_live", "attach", "THETA_COMM_SIZES"]
 
 #: The default sweep grid (cli.THETA_COMM_SIZES restated here so the
 #: monitor stays importable without the CLI module).
@@ -35,26 +35,42 @@ THETA_COMM_SIZES = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
                     4096, 8192, 999_999_999)
 
 
-def tail_events(path: str) -> list[dict]:
-    """Best-effort read of a trace JSONL that may be mid-append.
+def tail_events_counted(path: str) -> tuple[list[dict], int]:
+    """Best-effort read of a trace JSONL that may be mid-append,
+    COUNTING what it skips.
 
     Unlike ``trace.load_events`` (which raises: a COMMITTED artifact
     with a torn line is corrupt), a live tail skips what does not parse
-    — the torn final line is the normal case, not an error."""
+    — the torn final line is the normal case, not an error. But a
+    monitor must still SAY how many lines it could not read (the
+    recover/workload ``lost`` discipline): silently absorbed torn lines
+    hide lost work."""
     events: list[dict] = []
+    skipped = 0
     try:
         fh = open(path)
     except OSError:
-        return events
+        return events, 0
     with fh:
         for line in fh:
+            if not line.strip():
+                continue
             try:
                 rec = json.loads(line)
             except ValueError:
+                skipped += 1
                 continue
             if isinstance(rec, dict) and "ev" in rec:
                 events.append(rec)
-    return events
+            else:
+                skipped += 1
+    return events, skipped
+
+
+def tail_events(path: str) -> list[dict]:
+    """:func:`tail_events_counted` without the count (compat shim for
+    callers that only want the events)."""
+    return tail_events_counted(path)[0]
 
 
 def _cell_id(key: dict) -> tuple:
@@ -85,9 +101,33 @@ def sweep_status(results_csv: str, *, comm_sizes=None,
     from tpu_aggcomm.resilience.watchdog import derive_deadline
 
     journal_path = results_csv + ".journal.jsonl"
+    # torn-line + lost-request accounting (the recover/workload `lost`
+    # discipline surfaced live): RunJournal skips unreadable lines by
+    # contract, so the count comes from the watchtower's counting tail
+    # over the SAME file; request-shaped entries (a serve journal
+    # pointed at `inspect live`) that were admitted but never reached a
+    # terminal status are named, not dropped
+    from tpu_aggcomm.obs.watch import tail_journal
+    tail = tail_journal(journal_path)
+    req_admitted: set = set()
+    req_terminal: set = set()
+    for rec in tail["records"]:
+        rid = (rec.get("key") or {}).get("request")
+        if rid is None:
+            continue
+        if rec.get("status") == "admitted":
+            req_admitted.add(rid)
+        elif rec.get("status") in ("done", "fail", "shed"):
+            req_terminal.add(rid)
+    integrity = {"journal_torn_lines": tail["skipped_lines"],
+                 "trace_torn_lines": 0,
+                 "lost_requests": sorted(req_admitted - req_terminal)}
+
     latest: dict[tuple, dict] = {}
     for rec in RunJournal(journal_path).entries():
         key = rec.get("key") or {}
+        if {"request", "state", "drain"} & key.keys():
+            continue  # serve-journal records are not sweep cells
         latest[_cell_id(key)] = {
             "fault": key.get("fault"), "comm": key.get("comm"),
             "status": rec.get("status"), "wall_s": rec.get("wall_s")}
@@ -107,6 +147,7 @@ def sweep_status(results_csv: str, *, comm_sizes=None,
     act_events: list = []
     newest = None
     for p in trace_paths:
+        integrity["trace_torn_lines"] += tail_events_counted(p)[1]
         try:
             mt = os.path.getmtime(p)
         except OSError:
@@ -163,7 +204,8 @@ def sweep_status(results_csv: str, *, comm_sizes=None,
         eta["per_cell_s"] = per_cell
         eta["total_s"] = per_cell * len(remaining)
     return {"journal": journal_path, "cells": cells,
-            "remaining": remaining, "eta": eta, "activity": activity}
+            "remaining": remaining, "eta": eta, "activity": activity,
+            "integrity": integrity}
 
 
 def _fmt_s(s) -> str:
@@ -221,6 +263,18 @@ def render_live(status: dict) -> str:
             + (f", run {act['run']} ({act['backend']})"
                if act.get("run") else "")
             + f", file age {_fmt_s(act['age_s'])}")
+    integ = status.get("integrity") or {}
+    if integ.get("journal_torn_lines") or integ.get("trace_torn_lines"):
+        lines.append(
+            f"integrity: skipped {integ.get('journal_torn_lines', 0)} "
+            f"torn journal line(s), {integ.get('trace_torn_lines', 0)} "
+            f"torn trace line(s) — a writer may be mid-append; counted, "
+            f"never silently absorbed")
+    if integ.get("lost_requests"):
+        lines.append(
+            f"integrity: {len(integ['lost_requests'])} request(s) "
+            f"admitted but never terminal (LOST in flight): "
+            f"{integ['lost_requests']}")
     return "\n".join(lines)
 
 

@@ -73,8 +73,9 @@ __all__ = ["validate_bench", "validate_multichip", "validate_tune",
            "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE", "TUNE_SCHEMAS",
            "TRAFFIC_SCHEMAS", "PREDICT_SCHEMAS", "COMPARE_SCHEMAS",
            "SERVE_SCHEMAS", "SYNTH_SCHEMAS", "WORKLOAD_SCHEMAS",
-           "validate_predict", "validate_compare", "validate_serve",
-           "validate_synth", "validate_workload"]
+           "WATCH_SCHEMAS", "validate_predict", "validate_compare",
+           "validate_serve", "validate_synth", "validate_workload",
+           "validate_watch"]
 
 #: Relative slowdown vs the best prior same-platform round that counts as
 #: a regression. Differenced-chain numbers jitter a few percent
@@ -1626,4 +1627,231 @@ def validate_workload(obj, where: str = "WORKLOAD") -> list[str]:
             errors.append(f"{where}: 'proposals' do not re-derive from "
                           f"the aggregates + seed (detection must be "
                           f"deterministic and advisory)")
+    return errors
+
+
+WATCH_SCHEMAS = ("watch-v1",)
+
+_WATCH_STATUSES = ("done", "fail", "shed", "lost")
+
+
+def validate_watch(obj, where: str = "WATCH") -> list[str]:
+    """Schema errors (empty list = valid) for one ``WATCH_r*.json``
+    watchtower artifact (obs/watch.py).
+
+    The validate_workload discipline applied to verdicts: every
+    request's ``wall_s`` must equal its canonical phase sum, the whole
+    SLO evaluation must re-derive from the artifact's own ``per_request``
+    rows + embedded spec through the same ``evaluate_slo`` arithmetic
+    (float-exact by identical computation), the request-walls
+    changepoint must re-derive from the rows + seed, and EVERY anomaly's
+    root-cause verdict must re-derive from the blob's own rows +
+    evidence blocks through the same ``attribute_anomaly`` chain —
+    naming an evidence stream the blob does not support, or a bare
+    unquantified UNEXPLAINED, is schema-invalid. Freshness against the
+    source streams is the separate ``replay_watch`` gate."""
+    import json as _json
+
+    from tpu_aggcomm.obs import watch as _watch
+    from tpu_aggcomm.obs.slo import validate_slo as _validate_slo
+
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    schema = obj.get("schema")
+    if schema not in WATCH_SCHEMAS:
+        errors.append(f"{where}: unknown schema tag {schema!r} "
+                      f"(expected one of {list(WATCH_SCHEMAS)})")
+        return errors
+    _require(obj, "created_unix", (int, float), errors, where)
+    _require(obj, "seed", int, errors, where)
+    man = obj.get("manifest")
+    if man is not None and not isinstance(man, dict):
+        errors.append(f"{where}: 'manifest' must be an object or null")
+    journals = obj.get("journals")
+    if not isinstance(journals, list) or not journals \
+            or not all(isinstance(j, str) for j in journals):
+        errors.append(f"{where}: 'journals' must be a non-empty list of "
+                      f"journal basenames")
+    traces = obj.get("traces")
+    if not isinstance(traces, list) \
+            or not all(isinstance(t, str) for t in traces):
+        errors.append(f"{where}: 'traces' must be a list of trace "
+                      f"basenames")
+    probs = obj.get("problems")
+    if not isinstance(probs, list):
+        errors.append(f"{where}: 'problems' must be a list")
+    elif probs:
+        errors.append(f"{where}: artifact carries {len(probs)} "
+                      f"problem(s) (first: {probs[0]!r}) — a journal "
+                      f"that disagrees with itself must not be "
+                      f"committed as an artifact")
+    slo = obj.get("slo")
+    slo_errs = _validate_slo(slo, where=f"{where}.slo")
+    errors.extend(slo_errs)
+
+    rows = obj.get("per_request")
+    if not isinstance(rows, list):
+        return errors + [f"{where}: 'per_request' must be a list"]
+    counts = {"done": 0, "fail": 0, "shed": 0}
+    lost_rows: list = []
+    prev_rid = None
+    from tpu_aggcomm.obs.workload import BOUNDARIES as _BOUNDS
+    for i, r in enumerate(rows):
+        w = f"{where}.per_request[{i}]"
+        if not isinstance(r, dict):
+            errors.append(f"{w}: must be an object")
+            continue
+        rid = r.get("rid")
+        if prev_rid is not None and isinstance(rid, int) \
+                and rid <= prev_rid:
+            errors.append(f"{w}: rows must be sorted by rid "
+                          f"({rid} after {prev_rid})")
+        prev_rid = rid if isinstance(rid, int) else prev_rid
+        status = r.get("status")
+        if status not in _WATCH_STATUSES:
+            errors.append(f"{w}: status {status!r} not in "
+                          f"{_WATCH_STATUSES}")
+        elif status == "lost":
+            lost_rows.append(rid)
+        else:
+            counts[status] += 1
+        phases = r.get("phases")
+        if not isinstance(phases, dict):
+            errors.append(f"{w}: 'phases' must be an object")
+            continue
+        # wall_s is DEFINED as the canonical-order sum (the
+        # validate_workload discipline — identical computation)
+        want_wall = [phases[b] for b in _BOUNDS if b in phases]
+        want_wall = sum(want_wall) if want_wall else None
+        if r.get("wall_s") != want_wall:
+            errors.append(f"{w}: wall_s {r.get('wall_s')!r} != sum of "
+                          f"phase durations in canonical order "
+                          f"== {want_wall!r}")
+
+    req = obj.get("requests")
+    if not isinstance(req, dict):
+        errors.append(f"{where}: 'requests' must be an object")
+    else:
+        for k, have in (("completed", counts["done"]),
+                        ("failed", counts["fail"]),
+                        ("shed", counts["shed"])):
+            want = req.get(k)
+            if isinstance(want, int) and want != have:
+                errors.append(f"{where}: requests.{k} claims {want} but "
+                              f"the per_request rows re-derive {have}")
+        lost = req.get("lost")
+        if not isinstance(lost, list):
+            errors.append(f"{where}.requests: 'lost' must be a list")
+        elif sorted(lost, key=repr) != sorted(lost_rows, key=repr):
+            errors.append(f"{where}: requests.lost claims {lost} but "
+                          f"the per_request rows re-derive "
+                          f"{sorted(lost_rows, key=repr)}")
+    integ = obj.get("integrity")
+    if not isinstance(integ, dict):
+        errors.append(f"{where}: 'integrity' must be an object")
+    else:
+        for k in ("journal_torn_lines", "trace_torn_lines"):
+            v = integ.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"{where}.integrity: {k!r} must be a "
+                              f"non-negative int, got {v!r}")
+        if isinstance(req, dict) and isinstance(req.get("lost"), list) \
+                and integ.get("lost_requests") != req["lost"]:
+            errors.append(f"{where}: integrity.lost_requests "
+                          f"{integ.get('lost_requests')!r} != "
+                          f"requests.lost {req['lost']!r}")
+
+    # -- the SLO evaluation must re-derive from rows + embedded spec -------
+    if not slo_errs:
+        try:
+            want_eval = _watch.evaluate_slo(rows, slo)
+        except Exception as e:  # lint: broad-ok (validation must report malformed rows as schema errors, not crash the checker)
+            return errors + [f"{where}: per_request rows do not "
+                             f"evaluate: {type(e).__name__}: {e}"]
+        if _json.dumps(obj.get("evaluation"), sort_keys=True) \
+                != _json.dumps(want_eval, sort_keys=True):
+            errors.append(f"{where}: 'evaluation' does not re-derive "
+                          f"from per_request rows + the embedded SLO "
+                          f"spec float-exactly (the evaluate_slo "
+                          f"arithmetic) — burn rates and compliance "
+                          f"flags its own rows contradict")
+
+    # -- anomalies: detection + attribution must re-derive -----------------
+    anomalies = obj.get("anomalies")
+    evidence = obj.get("evidence")
+    if not isinstance(anomalies, list):
+        errors.append(f"{where}: 'anomalies' must be a list")
+        anomalies = []
+    if not isinstance(evidence, dict):
+        errors.append(f"{where}: 'evidence' must be an object")
+        evidence = {}
+    seed = obj.get("seed", 0)
+    walls_rows = [r for r in rows if isinstance(r, dict)
+                  and isinstance(r.get("wall_s"), (int, float))]
+    want_det = None
+    if isinstance(seed, int):
+        try:
+            want_det = _watch.detect_changepoint(
+                [r["wall_s"] for r in walls_rows], seed=seed)
+        except Exception as e:  # lint: broad-ok (validation must report malformed rows as schema errors, not crash the checker)
+            errors.append(f"{where}: request walls do not scan: "
+                          f"{type(e).__name__}: {e}")
+    req_anoms = [a for a in anomalies if isinstance(a, dict)
+                 and a.get("stream") == "request-walls"]
+    if want_det is None and req_anoms:
+        errors.append(f"{where}: a request-walls anomaly is recorded "
+                      f"but the rows + seed re-derive no confirmed "
+                      f"changepoint")
+    if want_det is not None and not req_anoms and not probs:
+        errors.append(f"{where}: the rows + seed re-derive a confirmed "
+                      f"request-walls changepoint (index "
+                      f"{want_det['index']}) the artifact omits")
+    for i, a in enumerate(anomalies):
+        w = f"{where}.anomalies[{i}]"
+        if not isinstance(a, dict):
+            errors.append(f"{w}: must be an object")
+            continue
+        det = a.get("detection")
+        if not isinstance(det, dict):
+            errors.append(f"{w}: 'detection' must be an object")
+            continue
+        if a.get("evidence") not in _watch.EVIDENCE_STREAMS:
+            errors.append(f"{w}: evidence {a.get('evidence')!r} not in "
+                          f"{_watch.EVIDENCE_STREAMS} — every verdict "
+                          f"must name its evidence stream")
+        if not isinstance(a.get("cause"), str) or not a.get("cause"):
+            errors.append(f"{w}: 'cause' must be a non-empty string — "
+                          f"a bare ANOMALY is a regression")
+        if a.get("cause") == "UNEXPLAINED" \
+                and "%" not in str(a.get("detail", "")):
+            errors.append(f"{w}: an UNEXPLAINED verdict must quantify "
+                          f"the residual")
+        if a.get("stream") == "request-walls":
+            if want_det is not None and _json.dumps(det, sort_keys=True) \
+                    != _json.dumps(want_det, sort_keys=True):
+                errors.append(f"{w}: detection does not re-derive from "
+                              f"the rows + seed (seeded changepoint "
+                              f"verdicts must be reproducible)")
+            split_rid, expl = a.get("at_rid"), None
+        else:
+            stream = str(a.get("stream", ""))
+            key = stream.split(":", 1)[1] if ":" in stream else None
+            split_rid = None
+            expl = (evidence.get("explain") or {}).get(key)
+        try:
+            want_v = _watch.attribute_anomaly(
+                det, rows=rows, evidence=evidence, split_rid=split_rid,
+                explain_rounds=expl)
+        except Exception as e:  # lint: broad-ok (validation must report malformed evidence as schema errors, not crash the checker)
+            errors.append(f"{w}: evidence does not attribute: "
+                          f"{type(e).__name__}: {e}")
+            continue
+        got_v = {k: a.get(k) for k in ("cause", "evidence", "detail")}
+        if _json.dumps(got_v, sort_keys=True) \
+                != _json.dumps(want_v, sort_keys=True):
+            errors.append(f"{w}: the root-cause verdict does not "
+                          f"re-derive from the blob's own rows + "
+                          f"evidence blocks (attribute_anomaly): "
+                          f"artifact {got_v} vs re-derived {want_v}")
     return errors

@@ -40,6 +40,10 @@ Four panels:
   run of the same (method, n, data size) across the traces passed in:
   the recovery delta (faulted minus healthy critical-path seconds) and
   its percentage, i.e. the measured cost of surviving the fault.
+- **monitoring** — every committed ``WATCH_r*.json`` watchtower
+  artifact (obs/watch.py): per-objective SLO burn rates over the
+  tumbling windows, overall compliance, stream-integrity counters, and
+  the confirmed changepoints with their NAMED root-cause verdicts.
 
 Empty inputs degrade to an honest "no data" panel, never a broken page.
 """
@@ -390,6 +394,53 @@ def _workload_rows(root: str, errors: list[str]) -> list[dict]:
     return rows
 
 
+def _watch_rows(root: str, errors: list[str]) -> list[dict]:
+    """Monitoring pane data from every ``WATCH_r*.json`` under the
+    history root (obs/watch.py, discovered via load_history like every
+    other family) — jax-free. A schema-invalid watch artifact becomes
+    an error payload, never a silently trusted verdict."""
+    from tpu_aggcomm.obs.history import load_history
+    from tpu_aggcomm.obs.regress import validate_watch
+
+    rows: list[dict] = []
+    for rnd, path, blob in load_history(root, "WATCH", errors=errors):
+        name = os.path.basename(path)
+        errs = validate_watch(blob, name)
+        if errs:
+            rows.append({"round": rnd, "file": name, "error": errs[0]})
+            continue
+        ev = blob.get("evaluation") or {}
+        rows.append({
+            "round": rnd, "file": name, "error": None,
+            "seed": blob.get("seed"),
+            "slo_source": blob.get("slo_source"),
+            "requests": blob.get("requests"),
+            "integrity": blob.get("integrity"),
+            "compliant": ev.get("compliant"),
+            "objectives": [
+                {"name": o.get("name"), "kind": o.get("kind"),
+                 "target": o.get("target"),
+                 "worst_burn": o.get("worst_burn"),
+                 "compliant": o.get("compliant"),
+                 "windows": {w: [e.get("burn") for e in entries]
+                             for w, entries in
+                             (o.get("windows") or {}).items()}}
+                for o in ev.get("objectives", [])],
+            "anomalies": [
+                {"stream": a.get("stream"),
+                 "at_rid": a.get("at_rid"),
+                 "at_round": a.get("at_round"),
+                 "detection": {k: (a.get("detection") or {}).get(k)
+                               for k in ("before_mean", "after_mean",
+                                         "delta_rel", "ci_rel",
+                                         "direction")},
+                 "cause": a.get("cause"),
+                 "evidence": a.get("evidence"),
+                 "detail": a.get("detail")}
+                for a in blob.get("anomalies", [])]})
+    return rows
+
+
 def build_payload(history_root: str = ".",
                   trace_paths: list[str] | None = None) -> dict:
     """The dashboard's inlined data: bench/multichip history + tuner
@@ -405,6 +456,7 @@ def build_payload(history_root: str = ".",
             "degradation": _degradation_rows(runs),
             "explain": _explain_rows(history_root),
             "workload": _workload_rows(history_root, errors),
+            "watch": _watch_rows(history_root, errors),
             "trend": check_trends(history_root),
             "errors": errors}
 
@@ -455,6 +507,8 @@ time; lower is better everywhere (seconds per rep).</p>
 <div id="explain"></div>
 <h2>Workload profile (serve request flow)</h2>
 <div id="workload"></div>
+<h2>Monitoring (watchtower SLO + named anomalies)</h2>
+<div id="watch"></div>
 <script id="data" type="application/json">{payload}</script>
 <script>
 "use strict";
@@ -1183,6 +1237,120 @@ function fmtS(v) {{
       "phase attribution is journal-derived (obs/workload.py over the " +
       "serve journal's boundary stamps, float-exact vs `inspect " +
       "workload`) — proposals are advisory only, nothing here gates"));
+}})();
+
+(function watchPane() {{
+  var host = document.getElementById("watch");
+  var rows = DATA.watch || [];
+  if (!rows.length) {{
+    host.appendChild(el("p", {{class: "note"}},
+        "no WATCH_r*.json under the history root (run `cli inspect " +
+        "watch serve.journal.jsonl --json WATCH_rNN.json` over a serve " +
+        "journal)"));
+    return;
+  }}
+  rows.forEach(function (w) {{
+    var cap = el("p", {{}});
+    cap.appendChild(el("b", {{}}, w.file));
+    if (w.error) {{
+      host.appendChild(cap);
+      host.appendChild(el("p", {{class: "err"}},
+          "watch artifact error: " + w.error));
+      return;
+    }}
+    var req = w.requests || {{}};
+    cap.appendChild(document.createTextNode(
+        " (seed " + w.seed + ", slo " + w.slo_source + ") — " +
+        req.admitted + " admitted: " + req.completed + " done, " +
+        req.failed + " fail, " + req.shed + " shed, " +
+        (req.lost || []).length + " lost — SLO " +
+        (w.compliant ? "COMPLIANT" : "VIOLATED")));
+    if (!w.compliant) cap.appendChild(el("span", {{class: "err"}}, " !"));
+    host.appendChild(cap);
+    var ig = w.integrity || {{}};
+    if (ig.journal_torn_lines || ig.trace_torn_lines ||
+        (ig.lost_requests || []).length)
+      host.appendChild(el("p", {{class: "err"}},
+          "integrity: " + (ig.journal_torn_lines || 0) +
+          " torn journal line(s), " + (ig.trace_torn_lines || 0) +
+          " torn trace line(s), lost requests [" +
+          (ig.lost_requests || []).join(", ") + "]"));
+    var tbl = el("table");
+    var hr = el("tr");
+    ["objective", "kind", "target", "worst burn", "windows (burn \\u00d7 budget)",
+     "status"].forEach(function (h, i) {{
+      hr.appendChild(el("th", i < 2 || i > 3 ? {{class: "l"}} : {{}}, h));
+    }});
+    tbl.appendChild(hr);
+    (w.objectives || []).forEach(function (o) {{
+      var tr = el("tr");
+      tr.appendChild(el("td", {{class: "l"}}, o.name));
+      tr.appendChild(el("td", {{class: "l"}}, o.kind));
+      tr.appendChild(el("td", {{}}, (o.target * 100).toFixed(0) + "%"));
+      tr.appendChild(el("td", {{}},
+          o.worst_burn === null || o.worst_burn === undefined ?
+          "-" : o.worst_burn.toFixed(2) + "x"));
+      // sparkline: burn per tumbling window, every configured window size
+      var wt = el("td", {{class: "l"}});
+      Object.keys(o.windows || {{}}).sort().forEach(function (wn) {{
+        var burns = o.windows[wn];
+        var txt = burns.map(function (b) {{
+          return b === null || b === undefined ? "\\u00b7" : b.toFixed(1);
+        }}).join(" ");
+        wt.appendChild(el("div", {{}}, wn + ": " + txt));
+      }});
+      tr.appendChild(wt);
+      var st = el("td", {{class: "l"}},
+          o.compliant === null || o.compliant === undefined ? "no data" :
+          (o.compliant ? "ok" : "BURNING"));
+      if (o.compliant === false) st.className = "l err";
+      tr.appendChild(st);
+      tbl.appendChild(tr);
+    }});
+    host.appendChild(tbl);
+    if (!(w.anomalies || []).length) {{
+      host.appendChild(el("p", {{class: "note"}},
+          "no confirmed changepoints (seeded detector, seed " +
+          w.seed + ")"));
+    }} else {{
+      var at = el("table");
+      var ah = el("tr");
+      ["stream", "at", "before", "after", "step", "95% CI", "cause",
+       "evidence", "detail"].forEach(function (h, i) {{
+        ah.appendChild(el("th", i < 2 || i > 5 ?
+            {{class: "l"}} : {{}}, h)); }});
+      at.appendChild(ah);
+      w.anomalies.forEach(function (a) {{
+        var d = a.detection || {{}};
+        var tr = el("tr");
+        tr.appendChild(el("td", {{class: "l"}}, a.stream));
+        tr.appendChild(el("td", {{class: "l"}},
+            a.at_rid !== null && a.at_rid !== undefined ?
+            "rid " + a.at_rid : "round " + a.at_round));
+        tr.appendChild(el("td", {{}}, fmtS(d.before_mean)));
+        tr.appendChild(el("td", {{}}, fmtS(d.after_mean)));
+        tr.appendChild(el("td", {{}},
+            d.delta_rel === null || d.delta_rel === undefined ? "-" :
+            (d.delta_rel >= 0 ? "+" : "") +
+            (d.delta_rel * 100).toFixed(0) + "%"));
+        tr.appendChild(el("td", {{}}, d.ci_rel ?
+            "[" + (d.ci_rel[0] * 100).toFixed(0) + "%, " +
+            (d.ci_rel[1] * 100).toFixed(0) + "%]" : "-"));
+        var cd = el("td", {{class: "l"}}, a.cause);
+        if (a.cause === "UNEXPLAINED") cd.className = "l err";
+        tr.appendChild(cd);
+        tr.appendChild(el("td", {{class: "l"}}, a.evidence));
+        tr.appendChild(el("td", {{class: "l"}}, a.detail));
+        at.appendChild(tr);
+      }});
+      host.appendChild(at);
+    }}
+  }});
+  host.appendChild(el("p", {{class: "note"}},
+      "SLO burn rates and changepoints are journal/trace-derived " +
+      "(obs/watch.py, seeded — float-exact vs `inspect watch`); every " +
+      "root-cause verdict names its evidence stream, UNEXPLAINED " +
+      "quantifies the residual — advisory only, nothing here gates"));
 }})();
 </script></body></html>
 """

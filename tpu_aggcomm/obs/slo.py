@@ -1,0 +1,172 @@
+"""Declarative SLO specs for the serve watchtower (obs/watch.py).
+
+A spec (slo-v1) names the objectives the serve layer is held to and the
+request-count windows they are judged over. Objectives are evaluated as
+**error-budget burn rates**: an objective with success target ``t`` has
+error budget ``1 - t``; a window whose bad-event fraction is ``f``
+burns at ``f / (1 - t)``. Burn <= 1 means the window lived inside its
+budget; burn 2 means the budget is being spent twice as fast as
+provisioned. :func:`burn_rate` is THE one burn arithmetic — the
+watchtower evaluator, the server's live ``/metrics`` gauges
+(obs/watch.LiveSlo) and the telemetry gate's re-render all call it, so
+the numbers cannot drift apart (the ``padded_slots`` precedent from
+obs/workload.py).
+
+Objective kinds, all derived from serve-journal records alone (never
+host callbacks — the obs discipline):
+
+- ``warm-latency`` — completed warm-cache (``cache == "hit"``) requests
+  whose wall exceeds ``threshold_s`` are bad; the window SLI is the
+  warm p50 wall.
+- ``goodput`` — any non-``done`` outcome (fail, shed, lost) is bad; the
+  SLI is the completed fraction.
+- ``shed-rate`` — shed requests are bad; the SLI is the shed fraction.
+- ``deadline-miss`` — among requests that declared ``deadline_ms``: a
+  deadline shed or a wall past the deadline is bad. A window with no
+  deadline-carrying requests is vacuous (burn ``None``), never counted
+  as a violation.
+- ``padding-waste`` — padded batch slots that carried no request are
+  bad (the power-of-two batching overhead); the SLI is the fill ratio.
+
+jax-free by contract: the whole ``obs`` package is in PURE_PACKAGES
+(analysis/lint.py), and the watchtower must evaluate precisely where a
+wedged tunnel hangs ``import jax``.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["SLO_SCHEMA", "OBJECTIVE_KINDS", "DEFAULT_SLO", "SloError",
+           "burn_rate", "objective_budget", "validate_slo", "load_slo"]
+
+SLO_SCHEMA = "slo-v1"
+
+#: Every objective kind the evaluator implements — a spec naming any
+#: other kind is refused by name (validate_slo), never silently skipped.
+OBJECTIVE_KINDS = ("warm-latency", "goodput", "shed-rate",
+                   "deadline-miss", "padding-waste")
+
+#: The spec used when ``inspect watch`` is given no ``--slo`` file.
+#: Deliberately lenient: defaults must hold on the committed healthy
+#: exemplar journal; a deployment tightens them with its own slo-v1
+#: file. (Dict literal, embedded verbatim in WATCH_r*.json so replay
+#: needs no side channel.)
+DEFAULT_SLO = {
+    "schema": SLO_SCHEMA,
+    "windows": [{"name": "fast", "requests": 8},
+                {"name": "slow", "requests": 32}],
+    "objectives": [
+        {"name": "warm-p50", "kind": "warm-latency",
+         "threshold_s": 2.0, "target": 0.9},
+        {"name": "goodput", "kind": "goodput", "target": 0.9},
+        {"name": "shed-rate", "kind": "shed-rate", "target": 0.9},
+        {"name": "deadline-miss", "kind": "deadline-miss", "target": 0.9},
+        {"name": "padding-waste", "kind": "padding-waste", "target": 0.5},
+    ],
+}
+
+
+class SloError(ValueError):
+    """A malformed SLO spec, refused with the defect named."""
+
+
+def objective_budget(obj: dict) -> float:
+    """The error budget of one objective: ``1 - target``."""
+    return 1.0 - float(obj["target"])
+
+
+def burn_rate(bad, total, budget: float):
+    """THE one burn arithmetic: bad-fraction over error budget.
+
+    ``None`` when the window is vacuous (``total`` 0) — no evidence is
+    not a violation. Float-exactness across the evaluator, the live
+    gauges and the telemetry gate comes from everyone calling THIS
+    function (identical computation, never a re-implementation)."""
+    if not total:
+        return None
+    return (bad / total) / budget
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_slo(obj, where: str = "SLO") -> list[str]:
+    """Schema errors (empty list = valid) for one slo-v1 spec."""
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    if obj.get("schema") != SLO_SCHEMA:
+        errors.append(f"{where}: unknown schema tag "
+                      f"{obj.get('schema')!r} (expected {SLO_SCHEMA!r})")
+        return errors
+    wins = obj.get("windows")
+    if not isinstance(wins, list) or not wins:
+        errors.append(f"{where}: 'windows' must be a non-empty list")
+        wins = []
+    seen: set = set()
+    for i, w in enumerate(wins):
+        ww = f"{where}.windows[{i}]"
+        if not isinstance(w, dict):
+            errors.append(f"{ww}: must be an object")
+            continue
+        name = w.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(f"{ww}: 'name' must be a non-empty string")
+        elif name in seen:
+            errors.append(f"{ww}: duplicate window name {name!r}")
+        else:
+            seen.add(name)
+        n = w.get("requests")
+        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+            errors.append(f"{ww}: 'requests' must be a positive int, "
+                          f"got {n!r}")
+    objs = obj.get("objectives")
+    if not isinstance(objs, list) or not objs:
+        errors.append(f"{where}: 'objectives' must be a non-empty list")
+        objs = []
+    seen = set()
+    for i, o in enumerate(objs):
+        ww = f"{where}.objectives[{i}]"
+        if not isinstance(o, dict):
+            errors.append(f"{ww}: must be an object")
+            continue
+        name = o.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(f"{ww}: 'name' must be a non-empty string")
+        elif name in seen:
+            errors.append(f"{ww}: duplicate objective name {name!r}")
+        else:
+            seen.add(name)
+        kind = o.get("kind")
+        if kind not in OBJECTIVE_KINDS:
+            errors.append(f"{ww}: unknown kind {kind!r} (one of "
+                          f"{list(OBJECTIVE_KINDS)})")
+        t = o.get("target")
+        if not _is_num(t) or not (0.0 < t < 1.0):
+            errors.append(f"{ww}: 'target' must be a number in (0, 1) — "
+                          f"target 1.0 leaves a zero error budget and "
+                          f"an undefined burn rate — got {t!r}")
+        if kind == "warm-latency":
+            th = o.get("threshold_s")
+            if not _is_num(th) or th <= 0:
+                errors.append(f"{ww}: warm-latency needs a positive "
+                              f"'threshold_s', got {th!r}")
+    return errors
+
+
+def load_slo(path: str) -> dict:
+    """One slo-v1 spec from disk, validated; defects raise
+    :class:`SloError` with every problem named."""
+    try:
+        with open(path) as fh:
+            obj = json.load(fh)
+    except OSError as e:
+        raise SloError(f"{path}: unreadable SLO spec: {e}")
+    except ValueError as e:
+        raise SloError(f"{path}: unparsable SLO spec: {e}")
+    errors = validate_slo(obj, where=path)
+    if errors:
+        raise SloError("; ".join(errors))
+    return obj

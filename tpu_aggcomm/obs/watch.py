@@ -1,0 +1,894 @@
+"""Watchtower: streaming SLO engine + anomaly root-cause attribution.
+
+Every prior observability surface judges COMMITTED artifacts after the
+fact (regression gate, trend gate, replay gates). This module watches
+the serve layer's LIVE streams — the crash-safe request journal
+(resilience/journal.py) and the flight-recorder trace JSONL — and says,
+continuously and by name, whether traffic is inside its SLOs and *why*
+it is not:
+
+- **tail** — torn-line-tolerant reads (the obs/live.py discipline; a
+  writer may be mid-append at any moment), with every skipped line and
+  every admitted-but-unterminated request COUNTED and named, never
+  silently absorbed.
+- **evaluate** — a declarative slo-v1 spec (obs/slo.py) judged over
+  tumbling request-count windows as error-budget burn rates;
+  :func:`measure_window` is THE one window arithmetic, shared by this
+  evaluator, the server's live gauges (:class:`LiveSlo`) and the
+  telemetry gate, so the numbers cannot drift apart.
+- **detect** — a seeded-bootstrap changepoint scan over per-request
+  walls and per-run round walls (:func:`detect_changepoint`): the same
+  double gate as the regression/trend verdicts (point jump beyond
+  tolerance AND bootstrap CI excluding zero), same seed discipline —
+  same streams in ⟹ same anomalies out, byte-for-byte.
+- **attribute** — each anomaly is joined against evidence the repo
+  already records, in a fixed order, and the verdict NAMES its
+  evidence stream: cache-eviction/compile-storm (``ledger``: journal
+  cache dispositions + manifest drift between session headers),
+  tunnel-degradation (``resilience``: degraded-state records + retry
+  attempts), shed-cascade (``shed``: serve-v2 shed reasons), incast/
+  bandwidth/fence-bound (``explain``: cost-model verdicts over the
+  trace), else ``UNEXPLAINED`` with the residual quantified. A bare
+  "ANOMALY" is a regression by contract.
+
+``WATCH_r*.json`` (watch-v1) embeds the SLO spec, the per-request rows
+and the evidence blocks, is written atomically, schema-validated by
+``obs.regress.validate_watch`` (an artifact its own rows contradict is
+invalid), discovered by ``obs.history.load_history``, and replays to
+REPRODUCED from the recorded stream basenames alone
+(:func:`replay_watch`). Everything is ADVISORY (the resilience/
+detect.py pattern): verdicts name suspects for a later actuator,
+nothing here changes what runs.
+
+jax-free throughout (obs discipline; the ``explain`` join uses only the
+jax-free tpu_aggcomm/model package): the watchtower must answer
+precisely where a wedged tunnel hangs ``import jax``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import statistics
+import time
+
+from tpu_aggcomm.obs.atomic import atomic_write
+from tpu_aggcomm.obs.metrics import percentile
+from tpu_aggcomm.obs.slo import (DEFAULT_SLO, burn_rate, objective_budget,
+                                 validate_slo)
+from tpu_aggcomm.obs.workload import BOUNDARIES, attribute_phases
+
+__all__ = ["WATCH_SCHEMA", "EVIDENCE_STREAMS", "tail_journal",
+           "measure_window", "evaluate_slo", "detect_changepoint",
+           "attribute_anomaly", "watch_streams", "write_watch",
+           "replay_watch", "render_watch", "watch_registry", "LiveSlo"]
+
+WATCH_SCHEMA = "watch-v1"
+
+#: Every evidence stream an attribution verdict may cite. "none" is the
+#: UNEXPLAINED residual — still a named verdict, never a bare anomaly.
+EVIDENCE_STREAMS = ("ledger", "resilience", "shed", "explain", "none")
+
+# -- detection constants (the trend-gate discipline: conservative,
+# seeded, documented) -------------------------------------------------------
+#: Fewest samples on each side of a candidate changepoint.
+MIN_SEGMENT = 4
+#: Relative step (fraction of the stream median) that counts as an
+#: anomaly when the bootstrap CI confirms it.
+CHANGE_TOLERANCE = 0.25
+#: Bootstrap resamples for the changepoint CI (seeded).
+N_BOOT = 800
+#: Cache-miss-fraction rise (after minus before) that implicates the
+#: compiled-chain cache.
+MISS_RISE = 0.25
+#: Shed-fraction rise that implicates a shed cascade.
+SHED_RISE = 0.10
+#: Mean cache-phase-seconds ratio (after/before) that implicates a
+#: compile storm even when the miss fraction held steady.
+COMPILE_RATIO = 1.5
+
+
+# ---------------------------------------------------------------------------
+# Tailing (torn lines and lost requests are COUNTED, never absorbed).
+
+def tail_journal(path: str) -> dict:
+    """Torn-line-tolerant serve-journal tail that counts what it skips.
+
+    Unlike ``resilience.journal.RunJournal._scan`` (which silently
+    skips unparseable lines by contract), a watchtower must surface the
+    skip count — a torn tail is normal, but an unseen one hides lost
+    work. Returns ``{"sessions": [{"fingerprint", "manifest"}...],
+    "records": [...], "skipped_lines": int}``."""
+    sessions: list[dict] = []
+    records: list[dict] = []
+    skipped = 0
+    try:
+        fh = open(path)
+    except OSError:
+        return {"sessions": sessions, "records": records,
+                "skipped_lines": 0}
+    with fh:
+        for line in fh:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if not isinstance(rec, dict):
+                skipped += 1
+                continue
+            if "journal" in rec and "fingerprint" in rec:
+                sessions.append({"fingerprint": rec.get("fingerprint"),
+                                 "manifest": rec.get("manifest")})
+            elif "key" in rec:
+                records.append(rec)
+            else:
+                skipped += 1
+    return {"sessions": sessions, "records": records,
+            "skipped_lines": skipped}
+
+
+def _tail_trace(path: str) -> tuple[list[dict], int]:
+    """Torn-tolerant trace tail (obs/live.tail_events semantics) that
+    also counts the skipped lines."""
+    events: list[dict] = []
+    skipped = 0
+    try:
+        fh = open(path)
+    except OSError:
+        return events, 0
+    with fh:
+        for line in fh:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if isinstance(rec, dict) and "ev" in rec:
+                events.append(rec)
+            else:
+                skipped += 1
+    return events, skipped
+
+
+def _scan_requests(journal_paths) -> dict:
+    """Per-request rows + lifecycle/evidence records from the serve
+    journal(s) — the obs/workload.py join (admitted + terminal), kept
+    to the fields the SLO evaluator and the attribution checks consume.
+    ``wall_s`` is the canonical phase-duration sum via the SAME
+    ``attribute_phases`` arithmetic the workload profiler uses."""
+    admitted: dict = {}
+    terminal: dict = {}
+    sessions: list[dict] = []
+    states: list[dict] = []
+    drain = None
+    problems: list[str] = []
+    skipped = 0
+    for path in journal_paths:
+        tail = tail_journal(path)
+        skipped += tail["skipped_lines"]
+        sessions.extend(tail["sessions"])
+        for rec in tail["records"]:
+            key = rec.get("key") or {}
+            rid = key.get("request")
+            if rid is not None:
+                status = rec.get("status")
+                if status == "admitted":
+                    admitted.setdefault(rid, rec)
+                elif status in ("done", "fail", "shed"):
+                    terminal.setdefault(rid, rec)
+                continue
+            if rec.get("status") == "state":
+                states.append({"state": rec.get("state"),
+                               "prev": rec.get("prev"),
+                               "reason": rec.get("reason")})
+            elif rec.get("status") == "drain":
+                drain = {k: rec.get(k) for k in
+                         ("completed", "failed", "shed", "lost")}
+
+    rows: list[dict] = []
+    counts = {"done": 0, "fail": 0, "shed": 0}
+    lost: list = []
+    for rid in sorted(set(admitted) | set(terminal)):
+        adm = admitted.get(rid)
+        term = terminal.get(rid)
+        status = term.get("status") if term is not None else "lost"
+        if term is None:
+            lost.append(rid)
+        else:
+            counts[status] += 1
+        phases: dict = {}
+        wall = None
+        if term is not None and "phases" in term:
+            phases, pp = attribute_phases(term.get("phases"))
+            for p in pp:
+                problems.append(f"request {rid}: {p}")
+            vals = [phases[b] for b in BOUNDARIES if b in phases]
+            wall = sum(vals) if vals else None
+        batch = None
+        if term is not None and term.get("batch_seq") is not None:
+            batch = {"seq": term["batch_seq"], "n": term.get("batch_n"),
+                     "padded": term.get("batch_padded")}
+        rows.append({
+            "rid": rid, "status": status,
+            "wall_s": wall, "phases": phases,
+            "cache": (term or {}).get("cache"),
+            "shed_reason": (term or {}).get("reason")
+            if status == "shed" else None,
+            "deadline_ms": (adm or {}).get("deadline_ms"),
+            "arrival_unix": (adm or {}).get("t_unix"),
+            "batch": batch,
+        })
+    return {"rows": rows, "sessions": sessions, "states": states,
+            "drain": drain, "problems": problems,
+            "skipped_lines": skipped,
+            "requests": {"admitted": len(admitted),
+                         "completed": counts["done"],
+                         "failed": counts["fail"],
+                         "shed": counts["shed"],
+                         "lost": lost}}
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluation (obs/slo.py specs over request rows).
+
+def _deadline_missed(r: dict) -> bool:
+    dl = r.get("deadline_ms")
+    if not isinstance(dl, (int, float)) or isinstance(dl, bool):
+        return False
+    if r.get("status") == "shed" and "deadline" in str(
+            r.get("shed_reason") or ""):
+        return True
+    w = r.get("wall_s")
+    return isinstance(w, (int, float)) and w > dl / 1e3
+
+
+def measure_window(rows: list[dict], obj: dict) -> dict:
+    """THE one per-window SLI/burn arithmetic — the evaluator, the
+    server's live gauges (:class:`LiveSlo`) and the telemetry gate all
+    call this, so exported numbers equal artifact numbers float-exactly
+    (identical computation, the obs/workload ``padded_slots``
+    precedent). Returns ``{"n", "sli", "bad", "total", "burn",
+    "compliant"}``; a vacuous window (no qualifying events) has burn
+    ``None`` and compliant ``None`` — absence of evidence is not a
+    violation."""
+    kind = obj["kind"]
+    budget = objective_budget(obj)
+    bad = total = 0
+    sli = None
+    if kind == "warm-latency":
+        walls = [r["wall_s"] for r in rows
+                 if r.get("status") == "done" and r.get("cache") == "hit"
+                 and isinstance(r.get("wall_s"), (int, float))]
+        total = len(walls)
+        bad = sum(1 for w in walls if w > obj["threshold_s"])
+        sli = percentile(walls, 50.0) if walls else None
+    elif kind == "goodput":
+        total = len(rows)
+        bad = sum(1 for r in rows if r.get("status") != "done")
+        sli = (total - bad) / total if total else None
+    elif kind == "shed-rate":
+        total = len(rows)
+        bad = sum(1 for r in rows if r.get("status") == "shed")
+        sli = bad / total if total else None
+    elif kind == "deadline-miss":
+        scoped = [r for r in rows
+                  if isinstance(r.get("deadline_ms"), (int, float))
+                  and not isinstance(r.get("deadline_ms"), bool)]
+        total = len(scoped)
+        bad = sum(1 for r in scoped if _deadline_missed(r))
+        sli = bad / total if total else None
+    elif kind == "padding-waste":
+        seen: dict = {}
+        for r in rows:
+            b = r.get("batch")
+            if isinstance(b, dict) and b.get("padded") is not None:
+                seen[b["seq"]] = (b.get("n") or 0, b["padded"])
+        total = sum(p for _n, p in seen.values())
+        bad = sum(p - n for n, p in seen.values())
+        sli = (total - bad) / total if total else None
+    else:
+        raise ValueError(f"unknown SLO objective kind {kind!r}")
+    burn = burn_rate(bad, total, budget)
+    return {"n": len(rows), "sli": sli, "bad": bad, "total": total,
+            "burn": burn,
+            "compliant": None if burn is None else burn <= 1.0}
+
+
+def evaluate_slo(rows: list[dict], slo: dict) -> dict:
+    """The whole spec over the whole stream: tumbling request-count
+    windows per window spec (the final partial window included — the
+    live tail is exactly the window a watcher cares about) plus one
+    whole-stream "overall" measurement per objective."""
+    objectives = []
+    for obj in slo["objectives"]:
+        windows: dict = {}
+        for w in slo["windows"]:
+            size = w["requests"]
+            entries = []
+            for lo in range(0, max(len(rows), 1), size):
+                chunk = rows[lo:lo + size]
+                if not chunk:
+                    continue
+                e = measure_window(chunk, obj)
+                e["start_rid"] = chunk[0]["rid"]
+                e["end_rid"] = chunk[-1]["rid"]
+                entries.append(e)
+            windows[w["name"]] = entries
+        overall = measure_window(rows, obj)
+        burns = [e["burn"] for es in windows.values() for e in es
+                 if e["burn"] is not None]
+        if overall["burn"] is not None:
+            burns.append(overall["burn"])
+        out = {"name": obj["name"], "kind": obj["kind"],
+               "target": obj["target"], "budget": objective_budget(obj),
+               "windows": windows, "overall": overall,
+               "worst_burn": max(burns) if burns else None,
+               "compliant": all(b <= 1.0 for b in burns)}
+        if "threshold_s" in obj:
+            out["threshold_s"] = obj["threshold_s"]
+        objectives.append(out)
+    return {"objectives": objectives,
+            "compliant": all(o["compliant"] for o in objectives)}
+
+
+# ---------------------------------------------------------------------------
+# Seeded changepoint detection.
+
+def detect_changepoint(values, *, seed: int = 0,
+                       tolerance: float = CHANGE_TOLERANCE,
+                       n_boot: int = N_BOOT,
+                       min_segment: int = MIN_SEGMENT) -> dict | None:
+    """The strongest mean-shift in one series, confirmed or discarded.
+
+    Scans every split with >= ``min_segment`` samples a side for the
+    largest mean step relative to the series median, then confirms it
+    with a seeded within-segment bootstrap: anomaly only when the point
+    step exceeds ``tolerance`` AND the 95% CI excludes zero — the same
+    double gate as the regression and trend verdicts, same determinism
+    contract (same values + seed ⟹ same verdict byte-for-byte).
+    Returns ``None`` (no confirmed changepoint) or the detection dict
+    (split index, segment means, relative step, CI, direction)."""
+    vals = [float(v) for v in values]
+    n = len(vals)
+    if n < 2 * min_segment:
+        return None
+    med = statistics.median(vals)
+    if med == 0:
+        return None
+    best_k, best_rel = None, 0.0
+    for k in range(min_segment, n - min_segment + 1):
+        before = vals[:k]
+        after = vals[k:]
+        rel = (sum(after) / len(after) - sum(before) / len(before)) \
+            / abs(med)
+        if best_k is None or abs(rel) > abs(best_rel):
+            best_k, best_rel = k, rel
+    if best_k is None or abs(best_rel) <= tolerance:
+        return None
+    before, after = vals[:best_k], vals[best_k:]
+    rng = random.Random(seed)
+    boots: list[float] = []
+    for _ in range(n_boot):
+        b = [before[rng.randrange(len(before))] for _ in before]
+        a = [after[rng.randrange(len(after))] for _ in after]
+        boots.append((sum(a) / len(a) - sum(b) / len(b)) / abs(med))
+    boots.sort()
+    lo = percentile(boots, 2.5)
+    hi = percentile(boots, 97.5)
+    if not (lo > 0 or hi < 0):
+        return None
+    return {"index": best_k, "n": n,
+            "before_mean": sum(before) / len(before),
+            "after_mean": sum(after) / len(after),
+            "delta_rel": best_rel, "ci_rel": [lo, hi],
+            "direction": "up" if best_rel > 0 else "down",
+            "tolerance": tolerance, "seed": seed}
+
+
+# ---------------------------------------------------------------------------
+# Root-cause attribution (every verdict names its evidence stream).
+
+def _cache_phase_mean(rows: list[dict]) -> float | None:
+    vals = [r["phases"]["cache"] for r in rows
+            if r.get("status") == "done"
+            and isinstance(r.get("phases"), dict)
+            and isinstance(r["phases"].get("cache"), (int, float))]
+    return sum(vals) / len(vals) if vals else None
+
+
+def _frac(rows: list[dict], pred) -> float | None:
+    return sum(1 for r in rows if pred(r)) / len(rows) if rows else None
+
+
+def attribute_anomaly(detection: dict, *, rows: list[dict],
+                      evidence: dict, split_rid=None,
+                      explain_rounds: list[dict] | None = None) -> dict:
+    """One NAMED root-cause verdict for one confirmed changepoint.
+
+    Evidence is consulted in a fixed order (ledger → resilience → shed
+    → explain), each check derived from blob-representable inputs only,
+    so ``validate_watch`` re-runs this exact function over a committed
+    artifact's own rows + evidence blocks and refuses a verdict they
+    contradict. The fallback is ``UNEXPLAINED`` with the residual step
+    quantified — never a bare anomaly."""
+    before = after = None
+    if split_rid is not None:
+        before = [r for r in rows if r["rid"] < split_rid]
+        after = [r for r in rows if r["rid"] >= split_rid]
+
+    # -- ledger: manifest drift + cache dispositions -----------------------
+    drift = [d for s in evidence.get("sessions", [])
+             for d in (s.get("drift") or [])]
+    if drift:
+        return {"cause": "cache-eviction/compile-storm",
+                "evidence": "ledger",
+                "detail": ("manifest drift across journal sessions "
+                           "forces compiled-chain re-keying: "
+                           + "; ".join(drift[:4]))}
+    if after is not None:
+        evicts = sum(1 for r in after if r.get("cache") == "evict")
+        if evicts:
+            return {"cause": "cache-eviction/compile-storm",
+                    "evidence": "ledger",
+                    "detail": (f"{evicts} cache eviction(s) among the "
+                               f"{len(after)} requests after the step "
+                               f"(journal cache dispositions)")}
+        is_miss = lambda r: r.get("cache") in ("miss", "evict")
+        mb, ma = _frac(before, is_miss), _frac(after, is_miss)
+        if mb is not None and ma is not None and ma - mb > MISS_RISE:
+            return {"cause": "cache-eviction/compile-storm",
+                    "evidence": "ledger",
+                    "detail": (f"cache-miss fraction rose "
+                               f"{mb:.0%} -> {ma:.0%} across the step "
+                               f"(journal cache dispositions)")}
+        cb, ca = _cache_phase_mean(before), _cache_phase_mean(after)
+        if cb is not None and ca is not None and cb > 0 \
+                and ca / cb > COMPILE_RATIO \
+                and any(is_miss(r) for r in after):
+            return {"cause": "cache-eviction/compile-storm",
+                    "evidence": "ledger",
+                    "detail": (f"mean cache-phase wall rose "
+                               f"{cb * 1e3:.1f} ms -> {ca * 1e3:.1f} ms "
+                               f"({ca / cb:.1f}x) with fresh misses "
+                               f"after the step — compile time, not "
+                               f"transport")}
+
+    # -- resilience: degraded lifecycle + retry attempts -------------------
+    degraded = [s for s in evidence.get("states", [])
+                if s.get("state") == "degraded"]
+    if degraded:
+        return {"cause": "tunnel-degradation", "evidence": "resilience",
+                "detail": (f"server entered DEGRADED "
+                           f"({degraded[0].get('reason')!r} — journal "
+                           f"lifecycle records)")}
+    retries = evidence.get("resilience_retries") or {}
+    if retries.get("count"):
+        sites = ", ".join(retries.get("sites", [])[:3])
+        return {"cause": "tunnel-degradation", "evidence": "resilience",
+                "detail": (f"{retries['count']} tunnel-class retry "
+                           f"attempt(s) in the trace resilience records "
+                           f"({sites})")}
+
+    # -- shed: cascade in the serve shed reasons ---------------------------
+    if after is not None:
+        is_shed = lambda r: r.get("status") == "shed"
+        sb, sa = _frac(before, is_shed), _frac(after, is_shed)
+        if sb is not None and sa is not None and sa - sb > SHED_RISE:
+            reasons = sorted({str(r.get("shed_reason"))
+                              for r in after if is_shed(r)})
+            return {"cause": "shed-cascade", "evidence": "shed",
+                    "detail": (f"shed fraction rose {sb:.0%} -> "
+                               f"{sa:.0%} across the step (reasons: "
+                               f"{', '.join(reasons)})")}
+
+    # -- explain: cost-model verdicts over the trace rounds ----------------
+    if explain_rounds:
+        k = detection["index"]
+        scoped = [r for r in explain_rounds if r.get("round") is not None
+                  and r["round"] >= k] or explain_rounds
+        named = [r["verdict"] for r in scoped
+                 if r.get("verdict") in ("incast-bound", "bandwidth-bound",
+                                         "fence-bound", "slow-injected")]
+        if named:
+            top = max(("incast-bound", "bandwidth-bound", "fence-bound",
+                       "slow-injected"),
+                      key=lambda v: (named.count(v), -len(v)))
+            rounds = [r["round"] for r in scoped
+                      if r.get("verdict") == top]
+            return {"cause": top, "evidence": "explain",
+                    "detail": (f"cost-model explain names {top} on "
+                               f"round(s) {rounds[:6]} after the step "
+                               f"(tpu_aggcomm/model verdicts)")}
+        unexp = [r for r in scoped
+                 if str(r.get("verdict", "")).startswith("UNEXPLAINED")]
+        if unexp:
+            dev = unexp[0].get("deviation_rel")
+            devtxt = f" (model deviation {dev:+.0%})" \
+                if isinstance(dev, (int, float)) else ""
+            return {"cause": "UNEXPLAINED", "evidence": "explain",
+                    "detail": (f"residual {detection['delta_rel']:+.0%} "
+                               f"step; the cost model also calls these "
+                               f"rounds UNEXPLAINED{devtxt} — outside "
+                               f"its physics")}
+
+    return {"cause": "UNEXPLAINED", "evidence": "none",
+            "detail": (f"residual {detection['delta_rel']:+.0%} step in "
+                       f"the {detection['direction']} direction — no "
+                       f"ledger/resilience/shed/explain evidence "
+                       f"matches")}
+
+
+# ---------------------------------------------------------------------------
+# The pipeline.
+
+def _trace_round_walls(events: list[dict]) -> list[tuple[dict, list]]:
+    """``(run_record, [round walls])`` per run of one trace tail — the
+    attribution cell stream via ``obs.metrics.round_stats``, never host
+    callbacks."""
+    from tpu_aggcomm.obs.metrics import round_stats
+    out = []
+    for run in (e for e in events if e.get("ev") == "run"):
+        stats = [s for s in round_stats(events, run["id"])
+                 if isinstance(s["round"], int) and s["round"] >= 0]
+        stats.sort(key=lambda s: s["round"])
+        out.append((run, [s["wall"] for s in stats if s["wall"]]))
+    return out
+
+
+def _explain_rounds(path: str, predict_path: str) -> dict:
+    """Per-run explain verdicts for one trace, keyed by run id — slim
+    ``{"round", "verdict", "deviation_rel"}`` rows, blob-representable
+    so the validator can re-run attribution from the artifact alone.
+    Unexplainable traces degrade to an empty dict (the join is
+    evidence, not a gate)."""
+    try:
+        from tpu_aggcomm.model.artifact import load_artifact
+        from tpu_aggcomm.model.explain import explain_trace
+        art = load_artifact(predict_path)
+        explained = explain_trace(path, art.get("platforms") or {})
+    except Exception:  # lint: broad-ok (the explain join is advisory evidence enrichment; a trace the model cannot price must not sink the watch)
+        return {}
+    out = {}
+    for run in explained.get("runs", []):
+        out[run["run"]] = [{"round": r.get("round"),
+                            "verdict": r.get("verdict"),
+                            "deviation_rel": r.get("deviation_rel")}
+                           for r in run.get("rounds", [])]
+    return out
+
+
+def watch_streams(journal_paths, trace_paths=(), *, slo: dict | None = None,
+                  slo_source: str = "default", seed: int = 0,
+                  predict_path: str | None = None) -> dict:
+    """The whole watchtower pass: tail → evaluate → detect → attribute.
+
+    Returns the watch-v1 body minus the artifact envelope (schema/
+    manifest/created_unix, added by :func:`write_watch`). Deterministic
+    by construction: a pure function of (streams, slo, seed, predict
+    artifact) — the replay gate depends on it."""
+    journal_paths = list(journal_paths)
+    trace_paths = list(trace_paths)
+    if slo is None:
+        slo = DEFAULT_SLO
+    errs = validate_slo(slo)
+    if errs:
+        raise ValueError("invalid SLO spec: " + "; ".join(errs))
+
+    scan = _scan_requests(journal_paths)
+    rows = scan["rows"]
+
+    # evidence blocks (blob-representable: validate_watch re-runs the
+    # attribution from exactly these)
+    sessions = []
+    prev = None
+    from tpu_aggcomm.obs.ledger import diff_manifests
+    for s in scan["sessions"]:
+        m = s.get("manifest") if isinstance(s.get("manifest"), dict) \
+            else None
+        drift = [f"{d['key']}: {d['a']} -> {d['b']}"
+                 for d in diff_manifests(prev, m)] \
+            if prev is not None and m is not None else []
+        sessions.append({"fingerprint": s.get("fingerprint"),
+                         "drift": drift})
+        if m is not None:
+            prev = m
+    trace_skipped = 0
+    retries = {"count": 0, "sites": []}
+    trace_tails: list[tuple[str, list[dict]]] = []
+    for path in trace_paths:
+        events, skipped = _tail_trace(path)
+        trace_skipped += skipped
+        trace_tails.append((path, events))
+        for e in events:
+            if e.get("ev") != "instant" \
+                    or e.get("name") != "ledger.resilience":
+                continue
+            args = e.get("args") or {}
+            if args.get("kind") == "attempt" \
+                    and args.get("outcome") == "retry":
+                retries["count"] += 1
+                site = str(args.get("site"))
+                if site not in retries["sites"]:
+                    retries["sites"].append(site)
+    evidence = {"sessions": sessions, "states": scan["states"],
+                "resilience_retries": retries}
+
+    explain: dict = {}
+    if predict_path is not None:
+        for path, _events in trace_tails:
+            per_run = _explain_rounds(path, predict_path)
+            for run_id, rounds in per_run.items():
+                explain[f"{os.path.basename(path)}#run{run_id}"] = rounds
+    evidence["explain"] = explain
+
+    # detection: per-request walls, then per-run round walls
+    anomalies: list[dict] = []
+    walls_rows = [r for r in rows
+                  if isinstance(r.get("wall_s"), (int, float))]
+    det = detect_changepoint([r["wall_s"] for r in walls_rows], seed=seed)
+    if det is not None:
+        split_rid = walls_rows[det["index"]]["rid"]
+        verdict = attribute_anomaly(det, rows=rows, evidence=evidence,
+                                    split_rid=split_rid)
+        anomalies.append({"stream": "request-walls",
+                          "at_rid": split_rid, "detection": det,
+                          **verdict})
+    for path, events in trace_tails:
+        base = os.path.basename(path)
+        for run, walls in _trace_round_walls(events):
+            det = detect_changepoint(walls, seed=seed)
+            if det is None:
+                continue
+            key = f"{base}#run{run['id']}"
+            verdict = attribute_anomaly(
+                det, rows=rows, evidence=evidence,
+                explain_rounds=explain.get(key))
+            anomalies.append({"stream": f"round-walls:{key}",
+                              "at_round": det["index"],
+                              "detection": det, **verdict})
+
+    return {
+        "seed": int(seed),
+        "journals": [os.path.basename(p) for p in journal_paths],
+        "traces": [os.path.basename(p) for p in trace_paths],
+        "predict": os.path.basename(predict_path)
+        if predict_path is not None else None,
+        "slo": slo, "slo_source": slo_source,
+        "requests": scan["requests"],
+        "integrity": {"journal_torn_lines": scan["skipped_lines"],
+                      "trace_torn_lines": trace_skipped,
+                      "lost_requests": scan["requests"]["lost"]},
+        "per_request": rows,
+        "evidence": evidence,
+        "evaluation": evaluate_slo(rows, slo),
+        "anomalies": anomalies,
+        "drain": scan["drain"],
+        "problems": scan["problems"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Artifact I/O (the obs/workload.py replay discipline).
+
+def write_watch(path: str, body: dict) -> dict:
+    """Write one watch-v1 artifact atomically (manifest records env var
+    NAMES only, the ledger discipline) and return the blob."""
+    from tpu_aggcomm.obs import ledger
+    blob = dict(body)
+    blob["schema"] = WATCH_SCHEMA
+    blob["manifest"] = ledger.manifest()
+    blob["created_unix"] = time.time()
+    with atomic_write(path) as fh:
+        json.dump(blob, fh, indent=1)
+        fh.write("\n")
+    return blob
+
+
+#: Envelope keys excluded from the replay comparison (environment-
+#: dependent by design; everything else must re-derive byte-for-byte).
+_ENVELOPE = ("schema", "manifest", "created_unix")
+
+
+def replay_watch(path: str) -> dict:
+    """Re-derive a committed WATCH_r*.json from the stream basenames it
+    records (resolved next to the artifact, the workload-replay
+    contract) + its embedded SLO spec + seed, and byte-compare minus
+    the envelope. ``{"verdict": "REPRODUCED" | "MISMATCH", "problems":
+    [...]}`` with every diverging top-level key named."""
+    with open(path) as fh:
+        blob = json.load(fh)
+    problems: list[str] = []
+    if blob.get("schema") != WATCH_SCHEMA:
+        return {"verdict": "MISMATCH",
+                "problems": [f"schema {blob.get('schema')!r} != "
+                             f"{WATCH_SCHEMA!r}"]}
+    root = os.path.dirname(os.path.abspath(path))
+
+    def _resolve(names, what):
+        out = []
+        for name in names or []:
+            p = name if os.path.isabs(name) else os.path.join(root, name)
+            if not os.path.exists(p):
+                problems.append(f"recorded {what} {name!r} not found "
+                                f"next to the artifact ({root})")
+            out.append(p)
+        return out
+
+    journals = _resolve(blob.get("journals"), "journal")
+    traces = _resolve(blob.get("traces"), "trace")
+    predict = None
+    if blob.get("predict") is not None:
+        predict = _resolve([blob["predict"]], "predict artifact")[0]
+    if problems:
+        return {"verdict": "MISMATCH", "problems": problems}
+    rederived = watch_streams(
+        journals, traces, slo=blob.get("slo"),
+        slo_source=blob.get("slo_source", "default"),
+        seed=blob.get("seed", 0), predict_path=predict)
+    want = {k: v for k, v in blob.items() if k not in _ENVELOPE}
+    for k in sorted(set(want) | set(rederived)):
+        a = json.dumps(want.get(k), sort_keys=True)
+        b = json.dumps(rederived.get(k), sort_keys=True)
+        if a != b:
+            problems.append(f"key {k!r} does not re-derive from the "
+                            f"recorded streams (artifact {a[:120]}... "
+                            f"vs re-derived {b[:120]}...)"
+                            if max(len(a), len(b)) > 120 else
+                            f"key {k!r}: artifact {a} vs re-derived {b}")
+    return {"verdict": "REPRODUCED" if not problems else "MISMATCH",
+            "problems": problems}
+
+
+# ---------------------------------------------------------------------------
+# /metrics gauges (shared names between LiveSlo and the artifact fold).
+
+def _burn_gauges(registry, objective_name: str, burns: dict,
+                 compliant: bool | None) -> None:
+    """One objective's gauge set — THE shared exposition arithmetic for
+    the live server and the committed-artifact fold (telemetry_gate.py
+    holds renders of both float-exact against artifact numbers)."""
+    for window, burn in burns.items():
+        if burn is not None:
+            registry.gauge("tpu_aggcomm_slo_burn_rate", burn,
+                           objective=objective_name, window=window)
+    if compliant is not None:
+        registry.gauge("tpu_aggcomm_slo_compliant",
+                       1.0 if compliant else 0.0,
+                       objective=objective_name)
+
+
+def watch_registry(blob: dict, registry) -> None:
+    """Fold one watch-v1 blob into a MetricsRegistry: per-objective
+    burn-rate gauges (latest window per window spec + overall),
+    compliance flags, and the anomaly count. Values are the artifact's
+    own numbers VERBATIM — telemetry_gate.py re-parses the render and
+    demands float-exact agreement."""
+    ev = blob.get("evaluation") or {}
+    for obj in ev.get("objectives", []):
+        burns: dict = {}
+        for wname, entries in (obj.get("windows") or {}).items():
+            live = [e["burn"] for e in entries if e.get("burn") is not None]
+            if live:
+                burns[wname] = live[-1]
+        overall = (obj.get("overall") or {}).get("burn")
+        if overall is not None:
+            burns["overall"] = overall
+        _burn_gauges(registry, obj["name"], burns, obj.get("compliant"))
+    registry.gauge("tpu_aggcomm_slo_compliant_all",
+                   1.0 if ev.get("compliant") else 0.0)
+    registry.gauge("tpu_aggcomm_watch_anomalies",
+                   float(len(blob.get("anomalies") or [])))
+
+
+class LiveSlo:
+    """The server-side hook: rolling SLO windows over live terminal
+    events, exported through the SAME gauge names and burn arithmetic
+    as the committed artifact (:func:`measure_window`).
+
+    Constructed by serve/server.py ONLY when ``/metrics`` is armed (the
+    import-level gate — this module never loads otherwise) and fed one
+    :meth:`record` per terminal request; the hot path pays one
+    ``is not None`` check. Gauges are derived from the journal-visible
+    event fields alone — never from hook-private timing."""
+
+    def __init__(self, registry, slo: dict | None = None):
+        self._registry = registry
+        self._slo = slo if slo is not None else DEFAULT_SLO
+        errs = validate_slo(self._slo)
+        if errs:
+            raise ValueError("invalid SLO spec: " + "; ".join(errs))
+        self._events: list[dict] = []
+        self._max = max(w["requests"] for w in self._slo["windows"])
+
+    def record(self, *, status: str, wall_s=None, cache=None,
+               shed_reason=None, deadline_ms=None, batch=None) -> None:
+        """One terminal request event (done/fail/shed), journal-field
+        shaped; updates every objective's burn/compliance gauges."""
+        self._events.append({"rid": len(self._events), "status": status,
+                             "wall_s": wall_s, "phases": {},
+                             "cache": cache, "shed_reason": shed_reason,
+                             "deadline_ms": deadline_ms, "batch": batch})
+        if len(self._events) > self._max:
+            del self._events[:len(self._events) - self._max]
+        for obj in self._slo["objectives"]:
+            burns: dict = {}
+            oks: list = []
+            for w in self._slo["windows"]:
+                m = measure_window(self._events[-w["requests"]:], obj)
+                burns[w["name"]] = m["burn"]
+                if m["compliant"] is not None:
+                    oks.append(m["compliant"])
+            _burn_gauges(self._registry, obj["name"], burns,
+                         all(oks) if oks else None)
+
+
+# ---------------------------------------------------------------------------
+# Rendering (``cli inspect watch``).
+
+def _fmt_burn(b) -> str:
+    return f"{b:6.2f}" if isinstance(b, (int, float)) else "     -"
+
+
+def render_watch(body: dict) -> str:
+    """The ``inspect watch`` text view: SLO verdicts, burn timeline,
+    anomalies with named causes, stream integrity."""
+    r = body["requests"]
+    lines = [f"watchtower over {', '.join(body['journals'])}"
+             + (f" + {', '.join(body['traces'])}" if body["traces"]
+                else "")
+             + f" (seed {body['seed']}, slo: {body['slo_source']})",
+             f"  requests: {r['admitted']} admitted — {r['completed']} "
+             f"completed, {r['failed']} failed, {r['shed']} shed"
+             + (f", LOST in flight: {r['lost']}" if r["lost"] else "")]
+    integ = body["integrity"]
+    if integ["journal_torn_lines"] or integ["trace_torn_lines"]:
+        lines.append(f"  integrity: skipped {integ['journal_torn_lines']} "
+                     f"torn journal line(s), {integ['trace_torn_lines']} "
+                     f"torn trace line(s) — counted, not silently "
+                     f"absorbed")
+    ev = body["evaluation"]
+    lines.append(f"  SLO: {'COMPLIANT' if ev['compliant'] else 'VIOLATED'}"
+                 f" ({sum(1 for o in ev['objectives'] if o['compliant'])}"
+                 f"/{len(ev['objectives'])} objectives inside budget)")
+    for o in ev["objectives"]:
+        tag = "ok " if o["compliant"] else "HOT"
+        worst = _fmt_burn(o["worst_burn"]).strip()
+        th = f" <= {o['threshold_s']:g}s" if "threshold_s" in o else ""
+        lines.append(f"    [{tag}] {o['name']} (target "
+                     f"{o['target']:.0%}{th}): worst burn {worst}")
+        for wname, entries in o["windows"].items():
+            burns = " ".join(_fmt_burn(e["burn"]).strip()
+                             for e in entries[-8:])
+            if burns.strip("- "):
+                lines.append(f"          {wname:>6} windows: {burns}")
+    for a in body["anomalies"]:
+        d = a["detection"]
+        at = f"rid {a['at_rid']}" if "at_rid" in a \
+            else f"round {a['at_round']}"
+        lines.append(
+            f"  ANOMALY [{a['stream']}] at {at}: "
+            f"{d['before_mean'] * 1e3:.1f} ms -> "
+            f"{d['after_mean'] * 1e3:.1f} ms ({d['delta_rel']:+.0%}, "
+            f"95% CI [{d['ci_rel'][0]:+.0%}, {d['ci_rel'][1]:+.0%}])")
+        lines.append(f"    cause: {a['cause']} [evidence: "
+                     f"{a['evidence']}] — {a['detail']}")
+    if not body["anomalies"]:
+        lines.append("  anomalies: none confirmed (seeded changepoint "
+                     "scan over request + round walls)")
+    for s in body["evidence"]["states"]:
+        lines.append(f"  lifecycle: {s['prev']} -> {s['state']} "
+                     f"({s['reason']})")
+    if body.get("drain"):
+        d = body["drain"]
+        lines.append(f"  drain record: {d.get('completed')} completed, "
+                     f"{d.get('failed')} failed, {d.get('shed')} shed, "
+                     f"lost {d.get('lost')}")
+    for p in body["problems"]:
+        lines.append(f"  PROBLEM: {p}")
+    return "\n".join(lines) + "\n"

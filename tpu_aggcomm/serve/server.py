@@ -231,6 +231,7 @@ class ScheduleServer:
         # is-not-None check per request
         self._registry = None
         self._metrics = None
+        self._slo = None
         env_armed = os.environ.get("TPU_AGGCOMM_METRICS_PORT", "").strip()
         if metrics_port is not None or env_armed:
             from tpu_aggcomm.obs.export import MetricsRegistry, serve_from_env
@@ -240,6 +241,11 @@ class ScheduleServer:
             if self._metrics is not None:
                 self._registry = registry
                 self._state_gauge("ready")
+                # burn-rate gauges over rolling SLO windows — same
+                # measure_window arithmetic as `inspect watch`, loaded
+                # only behind the same import-level gate
+                from tpu_aggcomm.obs.watch import LiveSlo
+                self._slo = LiveSlo(registry)
 
         self._recover = None
         if recover:
@@ -543,6 +549,9 @@ class ScheduleServer:
         if self._registry is not None:
             self._registry.counter("tpu_aggcomm_serve_shed",
                                    reason=reason)
+        if self._slo is not None and rid is not None:
+            self._slo.record(status="shed", shed_reason=reason,
+                             deadline_ms=extra.get("deadline_ms"))
         if self._journal is not None and rid is not None:
             self._journal.record({"request": rid}, fingerprint=self._fp,
                                  status="shed", reason=reason,
@@ -953,6 +962,12 @@ class ScheduleServer:
             self._registry.counter("tpu_aggcomm_serve_requests",
                                    backend=p.backend_name,
                                    outcome="ok" if ok else "error")
+        if self._slo is not None:
+            self._slo.record(
+                status="done" if ok else "fail", wall_s=latency,
+                cache=disposition, deadline_ms=p.req.deadline_ms,
+                batch={"seq": batch_seq, "n": batch_n,
+                       "padded": batch_padded})
         trace.instant("serve.request", rid=p.rid, ok=ok,
                       backend=p.backend_name, cache=disposition,
                       batch_seq=batch_seq, batch_n=batch_n,
