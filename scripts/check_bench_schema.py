@@ -30,8 +30,8 @@ from tpu_aggcomm.obs.regress import (parsed_schema_version, validate_bench,
                                      validate_compare, validate_multichip,
                                      validate_predict, validate_serve,
                                      validate_synth, validate_traffic,
-                                     validate_tune, validate_watch,
-                                     validate_workload)
+                                     validate_pilot, validate_tune,
+                                     validate_watch, validate_workload)
 
 
 def check(root: str) -> int:
@@ -183,6 +183,31 @@ def check(root: str) -> int:
         n_watch += 1
         n_errors += 1
         print(f"FAIL {e}")
+    # PILOT_r*.json autopilot artifacts (tpu_aggcomm/pilot/, pilot-v1):
+    # a promotion decision the artifact's own campaigns + swap evidence
+    # contradict must fail here (the zero-silent-method-changes
+    # contract at validation time)
+    n_pilot = 0
+    pilot_errors: list[str] = []
+    for rnd, path, blob in load_history(root, "PILOT",
+                                        errors=pilot_errors):
+        n_files += 1
+        n_pilot += 1
+        errors = validate_pilot(blob, os.path.basename(path))
+        if errors:
+            n_errors += len(errors)
+            for e in errors:
+                print(f"FAIL {e}")
+        else:
+            print(f"ok   {os.path.basename(path)} "
+                  f"({blob.get('schema', '?')}, {blob.get('mode', '?')}, "
+                  f"{len(blob.get('promotions') or [])} promotion(s), "
+                  f"{len(blob.get('decisions') or [])} decision(s))")
+    for e in pilot_errors:
+        n_files += 1
+        n_pilot += 1
+        n_errors += 1
+        print(f"FAIL {e}")
     from tpu_aggcomm.tune.cache import tune_paths
     for path in tune_paths(root):
         n_files += 1
@@ -238,7 +263,8 @@ def check(root: str) -> int:
         return 1
     print(f"{n_files} artifact(s) ({n_tune} tune, {n_traffic} traffic, "
           f"{n_model} model/compare, {n_serve} serve, {n_synth} synth, "
-          f"{n_workload} workload, {n_watch} watch), "
+          f"{n_workload} workload, {n_watch} watch, "
+          f"{n_pilot} pilot), "
           f"{n_errors} schema error(s)")
     return 1 if n_errors else 0
 

@@ -175,5 +175,17 @@ for f in WATCH_r*.json; do
   [ -e "$f" ] || continue
   python -m tpu_aggcomm.cli inspect watch --replay "$f" || post_rc=1
 done
+# autopilot gate (tpu_aggcomm/pilot/): the control loop must close
+# end-to-end on CPU — profile serve traffic, race a campaign, promote
+# behind byte-exact verify parity + a win CI excluding zero, serve the
+# new method, and leave a PILOT_r*.json that replays REPRODUCED — and
+# every committed pilot artifact must --replay jax-free (the same
+# replay discipline as tune/PREDICT/SYNTH/WORKLOAD/WATCH). A promotion
+# that cannot reproduce is a silent method change.
+python scripts/pilot_smoke.py || post_rc=1
+for f in PILOT_r*.json; do
+  [ -e "$f" ] || continue
+  python -m tpu_aggcomm.cli pilot --replay "$f" || post_rc=1
+done
 if [ "$rc" -eq 0 ]; then rc=$post_rc; fi
 exit $rc

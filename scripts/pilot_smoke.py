@@ -1,0 +1,208 @@
+"""Autopilot smoke gate (ci_tier1.sh): the pilot control loop must
+close end-to-end on CPU — profile real serve traffic, race a campaign,
+promote the winner through the server's framed swap op behind byte-exact
+verify parity, and leave an artifact that re-derives the whole decision
+trace jax-free.
+
+Two legs, each driving the real entry points in subprocesses:
+
+1. **Committed replay**: every committed ``PILOT_r*.json`` (discovered
+   through ``obs/history.load_history`` — the same lens as
+   ``check_bench_schema.py``) must ``cli pilot --replay`` to REPRODUCED,
+   and at least one committed promote decision must carry a win CI with
+   a positive lower bound (a promotion the seeded bootstrap actually
+   proved).
+2. **Live loop** (tmpdir): spawn ``cli serve --backend jax_sim`` with a
+   journal, drive 12 skewed ``--verify`` requests (10x method 1, 2x
+   method 3 on the hot shape), run ``cli pilot --serve-port`` with the
+   seeded synthetic sampler — the pilot must fold the hot target, race
+   it, and PROMOTE method 3 behind verify parity; a subsequent hot-shape
+   request must answer ``served_method == 3`` and verified; the fresh
+   artifact must validate and ``--replay`` to REPRODUCED.
+
+Exit 0 only when all hold. One subprocess at a time (the build host has
+ONE core — the tune/measure contention guard exists for the same
+reason).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SHAPE = dict(method=1, nprocs=8, cb_nodes=4, comm_size=2, data_size=256)
+SPEC = "120,m3*0.6"   # seeded synthetic: m3 is 40% faster — a real win
+
+
+def cpu_env(**extra) -> dict:
+    """The CLAUDE.md CPU recipe: disarm the tunnel, force cpu."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8").strip()
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def fail(msg: str) -> int:
+    print(f"pilot-smoke: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def replay_cli(path: str) -> "subprocess.CompletedProcess":
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "pilot",
+         "--replay", path],
+        cwd=REPO, env=cpu_env(), capture_output=True, text=True,
+        timeout=600)
+
+
+def leg_committed() -> int:
+    """Every committed PILOT_r*.json replays to REPRODUCED, and the set
+    carries at least one bootstrap-proven promote decision."""
+    from tpu_aggcomm.obs.history import load_history
+    errors: list = []
+    rounds = load_history(REPO, "PILOT", errors=errors)
+    if errors:
+        return fail("; ".join(str(e) for e in errors))
+    if not rounds:
+        return fail("no committed PILOT_r*.json — the autopilot gate "
+                    "needs at least one exemplar artifact")
+    n_proven = 0
+    for rnd, path, blob in rounds:
+        name = os.path.basename(path)
+        r = replay_cli(path)
+        if r.returncode != 0 or "REPRODUCED" not in r.stdout:
+            return fail(f"{name} did not replay to REPRODUCED "
+                        f"(rc {r.returncode}):\n{r.stdout}{r.stderr}")
+        print(f"pilot-smoke: {name} -> REPRODUCED")
+        for d in blob.get("decisions") or []:
+            ci = d.get("win_ci_pct")
+            if d.get("action") == "promote" and ci and ci[0] > 0:
+                n_proven += 1
+    if n_proven == 0:
+        return fail("no committed promote decision with a positive "
+                    "win-CI lower bound")
+    print(f"pilot-smoke: committed leg ok ({len(rounds)} artifact(s), "
+          f"{n_proven} proven promotion(s))")
+    return 0
+
+
+def leg_live() -> int:
+    """Serve -> skewed traffic -> pilot promotes -> new method serves
+    -> artifact replays."""
+    from tpu_aggcomm.serve.protocol import ServeClient
+
+    env = cpu_env()
+    with tempfile.TemporaryDirectory(prefix="pilot-smoke-") as tmp:
+        journal = os.path.join(tmp, "serve_pilot.journal.jsonl")
+        artifact = os.path.join(tmp, "PILOT_r01.json")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_aggcomm.cli", "serve",
+             "--backend", "jax_sim", "--port", "0",
+             "--journal", journal],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=sys.stderr, text=True)
+        try:
+            line = proc.stdout.readline()
+            try:
+                ready = json.loads(line)
+                assert ready.get("serve") == "ready"
+            except (ValueError, AssertionError):
+                return fail(f"no serve ready line (got {line!r})")
+            port = ready["port"]
+
+            # skewed traffic: the hot shape is method 1 (10 requests),
+            # method 3 rides along cold (2 requests)
+            for payload in ([dict(SHAPE, iter=i, verify=True)
+                             for i in range(10)]
+                            + [dict(SHAPE, method=3, iter=i,
+                                    verify=True) for i in range(2)]):
+                with ServeClient(port, timeout=300.0) as c:
+                    resp = c.run(**payload)
+                if not (resp["ok"] and resp["verified"]):
+                    return fail(f"traffic request failed: {resp}")
+
+            r = subprocess.run(
+                [sys.executable, "-m", "tpu_aggcomm.cli", "pilot",
+                 journal, "--serve-port", str(port),
+                 "--synthetic", SPEC, "--seed", "0",
+                 "--max-batches", "4",
+                 "--synth-root", tmp, "--predict-root", tmp,
+                 "--out", artifact],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=600)
+            if r.returncode != 0:
+                return fail(f"cli pilot rc {r.returncode}:\n"
+                            f"{r.stdout}{r.stderr}")
+            sys.stderr.write(r.stdout)
+
+            with open(artifact) as fh:
+                blob = json.load(fh)
+            if blob.get("mode") != "live":
+                return fail(f"expected a live pass, got mode "
+                            f"{blob.get('mode')!r}")
+            promotes = [d for d in blob.get("decisions") or []
+                        if d.get("action") == "promote"]
+            if not promotes:
+                return fail("live pilot pass promoted nothing "
+                            f"(decisions: "
+                            f"{[d.get('action') for d in blob.get('decisions') or []]})")
+            ci = promotes[0].get("win_ci_pct") or [0, 0]
+            if not ci[0] > 0:
+                return fail(f"promotion win CI {ci} does not exclude "
+                            f"zero")
+            if len(blob.get("promotions") or []) != len(promotes):
+                return fail("promotions block disagrees with the "
+                            "promote decisions")
+
+            # the promotion must actually serve: the hot shape now
+            # answers with the NEW method, still verified byte-exact
+            with ServeClient(port, timeout=300.0) as c:
+                resp = c.run(**dict(SHAPE, iter=99, verify=True))
+            if not (resp["ok"] and resp["verified"]):
+                return fail(f"post-promotion request failed: {resp}")
+            new = promotes[0]["record"]["new_method"]
+            if resp["served_method"] != new:
+                return fail(f"post-promotion served_method "
+                            f"{resp['served_method']} != promoted "
+                            f"{new} — a silent method change")
+            print(f"pilot-smoke: promoted m{new} "
+                  f"(win CI [{ci[0]:.1f}%, {ci[1]:.1f}%]), hot shape "
+                  f"now serves it verified")
+
+            rr = replay_cli(artifact)
+            if rr.returncode != 0 or "REPRODUCED" not in rr.stdout:
+                return fail(f"fresh artifact did not replay "
+                            f"(rc {rr.returncode}):\n"
+                            f"{rr.stdout}{rr.stderr}")
+            print("pilot-smoke: fresh artifact -> REPRODUCED")
+
+            with ServeClient(port, timeout=60.0) as c:
+                c.shutdown()
+            proc.wait(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.terminate()
+                proc.wait(timeout=60)
+    return 0
+
+
+def main() -> int:
+    rc = leg_committed()
+    if rc:
+        return rc
+    rc = leg_live()
+    if rc:
+        return rc
+    print("pilot-smoke: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

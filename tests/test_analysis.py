@@ -318,7 +318,7 @@ def test_lint_clean_on_tree():
 def test_pure_packages_cover_the_declared_set():
     assert set(PURE_PACKAGES) == {"core", "obs", "faults", "resilience",
                                   "analysis", "tune", "native", "model",
-                                  "serve", "synth"}
+                                  "serve", "synth", "pilot"}
     mods = pure_modules()
     assert "tpu_aggcomm.analysis.lint" in mods      # enforces itself
     assert "tpu_aggcomm.tune.measure" not in mods   # THE jax importer

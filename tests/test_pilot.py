@@ -1,0 +1,638 @@
+"""Autopilot tests (tpu_aggcomm/pilot/ + the serve swap/demote ops —
+ISSUE 19).
+
+The pins that define the subsystem:
+
+- **Deterministic folding**: the same profile + per-shape stats
+  snapshot fold to the byte-identical ranked target list (the replay
+  contract's foundation).
+- **Advisory until proven**: a campaign winner changes nothing without
+  a seeded-bootstrap win CI excluding zero AND a byte-exact verify
+  through the server's normal queue; every refusal is named.
+- **Named, reversible promotions**: a swap installs only a validated
+  record under a matching manifest fingerprint; demote accepts only
+  the SAME record (never a lookalike) plus a reason naming the
+  regression verdict; the served method visibly flips both ways.
+- **Rollback closes the loop**: an engineered post-promotion
+  regression ⟹ seeded watchtower changepoint ⟹ a live demotion row
+  with the verdict named ⟹ the old method serves again — and the
+  artifact recording all of it replays REPRODUCED.
+- **One-CPU-core discipline**: campaign samplers refuse by name while
+  a serve dispatch is in flight on the same backend
+  (PilotContentionError — a sample taken under serve load is noise
+  with a seed).
+- **jax-free planner**: folding, campaign replay, artifact validation
+  and ``cli pilot --replay`` all run where ``import jax`` raises
+  (poisoned-jax subprocess, the obs/analysis discipline).
+"""
+
+import copy
+import json
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+import _jaxfree
+
+REPO = _jaxfree.REPO
+
+from tpu_aggcomm.core.methods import METHODS
+from tpu_aggcomm.obs.regress import validate_pilot
+from tpu_aggcomm.obs.workload import profile_journal
+from tpu_aggcomm.pilot import (CampaignError, PilotError, PromotionError,
+                               fold_targets, make_promotion_record,
+                               render_pilot, replay_pilot, run_campaign,
+                               run_pilot, validate_promotion_record,
+                               write_pilot)
+from tpu_aggcomm.pilot.artifact import (demotion_rows, derive_decision,
+                                        mark_skips, next_pilot_path)
+from tpu_aggcomm.pilot.campaign import replay_campaign
+from tpu_aggcomm.pilot.plan import shape_stats_key
+from tpu_aggcomm.pilot.promote import records_equal
+from tpu_aggcomm.serve.protocol import ServeClient
+from tpu_aggcomm.serve.server import ScheduleServer
+from tpu_aggcomm.tune.race import make_synthetic_sampler
+
+#: The hot request shape every test drives (method 1, a2m): the
+#: synthetic spec "120,m3*0.6" makes the reference method 3 the
+#: provable winner at this cell.
+SHAPE = {"method": 1, "nprocs": 8, "cb_nodes": 4, "comm_size": 2,
+         "data_size": 256}
+SPEC = "120,m3*0.6"
+
+
+@pytest.fixture(autouse=True)
+def _registry_guard():
+    """Campaign registration mutates the global METHODS table; every
+    test leaves it exactly as found (the synth suite's contract)."""
+    before = set(METHODS)
+    yield
+    for mid in set(METHODS) - before:
+        del METHODS[mid]
+
+
+@pytest.fixture
+def fake_executor(monkeypatch):
+    """The real serve control plane with instant execution — the
+    journal stamps, per-shape counters and swap/demote plumbing are
+    what's under test. ``delay`` is mutable so a test can engineer a
+    wall-clock regression mid-run."""
+    from tpu_aggcomm.serve import executor
+
+    delay = {"s": 0.0}
+
+    def fake_build(schedule, backend_name):
+        return object(), 1e-3
+
+    def fake_exec(chain, reqs):
+        if delay["s"]:
+            time.sleep(delay["s"])
+        return [{"verified": True if r.verify else None, "error": None}
+                for r in reqs]
+
+    monkeypatch.setattr(executor, "build_chain", fake_build)
+    monkeypatch.setattr(executor, "execute_batch", fake_exec)
+    return delay
+
+
+def _drive(port, payloads):
+    """Sequential back-to-back requests (one client): a tight burst,
+    so the profiler's hot-shape/burstiness proposals fire."""
+    out = []
+    with ServeClient(port, timeout=300.0) as c:
+        for p in payloads:
+            out.append(c.run(**p))
+    assert all(r["ok"] for r in out), out
+    return out
+
+
+def _skewed_traffic(port):
+    """10x the hot shape + 2x a minority shape — the mix both the CLI
+    smoke and the committed exemplar use."""
+    return _drive(port, [dict(SHAPE, verify=True, iter=i)
+                         for i in range(10)]
+                  + [dict(SHAPE, method=3, verify=True, iter=i)
+                     for i in range(2)])
+
+
+def _server(tmp_path, **kw):
+    srv = ScheduleServer(backend="jax_sim", port=0, max_batch=4,
+                         batch_window_s=0.01,
+                         journal_path=str(tmp_path
+                                          / "serve_pilot.journal.jsonl"),
+                         **kw)
+    srv.start()
+    return srv
+
+
+def _full_shape(**over):
+    """The journal's admitted ``shape`` block for SHAPE — the FULL
+    shape-fields dict (what fold targets and demotion matching key on),
+    not the 5-field request we drive with."""
+    from tpu_aggcomm.serve.protocol import parse_request
+    req = parse_request(dict(SHAPE, **over))
+    return {f: getattr(req, f) for f in req.shape_fields}
+
+
+def _record(fingerprint, **over):
+    rec = {"shape": dict(SHAPE), "backend": "jax_sim",
+           "old_method": 1, "old_cid": "m1:a4:c2:t0",
+           "new_method": 3, "new_cid": "m3:a4:c2:t0",
+           "composition": None, "win_ci_pct": [5.0, 10.0],
+           "seed": 0, "alpha": 0.05, "n_boot": 200,
+           "fingerprint": fingerprint, "artifact": None}
+    rec.update(over)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Folding: measured traffic -> ranked targets, deterministically.
+
+
+def test_fold_targets_deterministic_and_ranked(fake_executor, tmp_path):
+    srv = _server(tmp_path)
+    try:
+        _skewed_traffic(srv.port)
+    finally:
+        srv.stop()
+        srv.close()
+    journal = str(tmp_path / "serve_pilot.journal.jsonl")
+    p1 = profile_journal([journal], seed=0)
+    p2 = profile_journal([journal], seed=0)
+    t1, t2 = fold_targets(p1), fold_targets(p2)
+    assert json.loads(json.dumps(t1)) == json.loads(json.dumps(t2))
+    assert t1, "the skewed mix must propose at least one target"
+    for t in t1:
+        assert t["incumbent_cid"] == "m1:a4:c2:t0"
+        assert t["direction"] == "all_to_many"
+        assert t["stats"] is None  # no per-shape snapshot supplied
+
+    # a per-shape stats snapshot attaches by schedule identity and
+    # ranks by measured latency mass
+    key = shape_stats_key(SHAPE, "jax_sim")
+    assert key is not None
+    per_shape = {key: {"hit": 9, "miss": 1, "requests": 10,
+                       "latency_sum": 123.5}}
+    ranked = fold_targets(p1, per_shape)
+    assert ranked[0]["stats"] == per_shape[key]
+    assert ranked[0]["rank"] == 0
+
+    # a malformed proposal shape is refused by name, never absorbed
+    with pytest.raises(PilotError, match="integer 'cb_nodes'"):
+        fold_targets({"proposals": [{"kind": "hot-shape",
+                                     "shape": {"method": 1, "nprocs": 8,
+                                               "comm_size": 2}}]})
+    # an unregistered synthesized incumbent names the --synth-root fix
+    bad = {"proposals": [{"kind": "hot-shape",
+                          "shape": dict(SHAPE, method=999)}]}
+    with pytest.raises(PilotError, match="synth-root"):
+        fold_targets(bad)
+
+
+# ---------------------------------------------------------------------------
+# Campaigns: synthetic race, win CI, byte-for-byte replay.
+
+
+def _hot_target():
+    return {"index": 0, "kind": "hot-shape", "shape": dict(SHAPE),
+            "backend": "jax_sim", "incumbent_cid": "m1:a4:c2:t0",
+            "direction": "all_to_many", "reason": "test", "stats_key": None,
+            "stats": None, "rank": 0, "skipped": None}
+
+
+def test_campaign_synthetic_race_and_replay():
+    sampler = make_synthetic_sampler(SPEC, seed=0, batch_trials=3)
+    c = run_campaign(_hot_target(), sampler, seed=0, max_batches=4)
+    assert c["winner"]["cid"] == "m3:a4:c2:t0"
+    assert c["winner"]["synthesized"] is False
+    assert c["improved"] is True and c["win_ci_pct"][0] > 0
+    # references-first order: the incumbent is in the reference field,
+    # nothing synthesized was registered for a hot-shape target
+    assert c["search"] is None and c["registration"] is None
+    assert c["race"]["order"][0] == "m1:a4:c2:t0"
+
+    assert replay_campaign(c) == []
+
+    # a mutated sample is named, not absorbed (the exact symptom
+    # depends on where the re-derived race diverges first)
+    bad = copy.deepcopy(c)
+    bad["race"]["samples"]["m3:a4:c2:t0"][0][0] *= 100.0
+    problems = replay_campaign(bad)
+    assert problems and all("race" in p or "re-derive" in p
+                            for p in problems)
+
+    # an improved flag the recorded CI contradicts is named
+    lie = copy.deepcopy(c)
+    lie["improved"] = False
+    assert any("contradicts its own win CI" in p
+               for p in replay_campaign(lie))
+
+
+def test_campaign_bursty_target_runs_search_and_registers():
+    t = dict(_hot_target(), kind="bursty-arrivals")
+    sampler = make_synthetic_sampler(SPEC, seed=0, batch_trials=3)
+    c = run_campaign(t, sampler, seed=0, max_batches=4, id_base=900)
+    assert c["search"] is not None and c["search"]["finalists"]
+    assert c["registration"], "finalists must register before racing"
+    for mid, reg in c["registration"].items():
+        assert int(mid) >= 900 and int(mid) in METHODS
+        assert reg["composition"]
+    # synthesized candidates raced AFTER the reference field
+    order = c["race"]["order"]
+    ref_end = max(i for i, cid in enumerate(order)
+                  if int(cid.split(":")[0][1:]) < 900)
+    assert all(int(cid.split(":")[0][1:]) >= 900
+               for cid in order[ref_end + 1:])
+    assert replay_campaign(c) == []
+
+
+def test_campaign_refuses_unraceable_target():
+    from tpu_aggcomm.synth.search import SearchError
+    t = dict(_hot_target(), direction="nope")
+    with pytest.raises((CampaignError, SearchError),
+                       match="unknown direction"):
+        run_campaign(t, make_synthetic_sampler(SPEC, seed=0),
+                     seed=0, max_batches=2)
+
+
+# ---------------------------------------------------------------------------
+# Promotion records: the only currency a swap accepts.
+
+
+def test_promotion_record_refusals_are_named():
+    sampler = make_synthetic_sampler(SPEC, seed=0, batch_trials=3)
+    c = run_campaign(_hot_target(), sampler, seed=0, max_batches=4)
+    rec = make_promotion_record(_hot_target(), c, fingerprint="fp")
+    assert validate_promotion_record(rec) == []
+    assert rec["old_method"] == 1 and rec["new_method"] == 3
+    assert rec["composition"] is None
+
+    # a non-improved campaign can never mint a record
+    flat = copy.deepcopy(c)
+    flat["improved"] = False
+    with pytest.raises(PromotionError, match="not an improvement"):
+        make_promotion_record(_hot_target(), flat, fingerprint="fp")
+
+    # a win CI touching zero is refused citing the bootstrap gate
+    bad = dict(rec, win_ci_pct=[-0.1, 4.0])
+    assert any("seeded-bootstrap gate" in p
+               for p in validate_promotion_record(bad))
+    # a no-op swap is refused, not silently applied
+    noop = dict(rec, new_method=1, new_cid="m1:a4:c2:t0")
+    assert any("no-op swap" in p
+               for p in validate_promotion_record(noop))
+    # a synthesized id without its composition cannot be reversed
+    synth = dict(rec, new_method=901, new_cid="m901:a4:c2:t0")
+    assert any("no canonical composition" in p
+               for p in validate_promotion_record(synth))
+    # a reference id must NOT carry one
+    ref = dict(rec, composition="fanin=2|order=flat")
+    assert any("reference id" in p
+               for p in validate_promotion_record(ref))
+    # identity is byte-level
+    assert records_equal(rec, json.loads(json.dumps(rec)))
+    assert not records_equal(rec, dict(rec, win_ci_pct=[6.0, 9.0]))
+
+
+# ---------------------------------------------------------------------------
+# The serve ops: swap installs behind verify, demote reverses by the
+# same record, every refusal named.
+
+
+def test_swap_and_demote_lifecycle(fake_executor, tmp_path):
+    srv = _server(tmp_path)
+    try:
+        fp = srv.stats()["fingerprint"]
+
+        # fingerprint drift is refused by name — a win measured under
+        # a drifted manifest does not transfer
+        drifted = srv.swap(_record("somebody-elses-fingerprint"))
+        assert not drifted["ok"]
+        assert "does not transfer" in drifted["error"]
+
+        # a structurally invalid record never reaches the queue
+        unproven = srv.swap(_record(fp, win_ci_pct=[-1.0, 3.0]))
+        assert not unproven["ok"]
+        assert "seeded-bootstrap gate" in unproven["error"]
+
+        # demotion without an installed promotion is named
+        none_yet = srv.demote(_record(fp), "watch: regression")
+        assert not none_yet["ok"]
+        assert "no promotion is installed" in none_yet["error"]
+
+        # the real swap: verify leg through the NORMAL queue, then the
+        # served method visibly flips
+        rec = _record(fp)
+        before = _drive(srv.port, [dict(SHAPE, verify=True)])[0]
+        assert before["served_method"] == 1
+        res = srv.swap(rec)
+        assert res["ok"] and res["installed"] and res["verified"] is True
+        assert res["seq"] == 1 and res["verify_rid"]
+        after = _drive(srv.port, [dict(SHAPE, verify=True)])[0]
+        assert after["served_method"] == 3
+        assert srv.stats()["promotions"] == [{"seq": 1, "record": rec}]
+
+        # double-install is refused by name
+        dup = srv.swap(_record(fp))
+        assert not dup["ok"] and "demote it first" in dup["error"]
+
+        # demote: empty reason refused, lookalike refused, the SAME
+        # record restores the old method
+        noname = srv.demote(rec, "   ")
+        assert not noname["ok"]
+        assert "name the regression verdict" in noname["error"]
+        lookalike = srv.demote(_record(fp, win_ci_pct=[6.0, 10.0]),
+                               "watch: regression")
+        assert not lookalike["ok"]
+        assert "never a lookalike" in lookalike["error"]
+        down = srv.demote(rec, "watch: confirmed request-wall step up")
+        assert down["ok"] and down["restored_method"] == 1
+        restored = _drive(srv.port, [dict(SHAPE, verify=True)])[0]
+        assert restored["served_method"] == 1
+        assert srv.stats()["promotions"] == []
+    finally:
+        srv.stop()
+        srv.close()
+
+    # the journal carries the named swap + demote records
+    recs = [json.loads(line) for line in
+            (tmp_path / "serve_pilot.journal.jsonl")
+            .read_text().splitlines() if line.strip()]
+    promo = [r for r in recs
+             if isinstance(r.get("key"), dict) and "promotion" in r["key"]]
+    assert [r["status"] for r in promo] == ["swap", "demote"]
+    assert promo[0]["record"] == promo[1]["record"]
+    assert "step up" in promo[1]["reason"]
+    verify_leg = [r for r in recs if r.get("purpose") == "swap-verify"]
+    assert verify_leg and verify_leg[0]["served_method"] == 3
+
+
+def test_per_shape_counters_feed_fold(fake_executor, tmp_path):
+    """stats()['per_shape'] rows join fold_targets by schedule
+    identity — the pilot's ranking evidence is the server's own
+    accounting, never a re-measurement."""
+    srv = _server(tmp_path)
+    try:
+        _skewed_traffic(srv.port)
+        st = srv.stats()
+    finally:
+        srv.stop()
+        srv.close()
+    key = shape_stats_key(SHAPE, "jax_sim")
+    assert key in st["per_shape"]
+    row = st["per_shape"][key]
+    assert row["requests"] == 10 and row["hit"] + row["miss"] == 10
+    profile = profile_journal(
+        [str(tmp_path / "serve_pilot.journal.jsonl")], seed=0)
+    targets = fold_targets(profile, st["per_shape"])
+    assert targets[0]["stats"] == row
+    assert targets[0]["stats_key"] == key
+
+
+# ---------------------------------------------------------------------------
+# run_pilot end-to-end: live promotion, artifact, replay (incl. under
+# poisoned jax).
+
+
+def test_run_pilot_live_promotes_and_replays(fake_executor, tmp_path):
+    srv = _server(tmp_path)
+    try:
+        _skewed_traffic(srv.port)
+        journal = str(tmp_path / "serve_pilot.journal.jsonl")
+        body = run_pilot([journal], seed=0, serve_port=srv.port,
+                         synthetic=SPEC, max_batches=4)
+        actions = [d["action"] for d in body["decisions"]]
+        assert "promote" in actions
+        # zero silent method changes: every promote decision carries
+        # the applied record, and promotions == promote records
+        promoted = [d["record"] for d in body["decisions"]
+                    if d["action"] == "promote"]
+        assert promoted == body["promotions"] and promoted
+        assert promoted[0]["new_method"] == 3
+        after = _drive(srv.port, [dict(SHAPE, verify=True)])[0]
+        assert after["served_method"] == 3
+        # the journal snapshot froze what the pilot read: the verify
+        # leg's appended records never leak into the recorded profile
+        assert body["journals"][0]["name"] == \
+            "serve_pilot.journal.jsonl"
+        assert body["requests"]["admitted"] == 12
+    finally:
+        srv.stop()
+        srv.close()
+
+    out = next_pilot_path(str(tmp_path))
+    blob = write_pilot(out, body)
+    assert validate_pilot(blob, "PILOT_r01.json") == []
+    rep = replay_pilot(out)
+    assert rep["verdict"] == "REPRODUCED", rep["problems"]
+    assert "promote" in render_pilot(body)
+
+    # a promotion the artifact's own campaigns contradict is named by
+    # the validator (the zero-silent-method-changes contract)
+    lie = copy.deepcopy(blob)
+    lie["promotions"] = []
+    assert any("promote" in e for e in
+               validate_pilot(lie, "PILOT_r01.json"))
+    # a shrunk journal is named by replay
+    shutil.copy(out, str(tmp_path / "PILOT_r77.json"))
+    trimmed = (tmp_path / "serve_pilot.journal.jsonl")
+    lines = trimmed.read_text().splitlines(keepends=True)
+    sub = tmp_path / "short"
+    sub.mkdir()
+    shutil.copy(out, str(sub / "PILOT_r77.json"))
+    (sub / "serve_pilot.journal.jsonl").write_text("".join(lines[:3]))
+    short = replay_pilot(str(sub / "PILOT_r77.json"))
+    assert short["verdict"] == "MISMATCH"
+    assert any("shrank" in p for p in short["problems"])
+
+    # the committed artifact replays where `import jax` raises — the
+    # jax-free planner pin, via the CLI gate itself
+    env = _jaxfree.poisoned_env(tmp_path,
+                                "pilot --replay must be jax-free")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "pilot",
+         "--replay", out], capture_output=True, text=True, env=env,
+        cwd=str(tmp_path), timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "REPRODUCED" in r.stdout
+
+
+def test_run_pilot_dry_run_never_contacts_a_server(fake_executor,
+                                                  tmp_path):
+    srv = _server(tmp_path)
+    try:
+        _skewed_traffic(srv.port)
+    finally:
+        srv.stop()
+        srv.close()
+    journal = str(tmp_path / "serve_pilot.journal.jsonl")
+    body = run_pilot([journal], seed=0, synthetic=SPEC, max_batches=4)
+    assert body["mode"] == "dry-run"
+    assert body["per_shape"] is None and body["promotions"] == []
+    for d in body["decisions"]:
+        assert d["action"] in ("would-promote", "keep-incumbent",
+                               "no-win")
+        assert d["swap"] is None
+    out = next_pilot_path(str(tmp_path))
+    write_pilot(out, body)
+    rep = replay_pilot(out)
+    assert rep["verdict"] == "REPRODUCED", rep["problems"]
+
+
+# ---------------------------------------------------------------------------
+# Rollback: regression -> watch verdict -> live demotion -> the old
+# method serves again, and the artifact replays (satellite 3).
+
+
+def test_rollback_demotes_on_engineered_regression(fake_executor,
+                                                   tmp_path):
+    delay = fake_executor
+    srv = _server(tmp_path)
+    try:
+        fp = srv.stats()["fingerprint"]
+        # healthy epoch on the incumbent
+        _drive(srv.port, [dict(SHAPE, verify=True, iter=i)
+                          for i in range(10)])
+        # the record's shape must be the journal's FULL shape-fields
+        # block — that is what fold targets and wall matching key on
+        rec = _record(fp, shape=_full_shape())
+        assert srv.swap(rec)["installed"] is True
+        # the promotion regresses: engineered wall-clock step up
+        delay["s"] = 0.35
+        _drive(srv.port, [dict(SHAPE, verify=True, iter=i)
+                          for i in range(8)])
+        delay["s"] = 0.0
+
+        journal = str(tmp_path / "serve_pilot.journal.jsonl")
+        body = run_pilot([journal], seed=0, serve_port=srv.port,
+                         synthetic=SPEC, max_batches=4)
+        # the demotion row names the watch verdict and the server
+        # confirmed the reversal
+        assert len(body["demotions"]) == 1
+        row = body["demotions"][0]
+        assert row["action"] == "demote" and row["seq"] == 1
+        assert "confirmed request-wall step up" in row["reason"]
+        assert row["detection"]["direction"] == "up"
+        assert row["outcome"]["ok"] is True
+        assert row["outcome"]["restored_method"] == 1
+        # targets on the (still-snapshotted) promoted shape were
+        # skipped, not raced mid-promotion
+        assert all(t["skipped"] == "already-promoted"
+                   for t in body["targets"])
+        assert body["campaigns"] == [] and body["promotions"] == []
+
+        # the old method serves again, byte-for-byte the same path
+        restored = _drive(srv.port, [dict(SHAPE, verify=True)])[0]
+        assert restored["served_method"] == 1
+        assert srv.stats()["promotions"] == []
+    finally:
+        srv.stop()
+        srv.close()
+
+    out = next_pilot_path(str(tmp_path))
+    blob = write_pilot(out, body)
+    assert validate_pilot(blob, "PILOT_rollback.json") == []
+    rep = replay_pilot(out)
+    assert rep["verdict"] == "REPRODUCED", rep["problems"]
+
+    # a demotion row whose recorded detection contradicts its action
+    # fails validation — the verdict must follow its own evidence
+    lie = copy.deepcopy(blob)
+    lie["demotions"][0]["action"] = "hold"
+    assert any("demotion" in e.lower() or "hold" in e
+               for e in validate_pilot(lie, "PILOT_rollback.json"))
+
+
+def test_demotion_rows_is_pure_and_seeded():
+    rec = _record("fp")
+    installed = [{"seq": 1, "record": rec}]
+    flat = [{"status": "done", "shape": dict(SHAPE), "wall_s": w}
+            for w in [0.010, 0.011, 0.010, 0.012, 0.011, 0.010,
+                      0.011, 0.010]]
+    step = flat + [{"status": "done", "shape": dict(SHAPE),
+                    "wall_s": w}
+                   for w in [0.30, 0.31, 0.30, 0.32, 0.31, 0.30,
+                             0.31, 0.30]]
+    hold = demotion_rows(installed, flat, seed=0)
+    assert hold[0]["action"] == "hold" and hold[0]["n_walls"] == 8
+    demote = demotion_rows(installed, step, seed=0)
+    assert demote[0]["action"] == "demote"
+    assert "watch: confirmed" in demote[0]["reason"]
+    # seeded: same inputs, byte-identical rows
+    assert json.loads(json.dumps(demote)) == \
+        json.loads(json.dumps(demotion_rows(installed, step, seed=0)))
+    # other shapes' walls never count against this promotion
+    other = [{"status": "done", "shape": dict(SHAPE, method=3),
+              "wall_s": 9.9}] * 16
+    assert demotion_rows(installed, other, seed=0)[0]["n_walls"] == 0
+
+
+def test_mark_skips_and_decision_arithmetic():
+    t = _hot_target()
+    installed = [{"seq": 1, "record": _record("fp")}]
+    marked = mark_skips([t], installed)
+    assert marked[0]["skipped"] == "already-promoted"
+    assert mark_skips([t], [])[0]["skipped"] is None
+
+    sampler = make_synthetic_sampler(SPEC, seed=0, batch_trials=3)
+    c = run_campaign(t, sampler, seed=0, max_batches=4)
+    would = derive_decision(t, c, mode="dry-run", fingerprint="fp",
+                            swap=None)
+    assert would["action"] == "would-promote"
+    unattempted = derive_decision(t, c, mode="live", fingerprint="fp",
+                                  swap=None)
+    assert unattempted["action"] == "swap-unattempted"
+    ok = derive_decision(t, c, mode="live", fingerprint="fp",
+                         swap={"ok": True, "installed": True,
+                               "verified": True})
+    assert ok["action"] == "promote"
+    unverified = derive_decision(t, c, mode="live", fingerprint="fp",
+                                 swap={"ok": True, "verified": False})
+    assert unverified["action"] == "verify-failed"
+    refused = derive_decision(t, c, mode="live", fingerprint="fp",
+                              swap={"ok": False, "error": "nope"})
+    assert refused["action"] == "swap-refused"
+
+
+# ---------------------------------------------------------------------------
+# One-CPU-core contention guard (satellite 2).
+
+
+def test_sampler_refuses_under_serve_dispatch():
+    from tpu_aggcomm.tune.measure import (PilotContentionError,
+                                          make_jax_sim_sampler,
+                                          serve_dispatch_inflight)
+    # factory-time refusal, naming the backend and the remedy
+    with serve_dispatch_inflight("jax_sim"):
+        with pytest.raises(PilotContentionError,
+                           match="jax_sim.*serve queue drains"):
+            make_jax_sim_sampler(nprocs=8, data_size=64, proc_node=1)
+    # per-call refusal: a sampler built while quiet still refuses the
+    # moment a dispatch is in flight
+    sampler = make_jax_sim_sampler(nprocs=8, data_size=64, proc_node=1)
+    with serve_dispatch_inflight("jax_sim"):
+        with pytest.raises(PilotContentionError, match="1 serve"):
+            sampler("m1:a4:c2:t0", 0)
+    # other backends are not blocked; exit releases the slot
+    with serve_dispatch_inflight("pallas_fused"):
+        pass  # jax_sim unaffected
+    sampler  # still usable once the queue drained (no raise on check)
+    from tpu_aggcomm.tune.measure import _check_contention
+    _check_contention("jax_sim")
+
+
+# ---------------------------------------------------------------------------
+# jax purity: the planner answers where a wedged tunnel hangs import.
+
+
+def test_pilot_planner_is_jaxfree(tmp_path):
+    env = _jaxfree.poisoned_env(tmp_path,
+                                "the pilot planner must not import jax")
+    code = _jaxfree.pure_import_code("tpu_aggcomm.pilot")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, cwd=REPO,
+                       timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr

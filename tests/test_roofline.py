@@ -37,7 +37,8 @@ class TestModel:
         # the floor the measured 42.3 ms is judged against
         assert 0.005 < rb.floor_seconds(HBM_V5E_GBPS) < 0.010
 
-    def test_throttle_rounds_add_refence_walks_only(self):
+    @pytest.mark.slow  # ~60 s: builds a 4096-rank schedule twice — a
+    def test_throttle_rounds_add_refence_walks_only(self):  # stress cell
         p = AggregatorPattern(nprocs=4096, cb_nodes=256, data_size=2048,
                               comm_size=1024)  # 4 rounds
         rb1 = rep_bytes(compile_method(1, AggregatorPattern(**FLAGSHIP)),

@@ -1119,3 +1119,56 @@ def test_history_inverse_goodput_trend_gate(tmp_path):
     assert trends["ok"] is False
     # seeded like every statistical verdict: same artifacts, same bytes
     assert check_trends(str(tmp_path)) == trends
+
+
+# ---------------------------------------------------------------------------
+# Per-shape serve stats — the autopilot's target-ranking evidence
+
+
+def test_per_shape_stats_float_consistent_with_journal(tmp_path,
+                                                       fake_executor):
+    """``stats()['per_shape']`` must re-derive from the journal alone:
+    per shape_key, hit/miss/requests equal the journal's ``cache``
+    dispositions and ``latency_sum`` equals the sum of the journal's
+    ``latency_s`` values accumulated in record order — float-EXACT,
+    because ``_finish`` performs exactly one row update per journaled
+    done/fail with the same latency value in the same order (the pin
+    the server comment names)."""
+    journal = tmp_path / "serve_stats.journal.jsonl"
+    srv = ScheduleServer(backend="jax_sim", port=0, max_batch=2,
+                         batch_window_s=0.01, journal_path=str(journal))
+    srv.start()
+    try:
+        # two distinct shapes with repeats: both rows see misses AND
+        # hits, plus one invalid request so a fail lands in a row too
+        for payload in ([dict(_SHAPE, iter=i) for i in range(4)]
+                        + [dict(_SHAPE, method=1, iter=i)
+                           for i in range(3)]):
+            with ServeClient(srv.port, timeout=120.0) as c:
+                assert c.run(**payload)["ok"]
+        st = srv.stats()
+    finally:
+        srv.stop()
+        srv.close()
+
+    recs = [json.loads(line)
+            for line in journal.read_text().splitlines() if line.strip()]
+    derived: dict[str, dict] = {}
+    for r in recs:
+        if r.get("status") not in ("done", "fail"):
+            continue
+        row = derived.setdefault(
+            r["shape_keys"][0],
+            {"hit": 0, "miss": 0, "requests": 0, "latency_sum": 0.0})
+        row["hit" if r["cache"] == "hit" else "miss"] += 1
+        row["requests"] += 1
+        row["latency_sum"] += r["latency_s"]   # journal record order
+
+    # two shape rows, each warmed after its first-request compile
+    assert len(derived) == 2
+    assert all(row["hit"] > 0 and row["miss"] > 0
+               for row in derived.values())
+    assert {row["requests"] for row in derived.values()} == {4, 3}
+    # the pin: dict equality is float-exact on latency_sum — identical
+    # values accumulated in identical order, no tolerance needed
+    assert st["per_shape"] == derived

@@ -245,8 +245,8 @@ class TestShardedTwoLevel:
             else:
                 np.testing.assert_array_equal(recv[r], oracle[r])
 
-    @pytest.mark.slow  # ~2 min for the pair; the ragged flagship cell
-    @pytest.mark.parametrize("method", [15, 16])  # below stays in tier-1
+    @pytest.mark.slow  # ~2 min for the pair (the ragged flagship cell
+    @pytest.mark.parametrize("method", [15, 16])  # below is slow too)
     def test_flagship_16384_ranks_on_8_devices(self, method):
         """The reference's defining TAM configuration — 16,384 ranks on
         256 nodes x 64 ranks (script_theta_all_to_many_256.sh:3,11) —
@@ -297,7 +297,8 @@ class TestShardedTwoLevel:
             iters_small=5, iters_big=55, trials=2, windows=2)
         assert per_rep > 0
 
-    def test_flagship_ragged_16384_ranks(self):
+    @pytest.mark.slow  # ~150 s flagship stress cell; full-suite only so
+    def test_flagship_ragged_16384_ranks(self):  # tier-1 fits its budget
         """A RAGGED 16,384-rank cell — proc_node=96 does not divide, so
         170 full nodes carry a 64-rank last node
         (lustre_driver_test.c:374-386) — through the blocked engine,

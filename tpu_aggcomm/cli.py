@@ -401,6 +401,66 @@ def build_parser() -> argparse.ArgumentParser:
                          "recorded samples; exits nonzero unless both "
                          "match byte-for-byte")
 
+    # pilot — the online control loop (tpu_aggcomm/pilot/)
+    pl = sub.add_parser(
+        "pilot", help="autopilot: tail a serve journal, fold the "
+                      "workload profiler's seeded proposals into "
+                      "(shape, method) targets, run a synth/race "
+                      "campaign per target (checker-pruned search, "
+                      "seeded-bootstrap eliminations on fresh samples), "
+                      "and — live, behind byte-exact --verify parity "
+                      "plus a win CI excluding zero — promote the "
+                      "winner into the serving cache as a NAMED, "
+                      "journaled, reversible record. Writes "
+                      "PILOT_r*.json; --replay re-derives the whole "
+                      "decision trace jax-free (the ci_tier1.sh gate)")
+    pl.add_argument("journals", nargs="*", metavar="JOURNAL",
+                    help="serve journal(s) to profile (JSONL; distinct "
+                         "basenames — they are recorded by name for "
+                         "replay)")
+    pl.add_argument("--seed", type=int, default=0,
+                    help="proposal + search + race-bootstrap seed "
+                         "(recorded; same streams + seed = same "
+                         "artifact modulo timestamps)")
+    pl.add_argument("--serve-port", type=int, default=None,
+                    help="a running serve port: stats feed the fold "
+                         "(per-shape latency ranks targets) and "
+                         "promotions go through its framed swap op; "
+                         "absent = advisory-only pass")
+    pl.add_argument("--dry-run", action="store_true",
+                    help="with --serve-port: read stats but never swap "
+                         "(decisions become would-promote)")
+    pl.add_argument("--synthetic", metavar="SPEC", default=None,
+                    help="race a seeded synthetic latency model instead "
+                         "of measuring ('BASE_US[,mID*FACTOR]...'): "
+                         "jax-free, CPU-smoke only — recorded and "
+                         "replayed identically")
+    pl.add_argument("--max-batches", type=int, default=6)
+    pl.add_argument("--batch-trials", type=int, default=3)
+    pl.add_argument("--alpha", type=float, default=0.05)
+    pl.add_argument("--n-boot", type=int, default=2000)
+    pl.add_argument("--id-base", type=int, default=None,
+                    help="first method id for campaign finalists "
+                         "(default: one past the highest registered "
+                         "synthesized id)")
+    pl.add_argument("--predict-root", metavar="DIR", default=".",
+                    help="where the newest committed PREDICT_*.json "
+                         "lives: its calibration prices campaign "
+                         "survivors (ranking prior only — the race "
+                         "decides)")
+    pl.add_argument("--synth-root", metavar="DIR", default=".",
+                    help="committed SYNTH_r*.json ids are registered "
+                         "FIRST so campaign finalists never collide "
+                         "(default: .)")
+    pl.add_argument("--out", metavar="PATH", default=None,
+                    help="artifact path (default: the first unused "
+                         "PILOT_rNN.json under --synth-root)")
+    pl.add_argument("--replay", metavar="PILOT_JSON", default=None,
+                    help="re-derive a committed artifact jax-free from "
+                         "the journal basenames + evidence recorded "
+                         "inside it; exits nonzero unless every "
+                         "derivation matches byte-for-byte")
+
     # serve — the persistent aggregation server (tpu_aggcomm/serve/)
     sv = sub.add_parser(
         "serve", help="aggregation-as-a-service: a long-lived server "
@@ -1509,6 +1569,75 @@ def _run_synth(args) -> int:
     return 0
 
 
+def _run_pilot(args) -> int:
+    """The autopilot control loop (tpu_aggcomm/pilot/): profile ->
+    fold -> campaigns -> named decisions (-> swap), or --replay
+    re-deriving a committed PILOT_r*.json jax-free (the ci_tier1.sh
+    gate)."""
+    import os
+
+    from tpu_aggcomm.pilot import (PilotError, next_pilot_path,
+                                   render_pilot, replay_pilot,
+                                   run_pilot, write_pilot)
+
+    if args.replay:
+        import json as _json
+
+        from tpu_aggcomm.obs.regress import validate_pilot
+        try:
+            with open(args.replay) as fh:
+                blob = _json.load(fh)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"pilot --replay: cannot read "
+                             f"{args.replay}: {e}")
+        errors = validate_pilot(blob, os.path.basename(args.replay))
+        if errors:
+            for e in errors:
+                print(f"FAIL {e}", file=sys.stderr)
+            raise SystemExit(f"pilot --replay: {args.replay} failed "
+                             f"schema validation ({len(errors)} "
+                             f"error(s))")
+        res = replay_pilot(args.replay)
+        print(f"replay {os.path.basename(args.replay)}: "
+              f"{len(blob.get('campaigns') or [])} campaign(s), "
+              f"{len(blob.get('promotions') or [])} promotion(s) -> "
+              f"{res['verdict']}")
+        for p in res["problems"]:
+            print(f"  {p}")
+        return 0 if res["verdict"] == "REPRODUCED" else 1
+
+    if not args.journals:
+        raise SystemExit("pilot: name at least one serve journal "
+                         "(or --replay a committed artifact)")
+    from tpu_aggcomm.synth import ensure_registered
+    # committed ids first, so campaign finalists never collide
+    ensure_registered(args.synth_root)
+    params, params_source = _synth_params(args)
+    try:
+        body = run_pilot(
+            args.journals, seed=args.seed, serve_port=args.serve_port,
+            dry_run=args.dry_run, synthetic=args.synthetic,
+            params=params, params_source=params_source,
+            max_batches=args.max_batches,
+            batch_trials=args.batch_trials, alpha=args.alpha,
+            n_boot=args.n_boot, id_base=args.id_base, log=print)
+    except PilotError as e:
+        raise SystemExit(f"pilot: {e}")
+    out = args.out or next_pilot_path(args.synth_root)
+    write_pilot(out, body)
+    print(render_pilot(body))
+    print(f"pilot artifact written: {out}")
+    from tpu_aggcomm.obs.regress import validate_pilot
+    import json as _json
+    with open(out) as fh:
+        errors = validate_pilot(_json.load(fh), os.path.basename(out))
+    if errors:
+        for e in errors:
+            print(f"FAIL {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _resolve_auto(args, nprocs: int, *, sweep: bool = False) -> None:
     """--auto: swap the explicit -m (and for run: -a/-c/-t) for the
     tuned winner of this (shape, direction, backend), when a
@@ -2393,6 +2522,8 @@ def main(argv=None) -> int:
         return _run_tune(args)
     if args.command == "synth":
         return _run_synth(args)
+    if args.command == "pilot":
+        return _run_pilot(args)
     if args.command == "serve":
         return _run_serve(args)
 

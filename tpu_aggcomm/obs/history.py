@@ -44,7 +44,7 @@ import statistics
 
 __all__ = ["load_history", "build_index", "write_index", "trend_gate",
            "check_trends", "bench_series", "workload_series",
-           "watch_series", "render_history",
+           "watch_series", "pilot_series", "render_history",
            "MIN_TREND_ROUNDS", "TREND_TOLERANCE", "HISTORY_SCHEMA"]
 
 #: Schema tag of the persisted index artifact (versioned like
@@ -230,6 +230,43 @@ def watch_series(root: str = ".", *,
     return series
 
 
+def pilot_series(root: str = ".", *,
+                 errors: list[str] | None = None
+                 ) -> dict[str, list[dict]]:
+    """The promotion-win time series from the committed
+    ``PILOT_r*.json`` history (tpu_aggcomm/pilot/): per piloted round,
+    the reciprocal of the BEST confirmed win's CI lower bound among
+    that round's improved campaigns (``1 / lo%``) — inverted so the
+    shared "drifting-up = worse" trend verdict applies: the autopilot
+    finding smaller and smaller proven wins round over round makes
+    this series RISE, and the gate fails the build on a confirmed
+    trajectory. Keyed ``"pilot inverse promotion win"`` (cannot collide
+    with bench ``"<metric> | <platform>"``, serve, workload or watch
+    keys). Rounds with no improved campaign contribute nothing — an
+    idle pilot is not a trend."""
+    series: dict[str, list[dict]] = {}
+    for rnd, path, blob in load_history(root, "PILOT", errors=errors):
+        los = []
+        for d in blob.get("decisions") or []:
+            ci = d.get("win_ci_pct") if isinstance(d, dict) else None
+            if d.get("improved") and isinstance(ci, list) \
+                    and len(ci) == 2 \
+                    and isinstance(ci[0], (int, float)) \
+                    and not isinstance(ci[0], bool) and ci[0] > 0:
+                los.append(float(ci[0]))
+        if not los:
+            continue
+        req = blob.get("requests") or {}
+        series.setdefault("pilot inverse promotion win", []).append({
+            "round": rnd, "value": 1.0 / max(los), "unit": "1/%",
+            "samples_n": req.get("admitted") or 0,
+            "compile_seconds": None, "hbm_peak_bytes": None,
+            "best_win_lo_pct": max(los),
+            "promotions": len(blob.get("promotions") or []),
+            "file": os.path.basename(path)})
+    return series
+
+
 def _tail_jsonl(path: str) -> list[dict]:
     """Torn-line-tolerant JSONL read (a live trace may be mid-append)."""
     out: list[dict] = []
@@ -346,6 +383,21 @@ def build_index(root: str = ".") -> dict:
                       "causes": sorted({a.get("cause") for a in
                                         blob.get("anomalies") or []
                                         if isinstance(a, dict)})})
+    pilot = []
+    for rnd, path, blob in load_history(root, "PILOT", errors=errors):
+        req = blob.get("requests") or {}
+        pilot.append({"round": rnd, "file": os.path.basename(path),
+                      "mode": blob.get("mode"),
+                      "admitted": req.get("admitted"),
+                      "targets": len(blob.get("targets") or []),
+                      "promotions": len(blob.get("promotions") or []),
+                      "demotions": sum(
+                          1 for d in blob.get("demotions") or []
+                          if isinstance(d, dict)
+                          and d.get("action") == "demote"),
+                      "actions": sorted({d.get("action") for d in
+                                         blob.get("decisions") or []
+                                         if isinstance(d, dict)})})
     return {"schema": HISTORY_SCHEMA, "root": os.path.abspath(root),
             "bench": bench, "multichip": multichip, "tune": tune,
             "traffic": traffic, "serve": serve_series(root, errors=errors),
@@ -353,6 +405,8 @@ def build_index(root: str = ".") -> dict:
             "workload_series": workload_series(root, errors=errors),
             "watch": watch,
             "watch_series": watch_series(root, errors=errors),
+            "pilot": pilot,
+            "pilot_series": pilot_series(root, errors=errors),
             "traces": _trace_rows(root), "errors": errors}
 
 
@@ -461,17 +515,20 @@ def check_trends(root: str = ".", *, tolerance: float = TREND_TOLERANCE,
                  seed: int = 0) -> dict:
     """The trend gate over every per-(metric, platform) bench series,
     every per-backend serve series, the workload padding-waste series
-    AND the watchtower SLO burn series under ``root``. ``ok`` is False
-    only on a confirmed ``drifting-up`` verdict — improvement and
-    insufficient history are not failures. (Key formats cannot collide:
-    bench keys are ``"<metric> | <platform>"``, serve keys ``"serve
-    warm p50 | <backend>"``, the workload key is ``"workload padding
-    waste"``, the watch key is ``"slo worst burn"``.)"""
+    the watchtower SLO burn series AND the autopilot promotion-win
+    series under ``root``. ``ok`` is False only on a confirmed
+    ``drifting-up`` verdict — improvement and insufficient history are
+    not failures. (Key formats cannot collide: bench keys are
+    ``"<metric> | <platform>"``, serve keys ``"serve warm p50 |
+    <backend>"``, the workload key is ``"workload padding waste"``, the
+    watch key is ``"slo worst burn"``, the pilot key is ``"pilot
+    inverse promotion win"``.)"""
     errors: list[str] = []
     series = dict(bench_series(root, errors=errors))
     series.update(serve_series(root, errors=errors))
     series.update(workload_series(root, errors=errors))
     series.update(watch_series(root, errors=errors))
+    series.update(pilot_series(root, errors=errors))
     gates = {key: trend_gate([(r["round"], r["value"]) for r in rows],
                              tolerance=tolerance, seed=seed)
              for key, rows in sorted(series.items())}
@@ -598,6 +655,29 @@ def render_history(root: str = ".") -> str:
                      + ", ".join(detail))
         if gate.get("note"):
             lines.append(f"  note: {gate['note']}")
+    for key, rows in sorted(index["pilot_series"].items()):
+        gate = trends["series"].get(key, {})
+        lines.append(f"== {key} ({len(rows)} piloted rounds) ==")
+        for r in rows:
+            extras = [f"best win lo {r['best_win_lo_pct']:.1f}%"]
+            if r.get("promotions"):
+                extras.append(f"{r['promotions']} promotion(s)")
+            lines.append(f"  r{r['round']:02d}: "
+                         f"{_fmt_val(r['value'], r['unit'])}"
+                         f"  [{', '.join(extras)}]")
+        detail = []
+        if gate.get("slope_pct_per_round") is not None:
+            detail.append(f"slope {gate['slope_pct_per_round']:+.1f}%"
+                          f"/round")
+        if gate.get("ci_pct_per_round") is not None:
+            ci = gate["ci_pct_per_round"]
+            detail.append(f"95% CI [{ci[0]:+.1f}%, {ci[1]:+.1f}%]")
+        detail.append(f"tolerance {gate.get('tolerance_pct', 0):.0f}%"
+                      f"/round (seed {gate.get('seed')})")
+        lines.append(f"  trend: {gate.get('verdict', '?').upper()} — "
+                     + ", ".join(detail))
+        if gate.get("note"):
+            lines.append(f"  note: {gate['note']}")
     for w in index["workload"]:
         props = f", {w['proposals']} advisory proposal(s)" \
             if w["proposals"] else ""
@@ -610,6 +690,14 @@ def render_history(root: str = ".") -> str:
         lines.append(f"watch: {w['file']} — {w['admitted']} requests, "
                      f"SLO {'compliant' if w['compliant'] else 'VIOLATED'}"
                      f", {w['anomalies']} anomaly(ies){causes}")
+    for p in index["pilot"]:
+        acts = f" — actions: {', '.join(p['actions'])}" \
+            if p["actions"] else ""
+        lines.append(f"pilot: {p['file']} ({p['mode']}) — "
+                     f"{p['admitted']} requests profiled, "
+                     f"{p['targets']} target(s), "
+                     f"{p['promotions']} promotion(s), "
+                     f"{p['demotions']} demotion(s){acts}")
     mc = index["multichip"]
     if mc:
         ok = sum(1 for m in mc if m.get("ok"))

@@ -73,9 +73,9 @@ __all__ = ["validate_bench", "validate_multichip", "validate_tune",
            "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE", "TUNE_SCHEMAS",
            "TRAFFIC_SCHEMAS", "PREDICT_SCHEMAS", "COMPARE_SCHEMAS",
            "SERVE_SCHEMAS", "SYNTH_SCHEMAS", "WORKLOAD_SCHEMAS",
-           "WATCH_SCHEMAS", "validate_predict", "validate_compare",
-           "validate_serve", "validate_synth", "validate_workload",
-           "validate_watch"]
+           "WATCH_SCHEMAS", "PILOT_SCHEMAS", "validate_predict",
+           "validate_compare", "validate_serve", "validate_synth",
+           "validate_workload", "validate_watch", "validate_pilot"]
 
 #: Relative slowdown vs the best prior same-platform round that counts as
 #: a regression. Differenced-chain numbers jitter a few percent
@@ -1854,4 +1854,131 @@ def validate_watch(obj, where: str = "WATCH") -> list[str]:
                           f"re-derive from the blob's own rows + "
                           f"evidence blocks (attribute_anomaly): "
                           f"artifact {got_v} vs re-derived {want_v}")
+    return errors
+
+
+#: Valid ``schema`` tags for PILOT_r*.json (tpu_aggcomm/pilot/ — the
+#: ``cli pilot`` output) — versioned like TUNE_SCHEMAS.
+PILOT_SCHEMAS = ("pilot-v1",)
+
+
+def validate_pilot(obj, where: str = "PILOT") -> list[str]:
+    """Validate one PILOT_r*.json blob (pilot-v1) and re-derive every
+    claim re-derivable from the artifact ALONE: each campaign's race
+    verdict from its recorded samples, the win CI and improvement flag
+    from the recorded numbers (pilot/campaign.replay_campaign with the
+    search left to ``pilot --replay`` — re-running the seeded search
+    per artifact is the stream-level gate's job), every decision from
+    the one decision arithmetic over the recorded swap evidence, every
+    promotion record through validate_promotion_record, and each
+    demotion action against its own recorded detection. An artifact
+    whose own rows contradict a promotion it claims is schema-invalid —
+    the zero-silent-method-changes contract, enforced at validation
+    time. jax-free."""
+    import json as _json
+
+    from tpu_aggcomm.pilot.artifact import derive_decision
+    from tpu_aggcomm.pilot.campaign import replay_campaign
+    from tpu_aggcomm.pilot.promote import validate_promotion_record
+
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: artifact must be a JSON object, got "
+                f"{type(obj).__name__}"]
+    w = where
+    schema = obj.get("schema")
+    if schema not in PILOT_SCHEMAS:
+        errors.append(f"{w}: unknown schema {schema!r} "
+                      f"(expected one of {list(PILOT_SCHEMAS)})")
+        return errors
+    _require(obj, "manifest", dict, errors, w)
+    _require(obj, "created_unix", (int, float), errors, w)
+    _require(obj, "seed", int, errors, w)
+    _require(obj, "mode", str, errors, w)
+    _require(obj, "journals", list, errors, w)
+    _require(obj, "fingerprint", str, errors, w)
+    _require(obj, "requests", dict, errors, w)
+    _require(obj, "proposals", list, errors, w)
+    _require(obj, "targets", list, errors, w)
+    _require(obj, "demotions", list, errors, w)
+    _require(obj, "campaigns", list, errors, w)
+    _require(obj, "decisions", list, errors, w)
+    _require(obj, "promotions", list, errors, w)
+    _require(obj, "race_opts", dict, errors, w)
+    _require(obj, "per_shape", dict, errors, w, nullable=True)
+    if errors:
+        return errors
+    if obj["mode"] not in ("live", "dry-run"):
+        errors.append(f"{w}: mode must be 'live' or 'dry-run', got "
+                      f"{obj['mode']!r}")
+    for i, ent in enumerate(obj["journals"]):
+        if not isinstance(ent, dict) or not isinstance(
+                ent.get("name"), str) or not isinstance(
+                ent.get("lines"), int):
+            errors.append(f"{w}: journals[{i}] must be "
+                          f"{{name: str, lines: int}}, got {ent!r}")
+
+    inputs = obj.get("inputs") or {}
+    for i, c in enumerate(obj["campaigns"]):
+        for p in replay_campaign(c, params=inputs.get("params"),
+                                 params_source=inputs.get("params_source"),
+                                 rerun_search=False):
+            errors.append(f"{w}: campaigns[{i}]: {p}")
+
+    # the decision arithmetic over the artifact's own evidence
+    active = [t for t in obj["targets"]
+              if isinstance(t, dict) and t.get("skipped") is None]
+    if len(active) != len(obj["campaigns"]) \
+            or len(obj["campaigns"]) != len(obj["decisions"]):
+        errors.append(f"{w}: {len(active)} active target(s) vs "
+                      f"{len(obj['campaigns'])} campaign(s) vs "
+                      f"{len(obj['decisions'])} decision(s) — the "
+                      f"decision trace is truncated")
+    else:
+        for t, c, d_rec in zip(active, obj["campaigns"],
+                               obj["decisions"]):
+            try:
+                want = derive_decision(
+                    t, c, mode=obj["mode"],
+                    fingerprint=obj["fingerprint"],
+                    swap=(d_rec or {}).get("swap"))
+            except Exception as e:  # lint: broad-ok (validation must report a malformed campaign as a schema error, not crash the checker)
+                errors.append(f"{w}: decision for "
+                              f"{c.get('incumbent_cid')} does not "
+                              f"re-derive: {type(e).__name__}: {e}")
+                continue
+            if _json.dumps(want, sort_keys=True) \
+                    != _json.dumps(d_rec, sort_keys=True):
+                errors.append(
+                    f"{w}: decision for {c.get('incumbent_cid')} "
+                    f"contradicts the one decision arithmetic over its "
+                    f"own campaign + swap evidence (recorded "
+                    f"{(d_rec or {}).get('action')!r})")
+        want_promos = [d["record"] for d in obj["decisions"]
+                       if isinstance(d, dict)
+                       and d.get("action") == "promote"]
+        if _json.dumps(want_promos, sort_keys=True) \
+                != _json.dumps(obj["promotions"], sort_keys=True):
+            errors.append(f"{w}: promotions must be exactly the "
+                          f"promote-decision records")
+
+    for i, rec in enumerate(obj["promotions"]):
+        for p in validate_promotion_record(rec):
+            errors.append(f"{w}: promotions[{i}]: {p}")
+
+    for i, row in enumerate(obj["demotions"]):
+        if not isinstance(row, dict):
+            errors.append(f"{w}: demotions[{i}] must be an object")
+            continue
+        det = row.get("detection")
+        regressed = isinstance(det, dict) \
+            and det.get("direction") == "up"
+        want_action = "demote" if regressed else "hold"
+        if row.get("action") != want_action:
+            errors.append(
+                f"{w}: demotions[{i}] action {row.get('action')!r} "
+                f"contradicts its own recorded detection "
+                f"({'confirmed up-step' if regressed else 'no confirmed regression'})")
+        for p in validate_promotion_record(row.get("record")):
+            errors.append(f"{w}: demotions[{i}].record: {p}")
     return errors

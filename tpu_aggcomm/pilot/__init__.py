@@ -1,0 +1,41 @@
+"""Autopilot: the online control loop over the serve layer.
+
+The pilot closes the loop the offline tools left open: the workload
+profiler (obs/workload.py) *measures* traffic, the watchtower
+(obs/watch.py) *judges* it, the synthesizer (tpu_aggcomm/synth/)
+*invents* schedules and the tuner (tpu_aggcomm/tune/) *races* them —
+the pilot chains those into detection → campaign → promotion, every
+step a recorded, replayable artifact (``PILOT_r*.json``, pilot-v1).
+
+Discipline (the whole package is in ``analysis/lint.PURE_PACKAGES``):
+
+- **jax-free planner** — tailing, target folding, campaign search,
+  promotion records and artifact replay never import jax; only the
+  measured race's sampler goes through ``tune/measure.py``, the one
+  declared jax door (and a synthetic sampler covers the smoke path).
+- **Advisory until proven** — a campaign winner changes NOTHING until
+  (a) its seeded-bootstrap latency win's CI excludes zero and (b) the
+  serve layer verified the new method byte-exact against the local
+  oracle through its normal queue. Predictions and proposals never
+  gate; measured, verified wins do.
+- **Named, reversible promotions** — every cache swap traces to a
+  validated promotion record (old id, new id, composition, win CI,
+  manifest fingerprint) journaled by the server; demotion re-installs
+  the old entry by the same record. Zero silent method changes.
+"""
+
+from tpu_aggcomm.pilot.artifact import (PILOT_SCHEMA, load_pilot,
+                                        next_pilot_path, render_pilot,
+                                        replay_pilot, run_pilot,
+                                        write_pilot)
+from tpu_aggcomm.pilot.campaign import CampaignError, run_campaign
+from tpu_aggcomm.pilot.plan import PilotError, fold_targets
+from tpu_aggcomm.pilot.promote import (PromotionError,
+                                       make_promotion_record,
+                                       validate_promotion_record)
+
+__all__ = ["PILOT_SCHEMA", "PilotError", "CampaignError",
+           "PromotionError", "fold_targets", "run_campaign",
+           "make_promotion_record", "validate_promotion_record",
+           "run_pilot", "write_pilot", "replay_pilot",
+           "load_pilot", "render_pilot", "next_pilot_path"]

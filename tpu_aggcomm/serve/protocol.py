@@ -3,7 +3,7 @@
 One request per line, one response per line, both plain JSON objects —
 the same torn-line-tolerant framing every journal in this repo uses, so
 a client killed mid-send costs the server one unparsable line, never a
-wedged connection state machine. Three ops:
+wedged connection state machine. The ops:
 
 - ``{"op": "run", ...pattern fields...}`` — execute one rep of the
   requested (method, shape, fault, backend) and answer with the request
@@ -19,6 +19,14 @@ wedged connection state machine. Three ops:
   counts. Answered even when the server is DEGRADED (jax-free op).
 - ``{"op": "shutdown"}`` — graceful drain (stop admitting, finish
   in-flight batches, flush the journal) and stop.
+- ``{"op": "swap", "record": {...}}`` — apply a validated promotion
+  record (tpu_aggcomm/pilot/promote.py): the server re-verifies the new
+  method byte-exact through its normal queue before installing the
+  override, journals the promotion by name, and refuses anything the
+  record's own evidence does not support.
+- ``{"op": "demote", "record": {...}, "reason": "..."}`` — reverse a
+  promotion by presenting the SAME record that installed it plus the
+  regression verdict that motivates the rollback.
 
 Everything in this module is jax-free (stdlib + core + faults): the
 client side and the request -> Schedule compilation run precisely where
@@ -257,6 +265,18 @@ class ServeClient:
 
     def shutdown(self) -> dict:
         return self._roundtrip({"op": "shutdown"})
+
+    def swap(self, record: dict) -> dict:
+        """Apply a promotion record (tpu_aggcomm/pilot/promote.py). The
+        server refuses anything that fails validation and re-verifies
+        the new method byte-exact before installing."""
+        return self._roundtrip({"op": "swap", "record": record})
+
+    def demote(self, record: dict, reason: str) -> dict:
+        """Reverse a promotion by the SAME record that installed it;
+        ``reason`` must name the regression verdict."""
+        return self._roundtrip({"op": "demote", "record": record,
+                                "reason": reason})
 
     def close(self) -> None:
         sock, fh = self._sock, self._fh

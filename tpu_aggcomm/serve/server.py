@@ -120,15 +120,21 @@ class _Pending:
     """One enqueued request awaiting its batch."""
 
     __slots__ = ("req", "rid", "schedule", "shape_key", "backend_name",
-                 "t0", "deadline", "event", "response", "marks",
-                 "depth_at_admit")
+                 "served_method", "t0", "deadline", "event", "response",
+                 "marks", "depth_at_admit")
 
-    def __init__(self, req, rid, schedule, shape_key, backend_name):
+    def __init__(self, req, rid, schedule, shape_key, backend_name,
+                 served_method=None):
         self.req = req
         self.rid = rid
         self.schedule = schedule
         self.shape_key = shape_key
         self.backend_name = backend_name
+        # the method id that actually executes — differs from
+        # req.method only under an installed promotion, and then it is
+        # ALWAYS named in the response + journal (zero silent swaps)
+        self.served_method = (served_method if served_method is not None
+                              else req.method)
         self.t0 = time.monotonic()
         self.deadline = (self.t0 + req.deadline_ms / 1e3
                          if req.deadline_ms is not None else None)
@@ -185,7 +191,18 @@ class ScheduleServer:
         self._cv = threading.Condition()
         self._queue: deque[_Pending] = deque()
         self._stop = False
-        self._schedules: dict[tuple, tuple] = {}   # shape sig -> (sched, key)
+        self._schedules: dict[tuple, tuple] = {}   # sig -> (sched, key, mid)
+        # installed promotions (autopilot swap op): shape sig -> the
+        # validated record + install seq. A promoted sig re-resolves to
+        # the NEW method's schedule; demote deletes the entry (and the
+        # resolved-schedule cache line) so the old method serves again.
+        self._promotions: dict[tuple, dict] = {}
+        self._promo_seq = 0
+        # per-shape_key serve stats (repr(shape_key) -> counters);
+        # latency_sum is the SAME latency the journal records per
+        # request, accumulated in journal order (float-consistency pin
+        # in tests/test_serve.py)
+        self._per_shape: dict[str, dict] = {}
         self._floor_params = _FLOOR_UNSET
         self._floors: dict = {}                    # shape_key -> float | None
         self._cache = CompiledChainCache()
@@ -619,20 +636,30 @@ class ScheduleServer:
 
     # -- request intake ----------------------------------------------------
     def _schedule_for(self, req, backend_name: str):
-        """(schedule, shape_key) for a request — compiled and (under a
-        fault spec) repaired once per distinct shape, jax-free."""
+        """(schedule, shape_key, served_method) for a request — compiled
+        and (under a fault spec) repaired once per distinct shape,
+        jax-free. An installed promotion re-routes the REQUESTED shape
+        to the promoted method's schedule; the served method id is
+        threaded through to the response and journal so a swap is never
+        silent."""
         sig = tuple(getattr(req, f) for f in req.shape_fields) \
             + (backend_name,)
         with self._cv:
             hit = self._schedules.get(sig)
+            promo = self._promotions.get(sig)
         if hit is not None:
             return hit
+        served_method = req.method
+        if promo is not None:
+            import dataclasses
+            served_method = promo["record"]["new_method"]
+            req = dataclasses.replace(req, method=served_method)
         schedule = request_schedule(req)
         from tpu_aggcomm.core.schedule import schedule_shape_key
         shape_key = schedule_shape_key(schedule)
         with self._cv:
-            self._schedules[sig] = (schedule, shape_key)
-        return schedule, shape_key
+            self._schedules[sig] = (schedule, shape_key, served_method)
+        return schedule, shape_key, served_method
 
     def _handle_conn_slot(self, conn) -> None:
         try:
@@ -655,6 +682,11 @@ class ScheduleServer:
                         send_msg(fh, self.stats())
                     elif op == "health":
                         send_msg(fh, self.health())
+                    elif op == "swap":
+                        send_msg(fh, self.swap(msg.get("record")))
+                    elif op == "demote":
+                        send_msg(fh, self.demote(msg.get("record"),
+                                                 msg.get("reason")))
                     elif op == "shutdown":
                         send_msg(fh, {"ok": True, "stopping": True})
                         self.begin_drain("shutdown op")
@@ -671,7 +703,8 @@ class ScheduleServer:
                 raise ProtocolError(
                     f"run request backend {backend_name!r} is not "
                     f"servable; valid: {SERVE_BACKENDS}")
-            schedule, shape_key = self._schedule_for(req, backend_name)
+            schedule, shape_key, served_method = \
+                self._schedule_for(req, backend_name)
         except (ProtocolError, FaultSpecError, RepairError,
                 ValueError) as e:
             with self._cv:
@@ -743,7 +776,8 @@ class ScheduleServer:
                 f"retry later or raise the bound",
                 depth=depth, limit=self._max_queue))
             return
-        pending = _Pending(req, rid, schedule, shape_key, backend_name)
+        pending = _Pending(req, rid, schedule, shape_key, backend_name,
+                           served_method)
         pending.depth_at_admit = depth
         try:
             # admission journal record BEFORE the executor can see the
@@ -758,6 +792,7 @@ class ScheduleServer:
                     {"request": rid}, fingerprint=self._fp,
                     status="admitted", shape=shape, backend=backend_name,
                     iter=req.iter_, deadline_ms=req.deadline_ms,
+                    served_method=served_method,
                     t_unix=time.time(), queue_depth=depth)
         finally:
             with self._cv:
@@ -907,12 +942,19 @@ class ScheduleServer:
             self._registry.gauge("tpu_aggcomm_serve_padding_waste_bytes",
                                  float(waste_total))
         chain = entry["chain"]
+        # occupancy marker for the pilot's contention guard
+        # (tune/measure.py): an in-process campaign sampler refuses to
+        # take race samples while this dispatch is in flight on the
+        # same backend (one CPU core — concurrent measured workloads
+        # corrupt each other's differenced timings)
+        from tpu_aggcomm.tune.measure import serve_dispatch_inflight
         try:
-            with trace.span("serve.batch", seq=seq, n=len(batch),
-                            backend=head.backend_name,
-                            method=head.schedule.method_id,
-                            padded=padded,
-                            rids=[p.rid for p in batch]):
+            with serve_dispatch_inflight(head.backend_name), \
+                    trace.span("serve.batch", seq=seq, n=len(batch),
+                               backend=head.backend_name,
+                               method=head.schedule.method_id,
+                               padded=padded,
+                               rids=[p.rid for p in batch]):
                 results = retry_call(
                     lambda: executor.execute_batch(
                         chain, [p.req for p in batch]),
@@ -946,6 +988,7 @@ class ScheduleServer:
                       "latency_s": latency, "batch_n": batch_n,
                       "cache": disposition, "compile_s": compile_s,
                       "backend": p.backend_name,
+                      "served_method": p.served_method,
                       "shape_key": repr(p.shape_key)}
         with self._cv:
             if ok:
@@ -955,6 +998,17 @@ class ScheduleServer:
             else:
                 self._n_errors += 1
                 self._n_failed += 1
+            # per-shape counters: exactly one row update per journaled
+            # done/fail, same latency value, same order — the pilot's
+            # target-ranking evidence (float-consistency pin in
+            # tests/test_serve.py)
+            row = self._per_shape.setdefault(
+                repr(p.shape_key),
+                {"hit": 0, "miss": 0, "requests": 0,
+                 "latency_sum": 0.0})
+            row["hit" if disposition == "hit" else "miss"] += 1
+            row["requests"] += 1
+            row["latency_sum"] += latency
         if self._registry is not None:
             self._registry.observe("tpu_aggcomm_serve_request_seconds",
                                    latency, backend=p.backend_name,
@@ -977,6 +1031,7 @@ class ScheduleServer:
                 {"request": p.rid}, fingerprint=self._fp,
                 status="done" if ok else "fail",
                 shape_keys=[repr(p.shape_key)], backend=p.backend_name,
+                served_method=p.served_method,
                 iter=p.req.iter_, latency_s=latency, batch_n=batch_n,
                 cache=disposition, error=error, phases=dict(p.marks),
                 batch_seq=batch_seq, batch_padded=batch_padded,
@@ -1021,6 +1076,12 @@ class ScheduleServer:
                    "completed": self._n_completed,
                    "errors": self._n_errors,
                    "shed": dict(self._shed),
+                   "per_shape": {k: dict(v)
+                                 for k, v in self._per_shape.items()},
+                   "promotions": sorted(
+                       ({"seq": v["seq"], "record": v["record"]}
+                        for v in self._promotions.values()),
+                       key=lambda r: r["seq"]),
                    "cache": dict(self._cache.stats(),
                                  compiles=self._n_compiles),
                    "batch": {"batches": self._n_batches,
@@ -1039,3 +1100,193 @@ class ScheduleServer:
         if self._metrics is not None:
             out["metrics_url"] = self._metrics.url
         return out
+
+    # -- autopilot promotions ----------------------------------------------
+    def _promo_sig(self, record: dict) -> tuple:
+        """The schedule-resolution signature a promotion overrides —
+        the SAME tuple _schedule_for keys on, built through the same
+        parse_request path (identity, never guesswork)."""
+        req = parse_request(dict(record["shape"]))
+        return tuple(getattr(req, f) for f in req.shape_fields) \
+            + (record["backend"],)
+
+    def _refuse_swap(self, op: str, why: str) -> dict:
+        print(f"serve: {op} refused: {why}", file=sys.stderr)
+        return {"ok": False, "op": op, "error": f"{op} refused: {why}"}
+
+    def swap(self, record) -> dict:
+        """Apply one validated promotion record (the pilot's ``swap``
+        op). The record is the ONLY currency accepted: structural
+        validation, fingerprint match, registration of a synthesized
+        winner, then a byte-exact ``--verify`` of the NEW method through
+        the NORMAL request queue — the override installs only on a
+        verified pass, and the installation is journaled by name."""
+        from tpu_aggcomm.pilot.promote import validate_promotion_record
+        problems = validate_promotion_record(record)
+        if problems:
+            return self._refuse_swap("swap", "; ".join(problems))
+        if record["fingerprint"] != self._fp:
+            return self._refuse_swap(
+                "swap",
+                f"record fingerprint {record['fingerprint'][:12]}… does "
+                f"not match this server's manifest fingerprint "
+                f"{self._fp[:12]}… — a win measured under a drifted "
+                f"manifest does not transfer")
+        backend = record["backend"]
+        if backend not in SERVE_BACKENDS:
+            return self._refuse_swap(
+                "swap", f"backend {backend!r} is not servable; valid: "
+                        f"{SERVE_BACKENDS}")
+        with self._cv:
+            state = self._state
+        if state != "ready":
+            return self._refuse_swap(
+                "swap", f"server is {state.upper()} — promotions apply "
+                        f"to a READY server only")
+        from tpu_aggcomm.core.methods import METHODS
+        if record["new_method"] not in METHODS \
+                and record.get("composition"):
+            from tpu_aggcomm.synth.register import (RegisterError,
+                                                    register_composition)
+            old_spec = METHODS.get(record["old_method"])
+            if old_spec is None:
+                return self._refuse_swap(
+                    "swap", f"old_method {record['old_method']} is not "
+                            f"a registered method on this server")
+            try:
+                register_composition(record["composition"],
+                                     method_id=record["new_method"],
+                                     direction=old_spec.direction.value)
+            except (RegisterError, ValueError) as e:
+                return self._refuse_swap(
+                    "swap", f"cannot register composition "
+                            f"{record['composition']!r} as method "
+                            f"{record['new_method']}: {e}")
+        try:
+            sig = self._promo_sig(record)
+            verify_req = parse_request(dict(
+                record["shape"], method=record["new_method"],
+                backend=backend, verify=True))
+            schedule = request_schedule(verify_req)
+            from tpu_aggcomm.core.schedule import schedule_shape_key
+            shape_key = schedule_shape_key(schedule)
+        except (ProtocolError, FaultSpecError, RepairError,
+                ValueError) as e:
+            return self._refuse_swap(
+                "swap", f"promoted method does not compile for this "
+                        f"shape: {type(e).__name__}: {e}")
+        with self._cv:
+            if sig in self._promotions:
+                return self._refuse_swap(
+                    "swap", f"a promotion (seq "
+                            f"{self._promotions[sig]['seq']}) is "
+                            f"already installed at this shape — demote "
+                            f"it first")
+            self._rid += 1
+            rid = self._rid
+            depth = len(self._queue) + self._reserved
+        # the acceptance bar: the NEW method, byte-exact vs the local
+        # oracle, through the normal queue (same batching, same
+        # journal) — never a side-door execution
+        pending = _Pending(verify_req, rid, schedule, shape_key,
+                           backend, record["new_method"])
+        pending.depth_at_admit = depth
+        if self._journal is not None:
+            shape = {f: getattr(verify_req, f)
+                     for f in verify_req.shape_fields}
+            self._journal.record(
+                {"request": rid}, fingerprint=self._fp,
+                status="admitted", shape=shape, backend=backend,
+                iter=verify_req.iter_, deadline_ms=None,
+                served_method=record["new_method"],
+                purpose="swap-verify", t_unix=time.time(),
+                queue_depth=depth)
+        with self._cv:
+            self._queue.append(pending)
+            self._cv.notify_all()
+        if not pending.event.wait(timeout=600.0):
+            return self._refuse_swap(
+                "swap", "verify leg timed out after 600 s — nothing "
+                        "installed")
+        resp = pending.response
+        if not (resp.get("ok") and resp.get("verified") is True):
+            return {"ok": True, "op": "swap", "installed": False,
+                    "verified": resp.get("verified"),
+                    "verify_rid": rid,
+                    "error": resp.get("error")
+                    or "verify leg did not return a verified pass — "
+                       "nothing installed"}
+        with self._cv:
+            self._promo_seq += 1
+            seq = self._promo_seq
+            self._promotions[sig] = {"seq": seq, "record": record}
+            # drop the resolved-schedule line so the next request at
+            # this sig re-resolves through the promotion
+            self._schedules.pop(sig, None)
+        if self._journal is not None:
+            self._journal.record(
+                {"promotion": seq}, fingerprint=self._fp, status="swap",
+                record=record, verify_rid=rid, t_unix=time.time())
+        trace.instant("serve.swap", seq=seq,
+                      old_method=record["old_method"],
+                      new_method=record["new_method"],
+                      new_cid=record["new_cid"],
+                      win_ci_pct=record["win_ci_pct"])
+        print(f"serve: promotion seq {seq}: m{record['old_method']} "
+              f"({record['old_cid']}) -> m{record['new_method']} "
+              f"({record['new_cid']}), win CI "
+              f"[{record['win_ci_pct'][0]:.1f}%, "
+              f"{record['win_ci_pct'][1]:.1f}%], verified rid {rid}",
+              file=sys.stderr)
+        return {"ok": True, "op": "swap", "installed": True,
+                "verified": True, "seq": seq, "verify_rid": rid,
+                "record": record}
+
+    def demote(self, record, reason) -> dict:
+        """Reverse one promotion. Accepts only the SAME record that
+        installed it (byte-level identity — never a lookalike) plus a
+        non-empty reason naming the regression verdict; re-installs the
+        old entry by deleting the override and its resolved-schedule
+        cache line, journaled by name."""
+        from tpu_aggcomm.pilot.promote import (records_equal,
+                                               validate_promotion_record)
+        if not isinstance(reason, str) or not reason.strip():
+            return self._refuse_swap(
+                "demote", "a demotion must name the regression verdict "
+                          "that motivates it (empty reason refused)")
+        problems = validate_promotion_record(record)
+        if problems:
+            return self._refuse_swap("demote", "; ".join(problems))
+        try:
+            sig = self._promo_sig(record)
+        except (ProtocolError, ValueError) as e:
+            return self._refuse_swap(
+                "demote", f"record shape does not parse: {e}")
+        with self._cv:
+            inst = self._promotions.get(sig)
+            if inst is None:
+                return self._refuse_swap(
+                    "demote", "no promotion is installed at this shape")
+            if not records_equal(inst["record"], record):
+                return self._refuse_swap(
+                    "demote", f"record does not match the installed "
+                              f"promotion (seq {inst['seq']}) — "
+                              f"demotion must present the SAME record "
+                              f"that promoted, never a lookalike")
+            seq = inst["seq"]
+            del self._promotions[sig]
+            self._schedules.pop(sig, None)
+        if self._journal is not None:
+            self._journal.record(
+                {"promotion": seq}, fingerprint=self._fp,
+                status="demote", record=record, reason=reason,
+                t_unix=time.time())
+        trace.instant("serve.demote", seq=seq,
+                      old_method=record["old_method"],
+                      new_method=record["new_method"], reason=reason)
+        print(f"serve: demotion of promotion seq {seq}: "
+              f"m{record['new_method']} -> m{record['old_method']} "
+              f"restored — {reason}", file=sys.stderr)
+        return {"ok": True, "op": "demote", "seq": seq,
+                "restored_method": record["old_method"],
+                "reason": reason}

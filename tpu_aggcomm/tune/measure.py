@@ -19,8 +19,55 @@ the same environment computes.
 
 from __future__ import annotations
 
+import contextlib
+import threading
+
 __all__ = ["record_device_facts", "make_jax_sim_sampler",
-           "make_pallas_fused_sampler", "make_jax_shard_sampler"]
+           "make_pallas_fused_sampler", "make_jax_shard_sampler",
+           "PilotContentionError", "serve_dispatch_inflight"]
+
+
+class PilotContentionError(RuntimeError):
+    """A campaign sampler refused to measure while a serve dispatch is
+    in flight on the same backend — the one-CPU-core discipline: two
+    concurrent measured workloads inflate each other's differenced
+    timings 2-3x, so a race sample taken under serve load is not a
+    sample, it is noise with a seed."""
+
+
+# serve-dispatch occupancy per backend name (module-level: the serve
+# executor and any in-process campaign sampler share this registry).
+_INFLIGHT: dict[str, int] = {}
+_INFLIGHT_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def serve_dispatch_inflight(backend_name: str):
+    """Mark one serve dispatch in flight on ``backend_name`` for the
+    duration of the with-block (serve/server.py wraps its
+    ``execute_batch`` call). jax-free — occupancy accounting only."""
+    with _INFLIGHT_LOCK:
+        _INFLIGHT[backend_name] = _INFLIGHT.get(backend_name, 0) + 1
+    try:
+        yield
+    finally:
+        with _INFLIGHT_LOCK:
+            _INFLIGHT[backend_name] -= 1
+            if _INFLIGHT[backend_name] <= 0:
+                del _INFLIGHT[backend_name]
+
+
+def _check_contention(backend_name: str) -> None:
+    """Refuse by name when a serve dispatch is in flight on the backend
+    a sampler is about to measure."""
+    with _INFLIGHT_LOCK:
+        n = _INFLIGHT.get(backend_name, 0)
+    if n > 0:
+        raise PilotContentionError(
+            f"{n} serve dispatch(es) in flight on backend "
+            f"{backend_name!r} — refusing to take race samples under "
+            f"serve load (one-CPU-core contention skews differenced "
+            f"timings 2-3x); retry when the serve queue drains")
 
 
 def record_device_facts() -> None:
@@ -49,11 +96,13 @@ def make_jax_sim_sampler(*, nprocs: int, data_size: int, proc_node: int,
     from tpu_aggcomm.core.pattern import AggregatorPattern
     from tpu_aggcomm.tune.space import parse_cid
 
+    _check_contention("jax_sim")
     record_device_facts()
     backend = JaxSimBackend()
     schedules: dict[str, object] = {}
 
     def sampler(cid: str, batch: int) -> list[float]:
+        _check_contention("jax_sim")
         if cid not in schedules:
             c = parse_cid(cid)
             schedules[cid] = compile_method(c.method, AggregatorPattern(
@@ -82,11 +131,13 @@ def make_jax_shard_sampler(*, nprocs: int, data_size: int, proc_node: int,
     from tpu_aggcomm.core.pattern import AggregatorPattern
     from tpu_aggcomm.tune.space import parse_cid
 
+    _check_contention("jax_shard")
     record_device_facts()
     backend = JaxShardBackend()
     schedules: dict[str, object] = {}
 
     def sampler(cid: str, batch: int) -> list[float]:
+        _check_contention("jax_shard")
         if cid not in schedules:
             c = parse_cid(cid)
             schedules[cid] = compile_method(c.method, AggregatorPattern(
@@ -115,11 +166,13 @@ def make_pallas_fused_sampler(*, nprocs: int, data_size: int,
     from tpu_aggcomm.core.pattern import AggregatorPattern
     from tpu_aggcomm.tune.space import parse_cid
 
+    _check_contention("pallas_fused")
     record_device_facts()
     backend = PallasFusedBackend()
     schedules: dict[str, object] = {}
 
     def sampler(cid: str, batch: int) -> list[float]:
+        _check_contention("pallas_fused")
         if cid not in schedules:
             c = parse_cid(cid)
             schedules[cid] = compile_method(c.method, AggregatorPattern(
