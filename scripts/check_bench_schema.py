@@ -31,7 +31,8 @@ from tpu_aggcomm.obs.regress import (parsed_schema_version, validate_bench,
                                      validate_predict, validate_serve,
                                      validate_synth, validate_traffic,
                                      validate_pilot, validate_tune,
-                                     validate_watch, validate_workload)
+                                     validate_watch, validate_workload,
+                                     validate_flow)
 
 
 def check(root: str) -> int:
@@ -183,6 +184,34 @@ def check(root: str) -> int:
         n_watch += 1
         n_errors += 1
         print(f"FAIL {e}")
+    # FLOW_r*.json causal-flow artifacts (obs/flow.py, flow-v1):
+    # discovered through load_history like the watch rounds; a
+    # decomposition the artifact's own rows contradict must fail here
+    n_flow = 0
+    flow_errors: list[str] = []
+    for rnd, path, blob in load_history(root, "FLOW",
+                                        errors=flow_errors):
+        n_files += 1
+        n_flow += 1
+        errors = validate_flow(blob, os.path.basename(path))
+        if errors:
+            n_errors += len(errors)
+            for e in errors:
+                print(f"FAIL {e}")
+        else:
+            req = blob.get("requests") or {}
+            wo = blob.get("warm_overhead") or {}
+            wtxt = (f"warm overhead {wo['mean']:.1%}"
+                    if isinstance(wo.get("mean"), (int, float))
+                    else "no warm requests")
+            print(f"ok   {os.path.basename(path)} "
+                  f"({blob.get('schema', '?')}, {req.get('joined', 0)} "
+                  f"joined, {wtxt})")
+    for e in flow_errors:
+        n_files += 1
+        n_flow += 1
+        n_errors += 1
+        print(f"FAIL {e}")
     # PILOT_r*.json autopilot artifacts (tpu_aggcomm/pilot/, pilot-v1):
     # a promotion decision the artifact's own campaigns + swap evidence
     # contradict must fail here (the zero-silent-method-changes
@@ -264,7 +293,7 @@ def check(root: str) -> int:
     print(f"{n_files} artifact(s) ({n_tune} tune, {n_traffic} traffic, "
           f"{n_model} model/compare, {n_serve} serve, {n_synth} synth, "
           f"{n_workload} workload, {n_watch} watch, "
-          f"{n_pilot} pilot), "
+          f"{n_pilot} pilot, {n_flow} flow), "
           f"{n_errors} schema error(s)")
     return 1 if n_errors else 0
 

@@ -187,5 +187,24 @@ for f in PILOT_r*.json; do
   [ -e "$f" ] || continue
   python -m tpu_aggcomm.cli pilot --replay "$f" || post_rc=1
 done
+# causal-flow gate (obs/flow.py, jax-free): the committed client/serve
+# exemplar streams must join cleanly (every decomposition float-exact
+# by construction — the client wall IS wire + server phases + rounds +
+# the quantified residual, validate_flow re-derives every row), and
+# every committed FLOW_r*.json must --replay to REPRODUCED from the
+# stream basenames named inside it — the same replay discipline as
+# tune/PREDICT/SYNTH/WORKLOAD/WATCH/PILOT. A warm-overhead ledger that
+# cannot reproduce must not be cited as the warm-path cost of serving.
+if [ -e flow_exemplar.client.journal.jsonl ] \
+    && [ -e flow_exemplar.serve.journal.jsonl ] \
+    && [ -e flow_exemplar.trace.jsonl ]; then
+  python -m tpu_aggcomm.cli inspect flow \
+    flow_exemplar.client.journal.jsonl flow_exemplar.serve.journal.jsonl \
+    flow_exemplar.trace.jsonl > /dev/null || post_rc=1
+fi
+for f in FLOW_r*.json; do
+  [ -e "$f" ] || continue
+  python -m tpu_aggcomm.cli inspect flow --replay "$f" || post_rc=1
+done
 if [ "$rc" -eq 0 ]; then rc=$post_rc; fi
 exit $rc

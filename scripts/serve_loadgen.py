@@ -169,6 +169,8 @@ def spawn_server(args) -> tuple[subprocess.Popen, int]:
         cmd += ["--max-queue", str(args.max_queue)]
     if args.journal:
         cmd += ["--journal", args.journal]
+    if args.server_trace:
+        cmd += ["--trace", args.server_trace]
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=sys.stderr, text=True)
     line = proc.stdout.readline()
@@ -208,6 +210,22 @@ def run_load(args, port: int, plan: list[dict]) -> dict:
     t_start = time.monotonic()
     records: list[dict | None] = [None] * n
 
+    # client-side stamp journal (--client-journal): crash-safe append
+    # JSONL, one "send" line before the socket roundtrip and one "recv"
+    # line after it — line-granular writes under one lock, flushed per
+    # line, so a SIGKILLed loadgen loses at most the line being written
+    # and a send with no matching recv names the request LOST in flight
+    # (obs/flow.py reads this stream torn-line-tolerantly).
+    jfh = None
+    jlock = threading.Lock()
+    if args.client_journal:
+        jfh = open(args.client_journal, "a")
+
+    def jrec(line: dict) -> None:
+        with jlock:
+            jfh.write(json.dumps(line) + "\n")
+            jfh.flush()
+
     def fire(i: int) -> None:
         item = plan[i]
         delay = t_start + item["at_s"] - time.monotonic()
@@ -217,16 +235,33 @@ def run_load(args, port: int, plan: list[dict]) -> dict:
         if args.deadline_ms is not None:
             fields["deadline_ms"] = args.deadline_ms
         t0 = time.monotonic()
+        if jfh is not None:
+            jrec({"ev": "send", "i": i, "t_send": t0,
+                  "shape": shape_spec(item["shape"])})
         try:
             with ServeClient(port, timeout=args.timeout) as c:
                 resp = c.run(**fields)
         except Exception as e:  # lint: broad-ok (a dead request is a record, not a loadgen crash)
+            t1 = time.monotonic()
             records[i] = {"ok": False, "error": f"{type(e).__name__}: {e}",
-                          "latency_s": time.monotonic() - t0,
+                          "latency_s": t1 - t0,
                           "cache": None}
+            if jfh is not None:
+                jrec({"ev": "recv", "i": i, "rid": None,
+                      "t_send": t0, "t_recv": t1,
+                      "client_wall_s": t1 - t0, "ok": False,
+                      "shed": None, "cache": None,
+                      "error": records[i]["error"]})
             return
-        resp["latency_s"] = time.monotonic() - t0   # client-side wall
+        t1 = time.monotonic()
+        resp["latency_s"] = t1 - t0   # client-side wall
         records[i] = resp
+        if jfh is not None:
+            jrec({"ev": "recv", "i": i, "rid": resp.get("request_id"),
+                  "t_send": t0, "t_recv": t1,
+                  "client_wall_s": t1 - t0, "ok": bool(resp.get("ok")),
+                  "shed": resp.get("shed"), "cache": resp.get("cache"),
+                  "error": resp.get("error")})
 
     threads = [threading.Thread(target=fire, args=(i,)) for i in range(n)]
     for t in threads:
@@ -234,6 +269,8 @@ def run_load(args, port: int, plan: list[dict]) -> dict:
     for t in threads:
         t.join()
     duration = time.monotonic() - t_start
+    if jfh is not None:
+        jfh.close()
 
     with ServeClient(port, timeout=args.timeout) as c:
         stats = c.stats()
@@ -284,6 +321,10 @@ def run_load(args, port: int, plan: list[dict]) -> dict:
         "seed": args.seed,
         "workload": (os.path.basename(args.workload)
                      if args.workload else None),
+        # the client stamp journal's basename (flow replay resolves it
+        # next to the artifact, like every other stream reference)
+        "client_journal": (os.path.basename(args.client_journal)
+                           if args.client_journal else None),
         "plan": plan,
         "shapes": sorted({shape_spec(p["shape"]) for p in plan})}
 
@@ -355,6 +396,15 @@ def main(argv=None) -> int:
                     help="(spawn mode) server --batch-window-ms")
     ap.add_argument("--journal", default=None,
                     help="(spawn mode) server --journal PATH")
+    ap.add_argument("--server-trace", default=None, metavar="PREFIX",
+                    help="(spawn mode) server --trace PREFIX — the "
+                         "flight-recorder stream 'cli inspect flow' "
+                         "joins dispatch round walls from")
+    ap.add_argument("--client-journal", default=None, metavar="PATH",
+                    help="append client-side send/recv wall stamps here "
+                         "(crash-safe JSONL, one line per stamp; the "
+                         "flow joiner's client stream — see "
+                         "'cli inspect flow')")
     ap.add_argument("--timeout", type=float, default=600.0,
                     help="per-request client timeout (default 600 s)")
     out = ap.add_mutually_exclusive_group()

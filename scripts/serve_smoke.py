@@ -3,7 +3,7 @@ compiles, batch correctly, survive overload by NAMED shedding, drain
 cleanly on SIGTERM, and recover from its own journal — CPU-only,
 auditable from its artifacts.
 
-Five legs, each driving the real entry points in subprocesses:
+Six legs, each driving the real entry points in subprocesses:
 
 1. **Warm/cold** (unchanged contract): 32 mixed-shape ``--verify``
    requests through ``scripts/serve_loadgen.py --spawn`` — all complete
@@ -28,6 +28,13 @@ Five legs, each driving the real entry points in subprocesses:
 5. **Recover**: a fresh ``cli serve --recover JOURNAL`` must report the
    replay on its ready line and pre-warm the compiled-chain cache, so
    the first same-shape request lands as a cache HIT.
+6. **Flow** (the causal-join end-to-end pin): ``inspect flow`` over
+   leg 1's client stamp journal + serve journal + flight-recorder trace
+   — every client wall joins and decomposes with a NAMED verdict (zero
+   LOST, zero stream-disagreement problems), the FLOW artifact passes
+   ``validate_flow`` and ``--replay``s to REPRODUCED, and the warm
+   overhead ledger lands under the named bound (the round component is
+   real: overhead must not be the whole warm wall).
 
 Exit 0 only when all hold.
 """
@@ -94,6 +101,8 @@ def leg_warm_cold(tmp: str) -> int:
          "--requests", "32", "--burst", "4", "--gap-ms", "2500",
          "--max-batch", "4", "--batch-window-ms", "50", "--verify",
          "--journal", os.path.join(tmp, "serve.journal.jsonl"),
+         "--client-journal", os.path.join(tmp, "client.journal.jsonl"),
+         "--server-trace", os.path.join(tmp, "flow"),
          "--out", out_path],
         cwd=REPO, capture_output=True, text=True, env=cpu_env())
     if r.returncode != 0:
@@ -162,6 +171,9 @@ def leg_warm_cold(tmp: str) -> int:
     if len(blob.get("samples") or []) < 3:
         return fail(f"artifact carries {len(blob.get('samples') or [])} "
                     f"samples; >= 3 required for the trend gate")
+    if summary.get("client_journal") != "client.journal.jsonl":
+        return fail(f"summary does not record the client stamp journal "
+                    f"by basename: {summary.get('client_journal')!r}")
 
     print(f"serve-smoke: warm/cold leg PASS — 32/32 verified, "
           f"{cache['compiles']} compiles, {cache['hits']} warm hits, "
@@ -250,6 +262,79 @@ def leg_workload(tmp: str) -> int:
     print(f"serve-smoke: workload leg PASS — 32 requests attributed "
           f"float-exact, artifact valid + REPRODUCED, 6-request "
           f"re-injection byte-identical", file=sys.stderr)
+    return 0
+
+
+def leg_flow(tmp: str) -> int:
+    """The causal-join end-to-end pin, over the warm/cold leg's three
+    streams: ``inspect flow`` joins every client wall to its server
+    phases and dispatch rounds, the FLOW artifact validates + replays
+    to REPRODUCED, and the warm overhead ledger stays under the named
+    bound."""
+    client = os.path.join(tmp, "client.journal.jsonl")
+    journal = os.path.join(tmp, "serve.journal.jsonl")
+    trace = os.path.join(tmp, "flow.trace.jsonl")
+    art = os.path.join(tmp, "FLOW_r01.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "flow",
+         client, journal, trace, "--seed", "0", "--json", art],
+        cwd=REPO, capture_output=True, text=True, env=cpu_env())
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-2000:])
+        return fail(f"inspect flow exited {r.returncode}:\n"
+                    f"{r.stdout[-2000:]}")
+    try:
+        with open(art) as fh:
+            blob = json.load(fh)
+    except (OSError, ValueError) as e:
+        return fail(f"flow artifact unreadable: {e}")
+
+    # -- every client request joins, nothing LOST, streams agree -----------
+    req = blob.get("requests") or {}
+    if req.get("client") != 32 or req.get("joined") != 32:
+        return fail(f"flow joined {req.get('joined')}/"
+                    f"{req.get('client')} client requests, expected "
+                    f"32/32 from the warm/cold leg")
+    if req.get("lost"):
+        return fail(f"flow named LOST requests in a clean run: "
+                    f"{req['lost']}")
+    if blob.get("problems"):
+        return fail("flow recorded stream disagreements in a clean "
+                    "run:\n  " + "\n  ".join(blob["problems"]))
+    for row in blob.get("per_request") or []:
+        if not row.get("verdict"):
+            return fail(f"request {row.get('rid')} joined without a "
+                        f"named dominant-component verdict")
+        if row.get("run") is None:
+            return fail(f"request {row.get('rid')} never joined a "
+                        f"dispatch run — the cid chain broke")
+
+    # -- warm overhead ledger present and under the named bound ------------
+    wo = blob.get("warm_overhead")
+    if not wo or wo.get("n", 0) < 1:
+        return fail(f"no warm requests in the overhead ledger ({wo}) — "
+                    f"the warm/cold leg's re-hit bursts must land warm")
+    if not (isinstance(wo.get("mean"), float) and 0.0 <= wo["mean"] < 1.0):
+        return fail(f"warm overhead fraction {wo.get('mean')!r} outside "
+                    f"[0, 1) — the joined round walls must account for "
+                    f"a real share of the warm dispatch wall")
+
+    # -- the artifact validates and replays like committed history ---------
+    from tpu_aggcomm.obs.regress import validate_flow
+    errors = validate_flow(blob, os.path.basename(art))
+    if errors:
+        return fail("artifact failed validate_flow:\n  "
+                    + "\n  ".join(errors))
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "flow",
+         "--replay", art],
+        cwd=REPO, capture_output=True, text=True, env=cpu_env())
+    if r.returncode != 0 or "REPRODUCED" not in r.stdout:
+        return fail(f"flow replay not REPRODUCED (rc {r.returncode}):"
+                    f"\n{r.stdout[-2000:]}")
+    print(f"serve-smoke: flow leg PASS — 32/32 joined with named "
+          f"verdicts, warm overhead {wo['mean']:.1%} (n={wo['n']}), "
+          f"artifact valid + REPRODUCED", file=sys.stderr)
     return 0
 
 
@@ -387,11 +472,14 @@ def main() -> int:
     rc = leg_workload(tmp)
     if rc:
         return rc
+    rc = leg_flow(tmp)
+    if rc:
+        return rc
     rc = leg_overload_drain_recover(tmp)
     if rc:
         return rc
-    print("serve-smoke: PASS — warm/cold, workload, overload, drain "
-          "and recover legs all hold", file=sys.stderr)
+    print("serve-smoke: PASS — warm/cold, workload, flow, overload, "
+          "drain and recover legs all hold", file=sys.stderr)
     return 0
 
 

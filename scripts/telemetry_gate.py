@@ -33,6 +33,13 @@ Three checks over COMMITTED artifacts only (no backend, no sweep):
    demand the parsed burn-rate / compliance / anomaly-count values
    equal the artifact's own evaluation block float-for-float (the
    check-4 batching-gauge precedent).
+6. **Flow overhead gauges vs the committed artifact** — fold every
+   committed ``FLOW_r*.json`` through ``obs.flow.flow_registry`` (the
+   warm-overhead-fraction / warm-component-fraction / verdict-count
+   gauges), render through a fresh ``MetricsRegistry`` and demand the
+   parsed values equal the artifact's own warm-overhead ledger and
+   verdict counts float-for-float — the /metrics numbers ARE the
+   ``inspect flow`` numbers, never a reimplementation.
 
 Usage: ``python scripts/telemetry_gate.py [root]`` (default repo root).
 Prints one line per check; exits nonzero on any failure.
@@ -272,6 +279,73 @@ def check_watch_gauges(root: str) -> int:
     return bad
 
 
+def check_flow_gauges(root: str) -> int:
+    """Gauge parity: the flow joiner's /metrics fold vs the artifact.
+
+    ``flow_registry`` sets the warm-overhead mean, the per-component
+    warm mean fractions and the per-verdict request counts from the
+    artifact VERBATIM — rendering and re-parsing must land exactly on
+    those numbers (``==`` on floats, the check-2 discipline)."""
+    from tpu_aggcomm.obs.flow import flow_registry
+    from tpu_aggcomm.obs.history import load_history
+    errors: list[str] = []
+    hist = load_history(root, "FLOW", errors=errors)
+    bad = 0
+    for e in errors:
+        print(f"FAIL flow: {e}")
+        bad += 1
+    if not hist:
+        print("ok   flow gauges: no committed FLOW_r*.json — "
+              "check inactive")
+        return bad
+    for _rnd, path, blob in hist:
+        name = os.path.basename(path)
+        reg = export.MetricsRegistry()
+        flow_registry(blob, reg)
+        text = reg.render()
+        errs = validate_openmetrics(text)
+        if errs:
+            for e in errs:
+                print(f"FAIL {name}: openmetrics: {e}")
+            bad += len(errs)
+            continue
+        samples = _sample_map(parse_openmetrics(text))
+        n_checked = 0
+        wo = blob.get("warm_overhead")
+        if wo is not None:
+            got = samples.get(("tpu_aggcomm_flow_warm_overhead_fraction",
+                               ()))
+            if got != wo.get("mean"):
+                print(f"FAIL {name}: warm-overhead gauge renders "
+                      f"{got!r} but the artifact's ledger says "
+                      f"{wo.get('mean')!r}")
+                bad += 1
+            n_checked += 1
+        for comp, block in (blob.get("warm_components") or {}).items():
+            got = samples.get(
+                ("tpu_aggcomm_flow_warm_component_fraction",
+                 tuple(sorted({"component": comp}.items()))))
+            if got != block.get("mean_fraction"):
+                print(f"FAIL {name}: component gauge [{comp}] renders "
+                      f"{got!r} but the artifact says "
+                      f"{block.get('mean_fraction')!r}")
+                bad += 1
+            n_checked += 1
+        for verdict, n in (blob.get("verdicts") or {}).items():
+            got = samples.get(
+                ("tpu_aggcomm_flow_requests",
+                 tuple(sorted({"verdict": verdict}.items()))))
+            if got != float(n):
+                print(f"FAIL {name}: verdict gauge [{verdict}] renders "
+                      f"{got!r} but the artifact counts {float(n)!r}")
+                bad += 1
+            n_checked += 1
+        if not bad:
+            print(f"ok   {name}: flow gauges float-exact vs artifact "
+                  f"({n_checked} gauge(s))")
+    return bad
+
+
 def main(root: str) -> int:
     traces = sorted(glob.glob(os.path.join(root, "*.trace.jsonl")))
     if not traces:
@@ -283,6 +357,7 @@ def main(root: str) -> int:
     n_bad += check_trend_consistency(root)
     n_bad += check_workload_gauges(root)
     n_bad += check_watch_gauges(root)
+    n_bad += check_flow_gauges(root)
     print(f"{len(traces)} trace(s) checked, {n_bad} failure(s)")
     return 1 if n_bad else 0
 

@@ -1,0 +1,597 @@
+"""Causal flow-tracing tests (obs/flow.py — ISSUE 20).
+
+The pins that define the subsystem:
+
+- **One decomposition arithmetic**: ``client_wall_s = t_recv -
+  t_send``, ``server_wall_s`` = the workload profiler's canonical
+  phase sum, ``wire_s = client_wall_s - server_wall_s``, ``residual_s
+  = dispatch - joined run wall`` — each defined by ONE expression in
+  obs/flow.py, re-run verbatim by ``validate_flow`` over a committed
+  artifact's own rows (identical-computation float-exactness, never
+  algebraic re-summation).
+- **Named verdicts**: every joined request carries a dominant
+  component from ``COMPONENT_ORDER`` mapped through ``VERDICTS`` —
+  a bare number is a regression; ties break to the earlier component.
+- **Crash honesty**: a SIGKILL-torn client journal loses at most one
+  line; a send with no recv is named LOST in flight, torn lines are
+  COUNTED into the integrity block, and the serve.request trace
+  instants (which carry cid) stand in when the serve journal is torn.
+- **Seeded determinism**: the warm-overhead bootstrap CI follows the
+  regression-gate seed discipline — same streams + same seed ⟹ the
+  same artifact body byte-for-byte.
+- **Artifacts are self-proving**: ``FLOW_r*.json`` validates, replays
+  REPRODUCED from the stream basenames recorded inside it, and every
+  doctored number is named, not absorbed.
+- **jax-free**: obs/flow.py and ``cli inspect flow`` run where
+  ``import jax`` raises (poisoned-jax subprocess, the obs discipline).
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import _jaxfree
+
+REPO = _jaxfree.REPO
+
+from tpu_aggcomm.obs.flow import (COMPONENT_ORDER, VERDICTS,
+                                  decompose_request, dominant_component,
+                                  flow_registry, flow_streams, render_flow,
+                                  replay_flow, tail_client,
+                                  warm_overhead_block, write_flow)
+from tpu_aggcomm.obs.regress import validate_flow
+from tpu_aggcomm.obs.workload import BOUNDARIES, attribute_phases
+from tpu_aggcomm.resilience.journal import RunJournal
+
+_SHAPE = {"method": 3, "nprocs": 8, "cb_nodes": 2, "comm_size": 2,
+          "data_size": 64}
+
+
+# ---------------------------------------------------------------------------
+# Synthetic streams: the loadgen client journal, the serve journal with
+# cid-stamped terminal records, and a cid-stamped trace.
+
+
+def _stamps(*, queue=0.001, batch=0.0005, cache=0.0002, dispatch=0.010,
+            respond=0.0003):
+    """Cumulative boundary stamps (the serve journal's ``phases``
+    payload) with the given per-phase durations."""
+    s = {"admit": 0.0}
+    s["queue"] = s["admit"] + queue
+    s["batch"] = s["queue"] + batch
+    s["cache"] = s["batch"] + cache
+    s["dispatch"] = s["cache"] + dispatch
+    s["respond"] = s["dispatch"] + respond
+    return s
+
+
+def _write_client(path, rows, *, torn_tail=False):
+    """``rows``: {"i", "wall_s", optional "lost"/"rid"} — the
+    serve_loadgen --client-journal line shapes, stamps computed with
+    the loadgen's own expression so the stream agrees with itself."""
+    with open(path, "w") as fh:
+        for row in rows:
+            i = row["i"]
+            t0 = 100.0 + 0.5 * i
+            fh.write(json.dumps({"ev": "send", "i": i, "t_send": t0,
+                                 "shape": "m3 n8 a2 c2 d64"}) + "\n")
+            if row.get("lost"):
+                continue
+            t1 = t0 + row["wall_s"]
+            fh.write(json.dumps(
+                {"ev": "recv", "i": i, "rid": row.get("rid", i),
+                 "t_send": t0, "t_recv": t1, "client_wall_s": t1 - t0,
+                 "ok": True, "shed": None,
+                 "cache": row.get("cache", "hit"),
+                 "error": None}) + "\n")
+        if torn_tail:
+            fh.write('{"ev": "recv", "i": 99, "t_se')
+    return str(path)
+
+
+def _write_serve(path, rows, *, torn_tail=False):
+    """``rows``: {"rid", "stamps", "cache", "cid", optional "status"} —
+    the server's admitted + terminal journal records (serve/server.py
+    shapes, cid riding in the terminal record)."""
+    j = RunJournal(str(path))
+    fp = j.begin_session({"jax": "0.0-test"})
+    for row in rows:
+        rid = row["rid"]
+        j.record({"request": rid}, fingerprint=fp, status="admitted",
+                 shape=dict(_SHAPE), backend="jax_sim", iter=rid,
+                 t_unix=1_700_000_000.0 + rid, queue_depth=0)
+        if row.get("status", "done") == "admitted-only":
+            continue
+        stamps = row["stamps"]
+        j.record({"request": rid}, fingerprint=fp,
+                 status=row.get("status", "done"),
+                 latency_s=stamps.get("respond"), batch_n=1,
+                 cache=row.get("cache", "hit"), phases=dict(stamps),
+                 batch_seq=row.get("seq", 0), batch_padded=1,
+                 cid=row.get("cid"), queue_depth=None)
+    if torn_tail:
+        with open(path, "a") as fh:
+            fh.write('{"key": {"request": 500}, "status": "don')
+    return str(path)
+
+
+def _write_trace(path, runs, *, with_instants=(), torn_tail=False):
+    """``runs``: {"id", "cid", "total", "rounds": [wall...]} — one
+    cid-stamped run event per dispatch plus its attribution cells (two
+    ranks per round so round_stats' wall lands exactly on the given
+    values), optionally serve.request instants (the torn-journal
+    stand-in)."""
+    events = []
+    for r in runs:
+        events.append({"ev": "run", "id": r["id"], "method": 3,
+                       "name": "theta", "backend": "jax_sim",
+                       "nprocs": 8, "data_size": 64, "ntimes": 1,
+                       "combine": "sum", "cid": r["cid"]})
+        events.append({"ev": "span", "run": r["id"], "rep": 0,
+                       "rank": 0, "round": -1, "bucket": "total",
+                       "dur_s": r["total"], "src": "measured",
+                       "ts": 0.0, "dur": r["total"] * 1e6})
+        for rnd, wall in enumerate(r["rounds"]):
+            for rank in (0, 1):
+                events.append({"ev": "span", "run": r["id"], "rep": 0,
+                               "rank": rank, "round": rnd,
+                               "bucket": "recv_wait",
+                               "dur_s": wall if rank == 0
+                               else wall * 0.5,
+                               "src": "measured",
+                               "ts": 1e3 * rnd, "dur": wall * 1e6})
+    for inst in with_instants:
+        events.append({"ev": "instant", "name": "serve.request",
+                       "ts": 0.0, "args": inst})
+    with open(path, "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+        if torn_tail:
+            fh.write('{"ev": "span", "run')
+    return str(path)
+
+
+def _streams(tmp_path, *, n=4, walls=None, torn=False):
+    """One coherent three-stream set: n requests, one batch (cid b0)
+    with a traced run, per-request walls large enough for positive
+    wire."""
+    walls = walls or [0.020 + 0.001 * i for i in range(n)]
+    client = _write_client(
+        tmp_path / "client.journal.jsonl",
+        [{"i": i, "wall_s": walls[i]} for i in range(n)],
+        torn_tail=torn)
+    serve = _write_serve(
+        tmp_path / "serve.journal.jsonl",
+        [{"rid": i, "stamps": _stamps(), "cid": "b0"} for i in range(n)],
+        torn_tail=torn)
+    trace = _write_trace(
+        tmp_path / "flow.trace.jsonl",
+        [{"id": 1, "cid": "b0", "total": 0.004,
+          "rounds": [0.002, 0.0015]}],
+        torn_tail=torn)
+    return client, serve, trace
+
+
+# ---------------------------------------------------------------------------
+# The decomposition arithmetic (identical-computation float-exactness).
+
+
+def test_decompose_request_is_the_one_arithmetic():
+    stamps = _stamps()
+    client = {"t_send": 100.0, "t_recv": 100.0 + 0.02}
+    server = {"phases": stamps}
+    run = {"wall_s": 0.004}
+    dec = decompose_request(client, server, run)
+    # every derived number re-computes with the identical expression
+    assert dec["client_wall_s"] == client["t_recv"] - client["t_send"]
+    phases, _ = attribute_phases(stamps)
+    want_server = sum(phases[b] for b in BOUNDARIES if b in phases)
+    assert dec["server_wall_s"] == want_server
+    assert dec["wire_s"] == dec["client_wall_s"] - want_server
+    assert dec["components"]["round"] == 0.004
+    assert dec["residual_s"] == phases["dispatch"] - 0.004
+    assert dec["components"]["overhead"] == dec["residual_s"]
+    for k, v in dec["components"].items():
+        assert dec["fractions"][k] == v / dec["client_wall_s"]
+    assert dec["dominant"] in COMPONENT_ORDER
+    assert dec["verdict"] == VERDICTS[dec["dominant"]]
+    assert dec["problems"] == []
+
+
+def test_decompose_without_run_keeps_dispatch_unsplit():
+    dec = decompose_request({"t_send": 0.0, "t_recv": 0.05},
+                            {"phases": _stamps()}, None)
+    phases, _ = attribute_phases(_stamps())
+    # no joined run: the whole dispatch phase is the round component
+    # and the overhead inside it is NOT quantifiable — never zeroed
+    assert dec["components"]["round"] == phases["dispatch"]
+    assert dec["residual_s"] is None
+    assert "overhead" not in dec["components"]
+
+
+def test_dominant_tie_breaks_to_earlier_component():
+    assert dominant_component({"wire": 1.0, "round": 1.0}) == "wire"
+    assert dominant_component({"round": 1.0, "overhead": 1.0}) == "round"
+    assert dominant_component({}) is None
+
+
+def test_stream_disagreement_is_a_named_problem():
+    # client wall smaller than the server phase sum: wire < 0
+    dec = decompose_request({"t_send": 0.0, "t_recv": 0.001},
+                            {"phases": _stamps()}, None)
+    assert dec["wire_s"] < 0
+    assert any("disagree" in p for p in dec["problems"])
+    # journal dispatch smaller than the joined run wall: residual < 0
+    dec = decompose_request({"t_send": 0.0, "t_recv": 0.05},
+                            {"phases": _stamps(dispatch=0.001)},
+                            {"wall_s": 0.004})
+    assert dec["residual_s"] < 0
+    assert any("residual" in p for p in dec["problems"])
+
+
+# ---------------------------------------------------------------------------
+# The joiner over the three streams.
+
+
+def test_flow_streams_joins_end_to_end(tmp_path):
+    client, serve, trace = _streams(tmp_path)
+    body = flow_streams(client, serve, [trace], seed=0)
+    assert body["requests"]["client"] == 4
+    assert body["requests"]["joined"] == 4
+    assert body["requests"]["lost"] == []
+    assert body["problems"] == []
+    for row in body["per_request"]:
+        assert row["server_source"] == "journal"
+        assert row["cid"] == "b0"
+        assert row["run"]["run_id"] == 1
+        assert row["run"]["rounds_total_s"] == sum(
+            r["wall_s"] for r in row["run"]["rounds"])
+        assert row["verdict"] in VERDICTS.values()
+    assert sum(body["verdicts"].values()) == 4
+    # the render answers "where do the warm ms go" with named parts
+    text = render_flow(body)
+    assert "warm overhead ledger" in text and "rounds (" in text
+
+
+def test_warm_overhead_ledger_arithmetic(tmp_path):
+    client, serve, trace = _streams(tmp_path)
+    body = flow_streams(client, serve, [trace], seed=0)
+    wo = body["warm_overhead"]
+    assert wo["n"] == 4 and len(wo["fractions"]) == 4
+    by_rid = {r["rid"]: r for r in body["per_request"]}
+    for rid, frac in zip(wo["rids"], wo["fractions"]):
+        r = by_rid[rid]
+        w = r["client_wall_s"]
+        assert frac == (w - r["components"]["round"]) / w
+    assert wo["mean"] == sum(wo["fractions"]) / len(wo["fractions"])
+    assert len(wo["ci95"]) == 2 and wo["ci95"][0] <= wo["ci95"][1]
+    # cold/failed requests never enter the warm ledger
+    assert warm_overhead_block(
+        [{"status": "done", "cache": "miss", "rid": 0,
+          "client_wall_s": 1.0, "components": {"round": 0.5}}],
+        seed=0) is None
+
+
+def test_lost_request_named_and_torn_lines_counted(tmp_path):
+    client = _write_client(
+        tmp_path / "client.journal.jsonl",
+        [{"i": 0, "wall_s": 0.02}, {"i": 1, "lost": True}],
+        torn_tail=True)   # the SIGKILL mid-line tail
+    serve = _write_serve(tmp_path / "serve.journal.jsonl",
+                         [{"rid": 0, "stamps": _stamps(), "cid": "b0"}],
+                         torn_tail=True)
+    trace = _write_trace(tmp_path / "flow.trace.jsonl",
+                         [{"id": 1, "cid": "b0", "total": 0.004,
+                           "rounds": [0.002]}], torn_tail=True)
+    tail = tail_client(client)
+    assert tail["skipped_lines"] == 1   # exactly the torn line
+    body = flow_streams(client, serve, [trace], seed=0)
+    assert body["requests"]["lost"] == [1]
+    assert any("LOST in flight" in p for p in body["problems"])
+    assert body["integrity"]["client_torn_lines"] == 1
+    assert body["integrity"]["journal_torn_lines"] == 1
+    assert body["integrity"]["trace_torn_lines"] == 1
+    assert "LOST" in render_flow(body)
+
+
+def test_trace_instants_stand_in_for_torn_journal(tmp_path):
+    # the serve journal never terminated rid 0 (torn tail) but the
+    # serve.request instant carries rid + phases + cache + cid — the
+    # joiner must still decompose, marked server_source == "trace"
+    client = _write_client(tmp_path / "client.journal.jsonl",
+                           [{"i": 0, "wall_s": 0.02}])
+    serve = _write_serve(
+        tmp_path / "serve.journal.jsonl",
+        [{"rid": 0, "stamps": _stamps(), "status": "admitted-only"}])
+    trace = _write_trace(
+        tmp_path / "flow.trace.jsonl",
+        [{"id": 1, "cid": "b0", "total": 0.004, "rounds": [0.002]}],
+        with_instants=[{"rid": 0, "ok": True, "cache": "hit",
+                        "cid": "b0", "phases": _stamps()}])
+    body = flow_streams(client, serve, [trace], seed=0)
+    [row] = body["per_request"]
+    assert row["server_source"] == "trace"
+    assert row["run"]["run_id"] == 1   # the cid join still lands
+    assert row["verdict"] in VERDICTS.values()
+    assert body["problems"] == []
+
+
+def test_client_journal_disagreeing_with_itself_is_named(tmp_path):
+    client = tmp_path / "client.journal.jsonl"
+    with open(client, "w") as fh:
+        fh.write(json.dumps({"ev": "send", "i": 0,
+                             "t_send": 100.0}) + "\n")
+        fh.write(json.dumps({"ev": "recv", "i": 0, "rid": 0,
+                             "t_send": 100.0, "t_recv": 100.02,
+                             "client_wall_s": 0.5}) + "\n")
+    serve = _write_serve(tmp_path / "serve.journal.jsonl",
+                         [{"rid": 0, "stamps": _stamps(), "cid": "b0"}])
+    body = flow_streams(str(client), serve, [], seed=0)
+    assert any("disagrees with itself" in p for p in body["problems"])
+
+
+# ---------------------------------------------------------------------------
+# Seeded determinism + the artifact contract.
+
+
+def test_flow_streams_seeded_and_deterministic(tmp_path):
+    client, serve, trace = _streams(tmp_path)
+    a = flow_streams(client, serve, [trace], seed=7)
+    b = flow_streams(client, serve, [trace], seed=7)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    c = flow_streams(client, serve, [trace], seed=8)
+    assert c["seed"] == 8 and c["warm_overhead"]["seed"] == 8
+    # everything but the seeded CI + recorded seed is seed-independent
+    # (with n=4 fractions two seeds may land on the same percentile
+    # bounds, so the CI itself is not asserted to differ)
+    for blob in (a, c):
+        blob["warm_overhead"].pop("ci95")
+        blob["warm_overhead"].pop("seed")
+        blob.pop("seed")
+    assert json.dumps(a, sort_keys=True) == json.dumps(c, sort_keys=True)
+
+
+def test_artifact_validates_replays_and_names_corruption(tmp_path):
+    client, serve, trace = _streams(tmp_path)
+    body = flow_streams(client, serve, [trace], seed=0)
+    art = tmp_path / "FLOW_r01.json"
+    blob = write_flow(str(art), body)
+    assert blob["schema"] == "flow-v1"
+    assert validate_flow(blob, "FLOW_r01.json") == []
+    rep = replay_flow(str(art))
+    assert rep["verdict"] == "REPRODUCED", rep["problems"]
+
+    # a doctored derived number is named by the validator, not absorbed
+    bad = json.loads(json.dumps(blob))
+    bad["per_request"][0]["wire_s"] += 1e-9
+    errs = validate_flow(bad, "FLOW_bad.json")
+    assert errs and any("wire_s" in e for e in errs)
+
+    bad = json.loads(json.dumps(blob))
+    bad["warm_overhead"]["mean"] += 1e-12
+    errs = validate_flow(bad, "FLOW_bad.json")
+    assert errs and any("warm_overhead" in e for e in errs)
+
+    # a doctored artifact MISMATCHes on replay, the key named
+    doctored = json.loads(json.dumps(blob))
+    doctored["verdicts"] = {"wire-bound": 99}
+    art2 = tmp_path / "FLOW_r02.json"
+    with open(art2, "w") as fh:
+        json.dump(doctored, fh)
+    rep = replay_flow(str(art2))
+    assert rep["verdict"] == "MISMATCH"
+    assert any("verdicts" in p for p in rep["problems"])
+
+    # a shrunk stream is a named MISMATCH too, never a silent pass
+    os.unlink(trace)
+    rep = replay_flow(str(art))
+    assert rep["verdict"] == "MISMATCH"
+    assert any("not found" in p for p in rep["problems"])
+
+
+def test_validator_refuses_disagreeing_streams(tmp_path):
+    client, serve, trace = _streams(tmp_path)
+    body = flow_streams(client, serve, [trace], seed=0)
+    blob = dict(body, schema="flow-v1", manifest={}, created_unix=0.0,
+                problems=["request rid=0: the streams disagree"])
+    errs = validate_flow(blob, "FLOW_bad.json")
+    assert errs and any("disagree" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# /metrics gauges + history discovery.
+
+
+def test_flow_registry_folds_artifact_numbers_verbatim(tmp_path):
+    from tpu_aggcomm.obs import export
+    from tpu_aggcomm.obs.regress import parse_openmetrics
+    client, serve, trace = _streams(tmp_path)
+    blob = write_flow(str(tmp_path / "FLOW_r01.json"),
+                      flow_streams(client, serve, [trace], seed=0))
+    reg = export.MetricsRegistry()
+    flow_registry(blob, reg)
+    samples = {(s["name"], tuple(sorted(s["labels"].items()))): s["value"]
+               for s in parse_openmetrics(reg.render())["samples"]}
+    assert samples[("tpu_aggcomm_flow_warm_overhead_fraction", ())] \
+        == blob["warm_overhead"]["mean"]
+    for comp, st in blob["warm_components"].items():
+        assert samples[("tpu_aggcomm_flow_warm_component_fraction",
+                        (("component", comp),))] == st["mean_fraction"]
+    for verdict, n in blob["verdicts"].items():
+        assert samples[("tpu_aggcomm_flow_requests",
+                        (("verdict", verdict),))] == float(n)
+
+
+def test_history_discovers_flow_series(tmp_path):
+    from tpu_aggcomm.obs.history import build_index, check_trends
+    for rnd in (1, 2):
+        client, serve, trace = _streams(tmp_path)
+        write_flow(str(tmp_path / f"FLOW_r{rnd:02d}.json"),
+                   flow_streams(client, serve, [trace], seed=0))
+    idx = build_index(str(tmp_path))
+    assert [f["file"] for f in idx["flow"]] == ["FLOW_r01.json",
+                                               "FLOW_r02.json"]
+    key = "flow warm overhead fraction"
+    from tpu_aggcomm.obs.history import flow_series
+    pts = flow_series(str(tmp_path))[key]
+    assert [p["round"] for p in pts] == [1, 2]
+    assert all(p["unit"] == "frac" for p in pts)
+    gates = check_trends(str(tmp_path))
+    assert key in gates["series"] and "verdict" in gates["series"][key]
+    assert gates["ok"]
+
+
+# ---------------------------------------------------------------------------
+# The watchtower's flow evidence stream (satellite 3).
+
+
+def test_watch_attributes_dominant_shift_from_flow():
+    from tpu_aggcomm.obs.watch import EVIDENCE_STREAMS, attribute_anomaly
+    assert "flow" in EVIDENCE_STREAMS
+    rows = [{"rid": i, "wall_s": 0.01 if i < 4 else 0.03, "status": "done"}
+            for i in range(8)]
+    detection = {"at_index": 4, "direction": "up", "delta_rel": 2.0}
+    doms = ([{"rid": i, "verdict": "round-bound"} for i in range(4)]
+            + [{"rid": i, "verdict": "compile-bound"}
+               for i in range(4, 8)])
+    got = attribute_anomaly(
+        detection, rows=rows, split_rid=4,
+        evidence={"flow": {"artifact": "FLOW_r01.json",
+                           "dominants": doms}})
+    assert got["evidence"] == "flow"
+    assert got["cause"] == "dominant-shift:round-bound->compile-bound"
+    assert "FLOW_r01.json" in got["detail"]
+    # no shift -> the UNEXPLAINED fallback keeps its committed wording
+    same = [{"rid": i, "verdict": "round-bound"} for i in range(8)]
+    got = attribute_anomaly(
+        detection, rows=rows, split_rid=4,
+        evidence={"flow": {"artifact": "FLOW_r01.json",
+                           "dominants": same}})
+    assert got["cause"] == "UNEXPLAINED"
+    assert "no ledger/resilience/shed/explain evidence" in got["detail"]
+
+
+# ---------------------------------------------------------------------------
+# Perfetto: cid on request slices, flow links to the dispatch run.
+
+
+def test_perfetto_emits_cid_and_flow_links():
+    from tpu_aggcomm.obs.perfetto import RANKS_PID, SERVE_PID, \
+        to_chrome_trace
+    stamps = _stamps()
+    events = [
+        {"ev": "run", "id": 1, "method": 3, "name": "theta",
+         "backend": "jax_sim", "cid": "b0"},
+        {"ev": "span", "run": 1, "rep": 0, "rank": 0, "round": 0,
+         "bucket": "recv_wait", "dur_s": 0.002, "ts": 50.0,
+         "dur": 2000.0, "src": "measured"},
+        {"ev": "instant", "name": "serve.request", "ts": 10_000.0,
+         "args": {"rid": 0, "ok": True, "cache": "hit", "cid": "b0",
+                  "phases": stamps}},
+    ]
+    tr = to_chrome_trace(events)["traceEvents"]
+    serve_slices = [e for e in tr if e.get("cat") == "serve"]
+    assert serve_slices and all(
+        s["args"]["cid"] == "b0" for s in serve_slices)
+    flows = [e for e in tr if e.get("cat") == "flow"]
+    assert len(flows) == 2
+    start = next(e for e in flows if e["ph"] == "s")
+    finish = next(e for e in flows if e["ph"] == "f")
+    assert start["id"] == finish["id"]
+    assert start["pid"] == SERVE_PID and finish["pid"] == RANKS_PID
+    assert finish["bp"] == "e"
+    # the arrow departs from the dispatch slice's start
+    dispatch = next(s for s in serve_slices
+                    if s["args"]["phase"] == "dispatch")
+    assert start["ts"] == dispatch["ts"]
+    # no cid -> no dangling flow events
+    tr2 = to_chrome_trace([events[2]])["traceEvents"]
+    assert not [e for e in tr2 if e.get("cat") == "flow"]
+
+
+# ---------------------------------------------------------------------------
+# The jax-free pins (the obs discipline, subprocess-enforced).
+
+
+def test_flow_is_jaxfree(tmp_path):
+    client, serve, trace = _streams(tmp_path)
+    code = (
+        _jaxfree.pure_import_code("tpu_aggcomm.obs.flow") +
+        "; from tpu_aggcomm.obs.flow import flow_streams, write_flow, "
+        "replay_flow"
+        f"; b = flow_streams({client!r}, {serve!r}, [{trace!r}], seed=0)"
+        "; assert b['problems'] == [] and b['requests']['joined'] == 4"
+        f"; write_flow({str(tmp_path / 'FLOW_r01.json')!r}, b)"
+        f"; r = replay_flow({str(tmp_path / 'FLOW_r01.json')!r})"
+        "; assert r['verdict'] == 'REPRODUCED', r['problems']"
+        "; import sys; assert 'jax' not in sys.modules")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=str(tmp_path),
+        env=_jaxfree.poisoned_env(
+            tmp_path, "the flow joiner must answer where a wedged "
+                      "tunnel hangs import jax"),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_cli_inspect_flow_is_jaxfree(tmp_path):
+    client, serve, trace = _streams(tmp_path)
+    env = _jaxfree.poisoned_env(
+        tmp_path, "inspect flow must answer on a wedged tunnel")
+    art = tmp_path / "FLOW_r01.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "flow",
+         client, serve, trace, "--seed", "0", "--json", str(art)],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "flow trace over" in proc.stdout
+    assert "warm overhead ledger" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "flow",
+         "--replay", str(art)],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "REPRODUCED" in proc.stdout
+
+
+def test_cli_refuses_artifact_over_disagreeing_streams(tmp_path):
+    # negative wire: the CLI must print the problem and refuse --json
+    client = _write_client(tmp_path / "client.journal.jsonl",
+                           [{"i": 0, "wall_s": 0.001}])
+    serve = _write_serve(tmp_path / "serve.journal.jsonl",
+                         [{"rid": 0, "stamps": _stamps(), "cid": "b0"}])
+    art = tmp_path / "FLOW_r01.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_aggcomm.cli", "inspect", "flow",
+         client, serve, "--json", str(art)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert "PROBLEM" in proc.stdout
+    assert not art.exists()
+
+
+# ---------------------------------------------------------------------------
+# The committed exemplar (the ci_tier1.sh gate's subject).
+
+
+def test_committed_exemplar_artifact_accepts():
+    paths = sorted(glob.glob(os.path.join(REPO, "FLOW_r*.json")))
+    assert paths, "no committed FLOW_r*.json exemplar at the repo root"
+    for path in paths:
+        with open(path) as fh:
+            blob = json.load(fh)
+        name = os.path.basename(path)
+        assert validate_flow(blob, name) == [], name
+        rep = replay_flow(path)
+        assert rep["verdict"] == "REPRODUCED", (name, rep["problems"])
+        # the exemplar answers the headline question: named verdicts
+        # and a warm overhead ledger with a seeded CI
+        assert blob["verdicts"]
+        wo = blob["warm_overhead"]
+        assert wo and wo["n"] >= 1 and wo["ci95"] is not None

@@ -81,7 +81,8 @@ _JAX_ROOTS = ("jax", "jaxlib")
 _ARTIFACT_GLOBS = ("BENCH_r*.json", "MULTICHIP_r*.json", "TUNE_*.json",
                    "TRAFFIC_*.json", "PREDICT_*.json", "COMPARE_*.json",
                    "SERVE_r*.json", "SYNTH_r*.json", "WORKLOAD_r*.json",
-                   "WATCH_r*.json", "PILOT_r*.json", "*.trace.json",
+                   "WATCH_r*.json", "PILOT_r*.json", "FLOW_r*.json",
+                   "*.trace.json",
                    "*.trace.jsonl", "BASELINE.json", "*.journal.jsonl")
 
 _IPV4 = re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b")

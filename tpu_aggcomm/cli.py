@@ -536,7 +536,7 @@ def build_parser() -> argparse.ArgumentParser:
                                                  "traffic", "check",
                                                  "live", "history",
                                                  "explain", "workload",
-                                                 "watch"],
+                                                 "watch", "flow"],
                      default=None,
                      help="'trace' to summarize *.trace.jsonl files, "
                           "'compare' to diff two of them, 'report' for "
@@ -570,7 +570,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "serve journal, seeded changepoint anomalies "
                           "over request + round walls, NAMED root-cause "
                           "verdicts joined from ledger/resilience/shed/"
-                          "explain evidence")
+                          "explain evidence, 'flow' for the end-to-end "
+                          "causal joiner (obs/flow.py, jax-free): "
+                          "CLIENT.journal SERVE.journal [TRACE...] — "
+                          "client walls decomposed as wire + server "
+                          "phases + device rounds + quantified residual "
+                          "with NAMED dominant-component verdicts and "
+                          "the warm overhead ledger")
     ins.add_argument("trace_file", nargs="*", default=[],
                      help="trace files: one or more to summarize "
                           "('trace'), exactly two files or directories to "
@@ -647,7 +653,8 @@ def build_parser() -> argparse.ArgumentParser:
                           "(PREDICT_*.json); 'compare': write the "
                           "machine-readable compare-v1 delta; "
                           "'workload': write the workload-v1 profile "
-                          "(WORKLOAD_*.json)")
+                          "(WORKLOAD_*.json); 'flow': write the flow-v1 "
+                          "decomposition (FLOW_*.json)")
     ins.add_argument("--replay", metavar="ARTIFACT_JSON", default=None,
                      help="'explain': re-derive the committed "
                           "predict-v1 artifact from its recorded inputs "
@@ -661,16 +668,28 @@ def build_parser() -> argparse.ArgumentParser:
                           "exemplar); 'watch': re-derive WATCH_r*.json "
                           "from the streams + embedded SLO spec + seed "
                           "recorded inside it (same contract; "
-                          "ci_tier1.sh gates the committed exemplar)")
+                          "ci_tier1.sh gates the committed exemplar); "
+                          "'flow': re-derive FLOW_r*.json from the "
+                          "client journal + serve journal + trace "
+                          "basenames recorded inside it (same contract; "
+                          "ci_tier1.sh gates every committed artifact)")
     ins.add_argument("--seed", type=int, default=0,
-                     help="'workload'/'watch': seed recorded in the "
-                          "artifact and used by the advisory detector / "
-                          "changepoint bootstrap (default: 0)")
+                     help="'workload'/'watch'/'flow': seed recorded in "
+                          "the artifact and used by the advisory "
+                          "detector / changepoint / warm-overhead "
+                          "bootstrap (default: 0)")
     ins.add_argument("--slo", metavar="FILE", default=None,
                      help="'watch' only: slo-v1 spec file (objectives + "
                           "windows); default: the built-in lenient spec "
                           "(obs/slo.DEFAULT_SLO), embedded verbatim in "
                           "the artifact either way")
+    ins.add_argument("--flow", metavar="FLOW_rNN.json", default=None,
+                     help="'watch' only: join this committed flow "
+                          "artifact's per-request dominant verdicts as "
+                          "the 'flow' evidence stream — a request-wall "
+                          "step coinciding with a dominant-component "
+                          "shift (e.g. round-bound -> compile-bound) "
+                          "attributes by name instead of UNEXPLAINED")
     ins.add_argument("--results-csv", default="results.csv",
                      help="'live' only: the running sweep's results CSV "
                           "— its crash-safe journal "
@@ -2126,9 +2145,12 @@ def _run_inspect_watch(args) -> int:
     def one_pass():
         try:
             return watch_streams(journals, traces, slo=slo,
-                                 slo_source=slo_source, seed=args.seed)
+                                 slo_source=slo_source, seed=args.seed,
+                                 flow_path=args.flow)
         except OSError as e:
             raise SystemExit(f"inspect watch: unreadable stream: {e}")
+        except ValueError as e:
+            raise SystemExit(f"inspect watch: {e}")
 
     body = one_pass()
     print(render_watch(body), end="")
@@ -2150,6 +2172,61 @@ def _run_inspect_watch(args) -> int:
     if args.json:
         write_watch(args.json, body)
         print(f"watch artifact written: {args.json}")
+    return 0
+
+
+def _run_inspect_flow(args) -> int:
+    """The end-to-end causal flow joiner (obs/flow.py, jax-free).
+
+    Two modes: ``--replay FLOW_r*.json`` re-derives a committed
+    artifact from the client journal + serve journal + trace basenames
+    recorded inside it (REPRODUCED or MISMATCH with the diverging keys
+    named — the ci_tier1.sh gate); ``flow CLIENT.journal SERVE.journal
+    [TRACE...]`` runs one join+decompose pass (``--json PATH`` writes
+    the flow-v1 artifact, refused while the streams disagree with each
+    other). Positional order is CLIENT then SERVE; *.trace.jsonl files
+    may appear anywhere (split by suffix). Exit 1 on any join problem —
+    streams that contradict each other must fail loudly, never average
+    the contradiction away."""
+    from tpu_aggcomm.obs.flow import (flow_streams, render_flow,
+                                      replay_flow, write_flow)
+    if args.replay:
+        try:
+            res = replay_flow(args.replay)
+        except (OSError, ValueError, KeyError) as e:
+            raise SystemExit(f"inspect flow --replay: {e}")
+        if res["verdict"] == "REPRODUCED":
+            print(f"flow replay: REPRODUCED ({args.replay})")
+            return 0
+        print(f"flow replay: MISMATCH vs {args.replay}")
+        for p in res["problems"]:
+            print(f"  {p}")
+        return 1
+
+    journals = [p for p in args.trace_file
+                if not p.endswith(".trace.jsonl")]
+    traces = [p for p in args.trace_file if p.endswith(".trace.jsonl")]
+    if len(journals) != 2:
+        raise SystemExit("inspect flow: need exactly two journals — "
+                         "CLIENT.journal (serve_loadgen.py "
+                         "--client-journal) then SERVE.journal (`cli "
+                         "serve --journal`); *.trace.jsonl files join "
+                         "as dispatch round streams")
+    try:
+        body = flow_streams(journals[0], journals[1], traces,
+                            seed=args.seed)
+    except OSError as e:
+        raise SystemExit(f"inspect flow: unreadable stream: {e}")
+    print(render_flow(body), end="")
+    if body["problems"]:
+        # never commit an artifact its own streams contradict
+        if args.json:
+            print(f"flow artifact NOT written ({args.json}): "
+                  f"{len(body['problems'])} problem(s) above")
+        return 1
+    if args.json:
+        write_flow(args.json, body)
+        print(f"flow artifact written: {args.json}")
     return 0
 
 
@@ -2204,6 +2281,8 @@ def _run_inspect(args) -> int:
         return _run_inspect_workload(args)
     if args.what == "watch":
         return _run_inspect_watch(args)
+    if args.what == "flow":
+        return _run_inspect_flow(args)
     if args.what == "traffic":
         return _run_inspect_traffic(args)
     if args.what == "check":

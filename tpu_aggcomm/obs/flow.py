@@ -1,0 +1,598 @@
+"""End-to-end causal flow tracing: client → server → round join.
+
+Three crash-safe streams already record a serve request's life, each
+from its own vantage point: the loadgen's client-side stamp journal
+(``serve_loadgen.py --client-journal`` — send/recv wall stamps per
+request), the serve journal's phase-boundary stamps (obs/workload.py
+BOUNDARIES, written by serve/server.py at its existing sites), and the
+flight recorder's attributed run event per batch dispatch (stamped with
+the batch correlation id ``cid`` via ``trace.run_context``). This
+module is the **jax-free** causal joiner: it stitches the three into
+one per-request end-to-end timeline, so a request's ``client_wall_s``
+decomposes as
+
+    wire + queue + batch + cache + (rounds + dispatch overhead) + respond
+
+with every component a NAMED number and the residual quantified, never
+silently absorbed. Per request the dominant component yields a NAMED
+verdict (wire-bound / queue-bound / batch-wait-bound / compile-bound /
+round-bound / dispatch-overhead-bound / respond-bound — a bare number
+is a regression), and over the warm (cache-hit) requests the module
+keeps the **warm overhead ledger**: the fraction of each client wall
+NOT spent in device rounds, with a seeded-bootstrap CI (the regression-
+gate seed discipline) — the trend-gated target of the ROADMAP item-1
+warm-path work.
+
+Float-exactness discipline: every derived number in a row is defined by
+ONE expression in this module (``client_wall_s = t_recv - t_send``;
+``server_wall_s`` = the workload profiler's canonical phase sum;
+``wire_s = client_wall_s - server_wall_s``; ``residual_s =
+phases["dispatch"] - run wall``; fractions = component / client wall),
+and ``obs.regress.validate_flow`` re-runs the identical expressions
+over a committed artifact's own rows — an artifact its own numbers
+contradict is schema-invalid. IEEE addition is not associative, so the
+contract is identical-computation equality, never algebraic
+re-summation.
+
+Join keys: client recv lines join serve journal records by ``rid``;
+serve records join run events by ``cid`` (``b<batch_seq>``). When the
+serve journal tail is torn, the ``serve.request`` trace instants (which
+carry rid, phases, cache AND cid) stand in as the server-side record —
+the joiner works on traces alone. All three streams are tailed
+torn-line-tolerantly with the skips COUNTED into ``integrity`` (the
+watchtower discipline); a client send with no recv names the request
+LOST in flight.
+
+``FLOW_r*.json`` (flow-v1) is written atomically, schema-validated by
+``obs.regress.validate_flow``, discovered by ``obs.history``
+(``inspect history`` trend-gates the "flow warm overhead fraction"
+series), rendered as an ``inspect report`` pane, exported as opt-in
+``/metrics`` gauges (:func:`flow_registry`, held float-exact by
+telemetry_gate.py), and replays to REPRODUCED from the stream basenames
+recorded inside it (:func:`replay_flow` — the tune/PREDICT/WORKLOAD/
+WATCH replay discipline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+
+from tpu_aggcomm.obs.atomic import atomic_write
+from tpu_aggcomm.obs.watch import _tail_trace, tail_journal
+from tpu_aggcomm.obs.workload import BOUNDARIES, attribute_phases
+
+__all__ = ["FLOW_SCHEMA", "COMPONENT_ORDER", "VERDICTS", "tail_client",
+           "decompose_request", "dominant_component", "flow_streams",
+           "write_flow", "replay_flow", "render_flow", "flow_registry"]
+
+FLOW_SCHEMA = "flow-v1"
+
+#: Canonical component order — the decomposition's spine AND the
+#: dominant-verdict tie-break (first in this order wins a tie). "round"
+#: is the joined dispatch's device-round wall; "overhead" is the
+#: quantified residual between the journal's dispatch phase and that
+#: wall (retry wrapper, span bookkeeping, result unpacking).
+COMPONENT_ORDER = ("wire", "queue", "batch", "cache", "round",
+                   "overhead", "respond")
+
+#: Component -> the NAMED per-request verdict (a bare number is a
+#: regression). "compile-bound" is the cache component: on a miss the
+#: cache phase IS the compile (serve/server.py marks "cache" after the
+#: lookup-or-compile resolves).
+VERDICTS = {
+    "wire": "wire-bound",
+    "queue": "queue-bound",
+    "batch": "batch-wait-bound",
+    "cache": "compile-bound",
+    "round": "round-bound",
+    "overhead": "dispatch-overhead-bound",
+    "respond": "respond-bound",
+}
+
+#: Bootstrap resamples for the warm-overhead CI (seeded — same streams
+#: + same seed ⟹ same interval byte-for-byte).
+N_BOOT = 2000
+
+
+# ---------------------------------------------------------------------------
+# Stream tails (torn lines COUNTED, never absorbed — the watch discipline).
+
+def tail_client(path: str) -> dict:
+    """Torn-line-tolerant client stamp-journal tail.
+
+    Returns ``{"sends": {i: rec}, "recvs": {i: rec}, "skipped_lines"}``.
+    A ``send`` with no matching ``recv`` is a request LOST in flight
+    (SIGKILLed loadgen / server that never answered) — the caller names
+    it, this tail only preserves the evidence."""
+    sends: dict = {}
+    recvs: dict = {}
+    skipped = 0
+    try:
+        fh = open(path)
+    except OSError:
+        return {"sends": sends, "recvs": recvs, "skipped_lines": 0}
+    with fh:
+        for line in fh:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if not isinstance(rec, dict) or not isinstance(
+                    rec.get("i"), int):
+                skipped += 1
+                continue
+            if rec.get("ev") == "send":
+                sends.setdefault(rec["i"], rec)
+            elif rec.get("ev") == "recv":
+                recvs.setdefault(rec["i"], rec)
+            else:
+                skipped += 1
+    return {"sends": sends, "recvs": recvs, "skipped_lines": skipped}
+
+
+def _runs_by_cid(events: list[dict], base: str) -> dict:
+    """``cid -> run block`` for one trace tail: the rep-0 envelope wall
+    (the measured dispatch host wall the server attributed) plus the
+    per-round walls via ``obs.metrics.round_stats`` — the attribution
+    cell stream, never host callbacks."""
+    from tpu_aggcomm.obs.metrics import round_stats
+    out: dict = {}
+    for run in (e for e in events if e.get("ev") == "run"
+                and e.get("cid") is not None):
+        rid = run["id"]
+        wall = None
+        for e in events:
+            if e.get("ev") == "span" and e.get("run") == rid \
+                    and e.get("rep") == 0 and e.get("bucket") == "total":
+                wall = e["dur_s"]
+                break
+        rounds = [{"round": s["round"], "wall_s": s["wall"]}
+                  for s in round_stats(events, rid)]
+        out.setdefault(str(run["cid"]), {
+            "trace": base, "run_id": rid, "method": run.get("method"),
+            "wall_s": wall, "rounds": rounds,
+            "rounds_total_s": sum(r["wall_s"] for r in rounds)})
+    return out
+
+
+def _instants_by_rid(events: list[dict]) -> dict:
+    """``rid -> serve.request instant args`` — the trace-side stand-in
+    for a torn serve-journal record (the instant carries rid, phases,
+    cache AND cid)."""
+    out: dict = {}
+    for e in events:
+        if e.get("ev") != "instant" or e.get("name") != "serve.request":
+            continue
+        args = e.get("args") or {}
+        if args.get("rid") is not None:
+            out.setdefault(args["rid"], args)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The decomposition (ONE expression per derived number — validate_flow
+# re-runs these exact functions over a committed artifact's rows).
+
+def _server_wall(phases: dict) -> float | None:
+    """The workload profiler's canonical wall arithmetic, verbatim."""
+    vals = [phases[b] for b in BOUNDARIES if b in phases]
+    return sum(vals) if vals else None
+
+
+def dominant_component(components: dict) -> str | None:
+    """Arg-max component in canonical order (strict — an earlier
+    component keeps a tie, so two spellings can never alias)."""
+    best = None
+    for k in COMPONENT_ORDER:
+        v = components.get(k)
+        if not isinstance(v, (int, float)):
+            continue
+        if best is None or v > components[best]:
+            best = k
+    return best
+
+
+def decompose_request(client: dict, server: dict,
+                      run: dict | None) -> dict:
+    """One request's end-to-end decomposition from its three joined
+    stream records. Pure and blob-representable: the validator re-runs
+    this function over the artifact's own (client, server, run) fields
+    and demands float-exact agreement with the stored row."""
+    t_send, t_recv = client.get("t_send"), client.get("t_recv")
+    client_wall = (t_recv - t_send
+                   if isinstance(t_send, (int, float))
+                   and isinstance(t_recv, (int, float)) else None)
+    phases, problems = attribute_phases(server.get("phases"))
+    server_wall = _server_wall(phases)
+    wire = (client_wall - server_wall
+            if client_wall is not None and server_wall is not None
+            else None)
+
+    components: dict = {}
+    if wire is not None:
+        components["wire"] = wire
+    for b in ("queue", "batch", "cache", "respond"):
+        if b in phases:
+            components[b] = phases[b]
+    run_wall = run.get("wall_s") if run else None
+    residual = None
+    if isinstance(run_wall, (int, float)):
+        components["round"] = run_wall
+        if "dispatch" in phases:
+            residual = phases["dispatch"] - run_wall
+            components["overhead"] = residual
+    elif "dispatch" in phases:
+        # no joined run (untraced dispatch): the whole dispatch phase
+        # is the round component — the overhead inside it is NOT
+        # quantifiable and stays un-split, never silently zeroed
+        components["round"] = phases["dispatch"]
+
+    fractions = ({k: v / client_wall for k, v in components.items()}
+                 if isinstance(client_wall, (int, float))
+                 and client_wall > 0 else {})
+    dominant = dominant_component(components)
+    if isinstance(wire, (int, float)) and wire < 0:
+        problems.append(
+            f"client wall {client_wall!r} is smaller than the server "
+            f"phase sum {server_wall!r} (wire_s {wire!r} < 0) — the "
+            f"two streams disagree about this request")
+    if isinstance(residual, (int, float)) and residual < 0:
+        problems.append(
+            f"journal dispatch phase {phases.get('dispatch')!r} is "
+            f"smaller than the joined run wall {run_wall!r} "
+            f"(residual_s {residual!r} < 0) — the streams disagree")
+    return {
+        "t_send": t_send, "t_recv": t_recv,
+        "client_wall_s": client_wall,
+        "phases": phases, "server_wall_s": server_wall,
+        "wire_s": wire,
+        "residual_s": residual,
+        "components": components,
+        "fractions": fractions,
+        "dominant": dominant,
+        "verdict": VERDICTS[dominant] if dominant is not None else None,
+        "problems": problems,
+    }
+
+
+def _boot_ci(vals: list, *, seed: int, n_boot: int = N_BOOT,
+             alpha: float = 0.05) -> list | None:
+    """Seeded percentile-bootstrap CI on the mean (the regression-gate
+    seed discipline: same samples + same seed ⟹ same interval)."""
+    if len(vals) < 2:
+        return None
+    rng = random.Random(int(seed))
+    n = len(vals)
+    means = sorted(sum(vals[rng.randrange(n)] for _ in range(n)) / n
+                   for _ in range(n_boot))
+    lo = means[int(n_boot * alpha / 2)]
+    hi = means[min(n_boot - 1, int(n_boot * (1 - alpha / 2)))]
+    return [lo, hi]
+
+
+def warm_overhead_block(rows: list[dict], *, seed: int) -> dict | None:
+    """The warm overhead ledger over completed cache-hit requests:
+    per-request ``1 - round/client`` fractions (row order), their mean,
+    and the seeded-bootstrap CI. None when no warm request decomposed.
+    THE one arithmetic — ``validate_flow`` and the trend series both
+    re-derive through this function."""
+    rids, fracs = [], []
+    for r in rows:
+        if r.get("status") != "done" or r.get("cache") != "hit":
+            continue
+        w = r.get("client_wall_s")
+        rnd = (r.get("components") or {}).get("round")
+        if not isinstance(w, (int, float)) or w <= 0 \
+                or not isinstance(rnd, (int, float)):
+            continue
+        rids.append(r["rid"])
+        fracs.append((w - rnd) / w)
+    if not fracs:
+        return None
+    return {"n": len(fracs), "rids": rids, "fractions": fracs,
+            "mean": sum(fracs) / len(fracs),
+            "ci95": _boot_ci(fracs, seed=seed),
+            "seed": int(seed)}
+
+
+def warm_components_block(rows: list[dict]) -> dict:
+    """Mean component fraction of the client wall over warm completed
+    requests, per component in canonical order — the numbers behind
+    "where do the warm milliseconds go" (report pane + /metrics
+    gauges)."""
+    out: dict = {}
+    for comp in COMPONENT_ORDER:
+        vals = [r["fractions"][comp] for r in rows
+                if r.get("status") == "done" and r.get("cache") == "hit"
+                and isinstance((r.get("fractions") or {}).get(comp),
+                               (int, float))]
+        if vals:
+            out[comp] = {"n": len(vals),
+                         "mean_fraction": sum(vals) / len(vals)}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The joiner.
+
+def flow_streams(client_path: str, serve_path: str, trace_paths=(), *,
+                 seed: int = 0) -> dict:
+    """The whole flow pass: tail the three streams, join, decompose.
+
+    Returns the flow-v1 body minus the artifact envelope (schema/
+    manifest/created_unix, added by :func:`write_flow`). Deterministic
+    by construction: a pure function of (streams, seed) — the replay
+    gate depends on it."""
+    trace_paths = list(trace_paths)
+    client = tail_client(client_path)
+    jtail = tail_journal(serve_path)
+
+    # serve-journal side: terminal record per rid (the workload join)
+    terminal: dict = {}
+    for rec in jtail["records"]:
+        rid = (rec.get("key") or {}).get("request")
+        if rid is None:
+            continue
+        if rec.get("status") in ("done", "fail", "shed"):
+            terminal.setdefault(rid, rec)
+
+    trace_skipped = 0
+    runs_by_cid: dict = {}
+    instants: dict = {}
+    for path in trace_paths:
+        events, skipped = _tail_trace(path)
+        trace_skipped += skipped
+        base = os.path.basename(path)
+        for cid, info in _runs_by_cid(events, base).items():
+            runs_by_cid.setdefault(cid, info)
+        for rid, args in _instants_by_rid(events).items():
+            instants.setdefault(rid, args)
+
+    rows: list[dict] = []
+    problems: list[str] = []
+    client_only: list = []
+    joined_rids: set = set()
+    lost = [i for i in sorted(client["sends"])
+            if i not in client["recvs"]]
+    for i in lost:
+        problems.append(
+            f"client request i={i} (shape "
+            f"{client['sends'][i].get('shape')!r}) has a send stamp but "
+            f"no recv — LOST in flight (torn client journal or a "
+            f"response that never came)")
+
+    for i in sorted(client["recvs"]):
+        crec = client["recvs"][i]
+        rid = crec.get("rid")
+        server = terminal.get(rid)
+        source = "journal"
+        if server is None and rid in instants:
+            # the torn-journal fallback: the serve.request instant
+            # carries the same phases/cache/cid payload
+            a = instants[rid]
+            server = {"status": "done" if a.get("ok") else "fail",
+                      "cache": a.get("cache"), "cid": a.get("cid"),
+                      "phases": a.get("phases")}
+            source = "trace"
+        if rid is None or server is None:
+            client_only.append({"i": i, "rid": rid,
+                                "shed": crec.get("shed"),
+                                "error": crec.get("error")})
+            continue
+        joined_rids.add(rid)
+        cid = server.get("cid")
+        run = runs_by_cid.get(cid) if cid is not None else None
+        dec = decompose_request(crec, server, run)
+        for p in dec.pop("problems"):
+            problems.append(f"request rid={rid}: {p}")
+        row = {"i": i, "rid": rid, "status": server.get("status"),
+               "cache": server.get("cache"), "cid": cid,
+               "server_source": source, "run": run, **dec}
+        # the stored client wall must equal the stream's own recorded
+        # one (the loadgen computed the identical expression)
+        if isinstance(crec.get("client_wall_s"), (int, float)) \
+                and crec["client_wall_s"] != row["client_wall_s"]:
+            problems.append(
+                f"request rid={rid}: recorded client_wall_s "
+                f"{crec['client_wall_s']!r} != t_recv - t_send "
+                f"{row['client_wall_s']!r} — the client journal "
+                f"disagrees with itself")
+        rows.append(row)
+
+    server_only = sorted(set(terminal) - joined_rids)
+    verdicts: dict = {}
+    for r in rows:
+        if r["verdict"] is not None:
+            verdicts[r["verdict"]] = verdicts.get(r["verdict"], 0) + 1
+
+    return {
+        "seed": int(seed),
+        "client_journal": os.path.basename(client_path),
+        "serve_journal": os.path.basename(serve_path),
+        "traces": [os.path.basename(p) for p in trace_paths],
+        "requests": {"client": len(client["recvs"]),
+                     "joined": len(rows),
+                     "client_only": client_only,
+                     "server_only": server_only,
+                     "lost": lost},
+        "per_request": rows,
+        "verdicts": verdicts,
+        "warm_overhead": warm_overhead_block(rows, seed=seed),
+        "warm_components": warm_components_block(rows),
+        "integrity": {"client_torn_lines": client["skipped_lines"],
+                      "journal_torn_lines": jtail["skipped_lines"],
+                      "trace_torn_lines": trace_skipped},
+        "problems": problems,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Artifact I/O (the obs/workload.py replay discipline).
+
+def write_flow(path: str, body: dict) -> dict:
+    """Write one flow-v1 artifact atomically (manifest records env var
+    NAMES only, the ledger discipline) and return the blob."""
+    from tpu_aggcomm.obs import ledger
+    blob = dict(body)
+    blob["schema"] = FLOW_SCHEMA
+    blob["manifest"] = ledger.manifest()
+    blob["created_unix"] = time.time()
+    with atomic_write(path) as fh:
+        json.dump(blob, fh, indent=1)
+        fh.write("\n")
+    return blob
+
+
+#: Envelope keys excluded from the replay comparison (environment-
+#: dependent by design; everything else must re-derive byte-for-byte).
+_ENVELOPE = ("schema", "manifest", "created_unix")
+
+
+def replay_flow(path: str) -> dict:
+    """Re-derive a committed FLOW_r*.json from the stream basenames it
+    records (resolved next to the artifact) + its seed, and
+    byte-compare minus the envelope. ``{"verdict": "REPRODUCED" |
+    "MISMATCH", "problems": [...]}`` with every diverging top-level key
+    named."""
+    with open(path) as fh:
+        blob = json.load(fh)
+    problems: list[str] = []
+    if blob.get("schema") != FLOW_SCHEMA:
+        return {"verdict": "MISMATCH",
+                "problems": [f"schema {blob.get('schema')!r} != "
+                             f"{FLOW_SCHEMA!r}"]}
+    root = os.path.dirname(os.path.abspath(path))
+
+    def _resolve(name, what):
+        if name is None:
+            problems.append(f"artifact records no {what}")
+            return None
+        p = name if os.path.isabs(name) else os.path.join(root, name)
+        if not os.path.exists(p):
+            problems.append(f"recorded {what} {name!r} not found next "
+                            f"to the artifact ({root})")
+        return p
+
+    cpath = _resolve(blob.get("client_journal"), "client journal")
+    spath = _resolve(blob.get("serve_journal"), "serve journal")
+    traces = [_resolve(n, "trace") for n in blob.get("traces") or []]
+    if problems:
+        return {"verdict": "MISMATCH", "problems": problems}
+    rederived = flow_streams(cpath, spath, traces,
+                             seed=blob.get("seed", 0))
+    want = {k: v for k, v in blob.items() if k not in _ENVELOPE}
+    for k in sorted(set(want) | set(rederived)):
+        a = json.dumps(want.get(k), sort_keys=True)
+        b = json.dumps(rederived.get(k), sort_keys=True)
+        if a != b:
+            problems.append(f"key {k!r} does not re-derive from the "
+                            f"recorded streams (artifact {a[:120]}... "
+                            f"vs re-derived {b[:120]}...)"
+                            if max(len(a), len(b)) > 120 else
+                            f"key {k!r}: artifact {a} vs re-derived {b}")
+    return {"verdict": "REPRODUCED" if not problems else "MISMATCH",
+            "problems": problems}
+
+
+# ---------------------------------------------------------------------------
+# /metrics gauges (the watch_registry fold pattern: artifact numbers
+# VERBATIM — telemetry_gate.py re-parses the render and demands
+# float-exact agreement).
+
+def flow_registry(blob: dict, registry) -> None:
+    """Fold one flow-v1 blob into a MetricsRegistry: the warm overhead
+    fraction, per-component warm mean fractions, and the per-verdict
+    request counts."""
+    wo = blob.get("warm_overhead")
+    if wo is not None:
+        registry.gauge("tpu_aggcomm_flow_warm_overhead_fraction",
+                       wo["mean"])
+    for comp, st in (blob.get("warm_components") or {}).items():
+        registry.gauge("tpu_aggcomm_flow_warm_component_fraction",
+                       st["mean_fraction"], component=comp)
+    for verdict, n in (blob.get("verdicts") or {}).items():
+        registry.gauge("tpu_aggcomm_flow_requests", float(n),
+                       verdict=verdict)
+
+
+# ---------------------------------------------------------------------------
+# Rendering.
+
+def _ms(v) -> str:
+    return f"{v * 1e3:9.3f} ms" if isinstance(v, (int, float)) \
+        else "      -  "
+
+
+def render_flow(body: dict) -> str:
+    """The ``inspect flow`` text view."""
+    r = body["requests"]
+    lines = [f"flow trace over {body['client_journal']} + "
+             f"{body['serve_journal']}"
+             + (f" + {', '.join(body['traces'])}" if body["traces"]
+                else "") + f" (seed {body['seed']})",
+             f"  requests: {r['client']} client recvs — {r['joined']} "
+             f"joined end-to-end, {len(r['client_only'])} client-only, "
+             f"{len(r['server_only'])} server-only"
+             + (f", LOST in flight: {r['lost']}" if r["lost"] else "")]
+    if body["verdicts"]:
+        order = sorted(body["verdicts"],
+                       key=lambda v: (-body["verdicts"][v], v))
+        lines.append("  verdicts: " + ", ".join(
+            f"{v} x{body['verdicts'][v]}" for v in order))
+    wo = body.get("warm_overhead")
+    if wo is not None:
+        ci = wo.get("ci95")
+        citxt = (f" (seeded 95% CI [{ci[0]:.3f}, {ci[1]:.3f}])"
+                 if ci else "")
+        lines.append(
+            f"  warm overhead ledger: {wo['mean']:.1%} of the warm "
+            f"client wall is NOT device rounds over {wo['n']} "
+            f"cache-hit request(s){citxt}")
+    wc = body.get("warm_components") or {}
+    if wc:
+        lines.append("  where the warm client wall goes (mean fraction "
+                     "per component):")
+        for comp in COMPONENT_ORDER:
+            st = wc.get(comp)
+            if st is None:
+                continue
+            lines.append(f"    {comp:>9}: {st['mean_fraction']:7.1%}  "
+                         f"(n={st['n']}, {VERDICTS[comp]})")
+    shown = 0
+    for row in body["per_request"]:
+        if shown >= 8:
+            lines.append(
+                f"  ... {len(body['per_request']) - shown} more request(s)")
+            break
+        shown += 1
+        comp = row["components"]
+        parts = "  ".join(f"{k} {_ms(comp[k]).strip()}"
+                          for k in COMPONENT_ORDER if k in comp)
+        run = row.get("run")
+        lines.append(
+            f"  rid {row['rid']} [{row['status']}/{row['cache']}"
+            f"{'/' + str(row['cid']) if row['cid'] else ''}]: client "
+            f"{_ms(row['client_wall_s']).strip()} -> {row['verdict']}")
+        lines.append(f"      {parts}")
+        if run is not None and run.get("rounds"):
+            rr = ", ".join(f"r{x['round']} {_ms(x['wall_s']).strip()}"
+                           for x in run["rounds"][:6])
+            lines.append(f"      rounds ({run['trace']}#run"
+                         f"{run['run_id']}): {rr}")
+    integ = body["integrity"]
+    if integ["client_torn_lines"] or integ["journal_torn_lines"] \
+            or integ["trace_torn_lines"]:
+        lines.append(
+            f"  integrity: skipped {integ['client_torn_lines']} torn "
+            f"client line(s), {integ['journal_torn_lines']} torn "
+            f"journal line(s), {integ['trace_torn_lines']} torn trace "
+            f"line(s) — counted, not silently absorbed")
+    for p in body["problems"]:
+        lines.append(f"  PROBLEM: {p}")
+    return "\n".join(lines) + "\n"

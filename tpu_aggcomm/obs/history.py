@@ -44,8 +44,9 @@ import statistics
 
 __all__ = ["load_history", "build_index", "write_index", "trend_gate",
            "check_trends", "bench_series", "workload_series",
-           "watch_series", "pilot_series", "render_history",
-           "MIN_TREND_ROUNDS", "TREND_TOLERANCE", "HISTORY_SCHEMA"]
+           "watch_series", "pilot_series", "flow_series",
+           "render_history", "MIN_TREND_ROUNDS", "TREND_TOLERANCE",
+           "HISTORY_SCHEMA"]
 
 #: Schema tag of the persisted index artifact (versioned like
 #: TUNE_SCHEMAS / TRAFFIC_SCHEMAS: new tag = new entry, old tags stay
@@ -267,6 +268,34 @@ def pilot_series(root: str = ".", *,
     return series
 
 
+def flow_series(root: str = ".", *,
+                errors: list[str] | None = None
+                ) -> dict[str, list[dict]]:
+    """The warm-overhead time series from the committed ``FLOW_r*.json``
+    history (obs/flow.py): per flow-traced round, the mean fraction of
+    the warm (cache-hit) client wall NOT spent in device rounds — the
+    end-to-end overhead the ROADMAP item-1 warm-path work must drive
+    down. Keyed ``"flow warm overhead fraction"`` (cannot collide with
+    bench ``"<metric> | <platform>"``, serve, workload, watch or pilot
+    keys), fed to the same seeded trend gate: overhead drifting UP
+    means the serve path is growing fat around the kernels, and the
+    gate fails the build on a confirmed trajectory."""
+    series: dict[str, list[dict]] = {}
+    for rnd, path, blob in load_history(root, "FLOW", errors=errors):
+        wo = blob.get("warm_overhead") if isinstance(
+            blob.get("warm_overhead"), dict) else {}
+        mean = wo.get("mean")
+        if not isinstance(mean, (int, float)) or isinstance(mean, bool):
+            continue
+        series.setdefault("flow warm overhead fraction", []).append({
+            "round": rnd, "value": float(mean), "unit": "frac",
+            "samples_n": wo.get("n") or 0,
+            "compile_seconds": None, "hbm_peak_bytes": None,
+            "ci95": wo.get("ci95"),
+            "file": os.path.basename(path)})
+    return series
+
+
 def _tail_jsonl(path: str) -> list[dict]:
     """Torn-line-tolerant JSONL read (a live trace may be mid-append)."""
     out: list[dict] = []
@@ -398,6 +427,15 @@ def build_index(root: str = ".") -> dict:
                       "actions": sorted({d.get("action") for d in
                                          blob.get("decisions") or []
                                          if isinstance(d, dict)})})
+    flow = []
+    for rnd, path, blob in load_history(root, "FLOW", errors=errors):
+        req = blob.get("requests") or {}
+        wo = blob.get("warm_overhead") or {}
+        flow.append({"round": rnd, "file": os.path.basename(path),
+                     "joined": req.get("joined"),
+                     "lost": len(req.get("lost") or []),
+                     "warm_overhead_mean": wo.get("mean"),
+                     "verdicts": blob.get("verdicts")})
     return {"schema": HISTORY_SCHEMA, "root": os.path.abspath(root),
             "bench": bench, "multichip": multichip, "tune": tune,
             "traffic": traffic, "serve": serve_series(root, errors=errors),
@@ -407,6 +445,8 @@ def build_index(root: str = ".") -> dict:
             "watch_series": watch_series(root, errors=errors),
             "pilot": pilot,
             "pilot_series": pilot_series(root, errors=errors),
+            "flow": flow,
+            "flow_series": flow_series(root, errors=errors),
             "traces": _trace_rows(root), "errors": errors}
 
 
@@ -522,13 +562,15 @@ def check_trends(root: str = ".", *, tolerance: float = TREND_TOLERANCE,
     ``"<metric> | <platform>"``, serve keys ``"serve warm p50 |
     <backend>"``, the workload key is ``"workload padding waste"``, the
     watch key is ``"slo worst burn"``, the pilot key is ``"pilot
-    inverse promotion win"``.)"""
+    inverse promotion win"``, the flow key is ``"flow warm overhead
+    fraction"``.)"""
     errors: list[str] = []
     series = dict(bench_series(root, errors=errors))
     series.update(serve_series(root, errors=errors))
     series.update(workload_series(root, errors=errors))
     series.update(watch_series(root, errors=errors))
     series.update(pilot_series(root, errors=errors))
+    series.update(flow_series(root, errors=errors))
     gates = {key: trend_gate([(r["round"], r["value"]) for r in rows],
                              tolerance=tolerance, seed=seed)
              for key, rows in sorted(series.items())}
@@ -678,6 +720,32 @@ def render_history(root: str = ".") -> str:
                      + ", ".join(detail))
         if gate.get("note"):
             lines.append(f"  note: {gate['note']}")
+    for key, rows in sorted(index["flow_series"].items()):
+        gate = trends["series"].get(key, {})
+        lines.append(f"== {key} ({len(rows)} flow-traced rounds) ==")
+        for r in rows:
+            extras = []
+            if r["samples_n"]:
+                extras.append(f"{r['samples_n']} warm requests")
+            if isinstance(r.get("ci95"), list) and len(r["ci95"]) == 2:
+                extras.append(f"95% CI [{r['ci95'][0]:.3f}, "
+                              f"{r['ci95'][1]:.3f}]")
+            ex = f"  [{', '.join(extras)}]" if extras else ""
+            lines.append(f"  r{r['round']:02d}: "
+                         f"{_fmt_val(r['value'], r['unit'])}{ex}")
+        detail = []
+        if gate.get("slope_pct_per_round") is not None:
+            detail.append(f"slope {gate['slope_pct_per_round']:+.1f}%"
+                          f"/round")
+        if gate.get("ci_pct_per_round") is not None:
+            ci = gate["ci_pct_per_round"]
+            detail.append(f"95% CI [{ci[0]:+.1f}%, {ci[1]:+.1f}%]")
+        detail.append(f"tolerance {gate.get('tolerance_pct', 0):.0f}%"
+                      f"/round (seed {gate.get('seed')})")
+        lines.append(f"  trend: {gate.get('verdict', '?').upper()} — "
+                     + ", ".join(detail))
+        if gate.get("note"):
+            lines.append(f"  note: {gate['note']}")
     for w in index["workload"]:
         props = f", {w['proposals']} advisory proposal(s)" \
             if w["proposals"] else ""
@@ -698,6 +766,12 @@ def render_history(root: str = ".") -> str:
                      f"{p['targets']} target(s), "
                      f"{p['promotions']} promotion(s), "
                      f"{p['demotions']} demotion(s){acts}")
+    for f in index["flow"]:
+        verd = ", ".join(f"{v} x{n}" for v, n in sorted(
+            (f.get("verdicts") or {}).items())) or "none"
+        lost = f", {f['lost']} LOST" if f["lost"] else ""
+        lines.append(f"flow: {f['file']} — {f['joined']} joined "
+                     f"request(s){lost}, verdicts: {verd}")
     mc = index["multichip"]
     if mc:
         ok = sum(1 for m in mc if m.get("ok"))

@@ -29,6 +29,10 @@ form), loadable in ``ui.perfetto.dev`` or ``chrome://tracing``:
   the boundary stamps each ``serve.request`` instant carries
   (obs/workload.py BOUNDARIES order) — the ``inspect workload``
   attribution projected onto the timeline, never ad-hoc host timing.
+  Request slices carry the batch correlation id (``cid``), and Chrome
+  flow events (``ph`` "s"/"f") link each request's ``dispatch`` slice
+  to the first round slice of the cid-matched attributed run in pid 2 —
+  the ``inspect flow`` causal join drawn as arrows on the timeline.
 
 Multi-run legibility: the process names carry the backend(s) and the
 ``process_labels`` metadata lists every run (``m<id> <method name>
@@ -195,6 +199,9 @@ def to_chrome_trace(events: list[dict]) -> dict:
     # `inspect workload` prints, projected onto the timeline.
     from tpu_aggcomm.obs.workload import BOUNDARIES
     serve_seen: set[int] = set()
+    # (rid, cid) -> the request's dispatch-slice start ts: the anchor
+    # each flow arrow departs from (obs/flow.py joins on the same cid)
+    dispatch_anchor: dict[tuple[int, str], float] = {}
     for e in events:
         if e["ev"] != "instant" or e.get("name") != "serve.request":
             continue
@@ -209,7 +216,10 @@ def to_chrome_trace(events: list[dict]) -> dict:
             continue
         t0 = e["ts"] - stamps[-1][1] * 1e6
         serve_seen.add(rid)
+        cid = args.get("cid")
         for (_b0, s0), (b1, s1) in zip(stamps, stamps[1:]):
+            if b1 == "dispatch" and isinstance(cid, str):
+                dispatch_anchor[(rid, cid)] = t0 + s0 * 1e6
             slices.append({
                 "ph": "X", "pid": SERVE_PID, "tid": rid + 1,
                 "name": b1, "cat": "serve",
@@ -218,8 +228,35 @@ def to_chrome_trace(events: list[dict]) -> dict:
                          "ok": args.get("ok"),
                          "backend": args.get("backend"),
                          "cache": args.get("cache"),
+                         "cid": cid,
                          "batch_seq": args.get("batch_seq"),
                          "batch_n": args.get("batch_n")}})
+
+    # flow links: request dispatch slice -> first round slice of the
+    # cid-matched attributed run (the obs/flow.py causal join as Chrome
+    # flow events). "s" binds to the enclosing dispatch slice; "f" with
+    # bp "e" binds to the enclosing round slice in the ranks process.
+    if dispatch_anchor:
+        run_by_cid = {e["cid"]: e["id"] for e in events
+                      if e["ev"] == "run" and isinstance(e.get("cid"), str)}
+        first_round: dict = {}   # run id -> (rank tid, ts) of first slice
+        for e in events:
+            if e["ev"] == "span" and e["bucket"] != "total":
+                cur = first_round.get(e["run"])
+                if cur is None or e["ts"] < cur[1]:
+                    first_round[e["run"]] = (e["rank"] + 1, e["ts"])
+        flow_id = 0
+        for (rid, cid), ts in sorted(dispatch_anchor.items()):
+            target = first_round.get(run_by_cid.get(cid))
+            if target is None:
+                continue
+            flow_id += 1
+            common = {"cat": "flow", "name": "dispatch",
+                      "id": flow_id, "args": {"rid": rid, "cid": cid}}
+            slices.append({"ph": "s", "pid": SERVE_PID, "tid": rid + 1,
+                           "ts": ts, **common})
+            slices.append({"ph": "f", "bp": "e", "pid": RANKS_PID,
+                           "tid": target[0], "ts": target[1], **common})
     if serve_seen:
         out.append(_meta(SERVE_PID, 0, "process_name",
                          "serve requests (journal-derived)"))

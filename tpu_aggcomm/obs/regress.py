@@ -73,9 +73,10 @@ __all__ = ["validate_bench", "validate_multichip", "validate_tune",
            "MIN_GATE_SAMPLES", "COMPILE_TOLERANCE", "TUNE_SCHEMAS",
            "TRAFFIC_SCHEMAS", "PREDICT_SCHEMAS", "COMPARE_SCHEMAS",
            "SERVE_SCHEMAS", "SYNTH_SCHEMAS", "WORKLOAD_SCHEMAS",
-           "WATCH_SCHEMAS", "PILOT_SCHEMAS", "validate_predict",
-           "validate_compare", "validate_serve", "validate_synth",
-           "validate_workload", "validate_watch", "validate_pilot"]
+           "WATCH_SCHEMAS", "PILOT_SCHEMAS", "FLOW_SCHEMAS",
+           "validate_predict", "validate_compare", "validate_serve",
+           "validate_synth", "validate_workload", "validate_watch",
+           "validate_pilot", "validate_flow"]
 
 #: Relative slowdown vs the best prior same-platform round that counts as
 #: a regression. Differenced-chain numbers jitter a few percent
@@ -1854,6 +1855,222 @@ def validate_watch(obj, where: str = "WATCH") -> list[str]:
                           f"re-derive from the blob's own rows + "
                           f"evidence blocks (attribute_anomaly): "
                           f"artifact {got_v} vs re-derived {want_v}")
+    return errors
+
+
+#: Valid ``schema`` tags for FLOW_r*.json (obs/flow.py — the
+#: ``cli inspect flow`` output) — versioned like TUNE_SCHEMAS.
+FLOW_SCHEMAS = ("flow-v1",)
+
+_FLOW_STATUSES = ("done", "fail", "shed")
+
+
+def validate_flow(obj, where: str = "FLOW") -> list[str]:
+    """Schema errors (empty list = valid) for one ``FLOW_r*.json``
+    causal-flow artifact (obs/flow.py).
+
+    The validate_workload/validate_watch discipline applied to the
+    end-to-end decomposition: every derived number in every row must
+    re-derive from the row's OWN fields through the identical
+    expressions obs/flow.py used to produce it — ``client_wall_s ==
+    t_recv - t_send``, ``server_wall_s`` == the canonical phase sum,
+    ``wire_s == client_wall_s - server_wall_s``, the round component ==
+    the joined run's wall (else the journal dispatch phase), the
+    overhead component == the quantified residual, every fraction ==
+    component / client wall, the dominant verdict == the canonical-order
+    arg-max's NAMED verdict — and the summary blocks (verdict counts,
+    warm overhead ledger with its seeded CI, warm component means) must
+    recount/re-derive from the rows + seed. An artifact its own numbers
+    contradict is schema-invalid. Freshness against the source streams
+    is the separate ``replay_flow`` gate."""
+    import json as _json
+
+    from tpu_aggcomm.obs import flow as _flow
+
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"{where}: top level must be an object"]
+    schema = obj.get("schema")
+    if schema not in FLOW_SCHEMAS:
+        errors.append(f"{where}: unknown schema tag {schema!r} "
+                      f"(expected one of {list(FLOW_SCHEMAS)})")
+        return errors
+    _require(obj, "created_unix", (int, float), errors, where)
+    _require(obj, "seed", int, errors, where)
+    man = obj.get("manifest")
+    if man is not None and not isinstance(man, dict):
+        errors.append(f"{where}: 'manifest' must be an object or null")
+    for k in ("client_journal", "serve_journal"):
+        _require(obj, k, str, errors, where)
+    traces = obj.get("traces")
+    if not isinstance(traces, list) \
+            or not all(isinstance(t, str) for t in traces):
+        errors.append(f"{where}: 'traces' must be a list of trace "
+                      f"basenames")
+    probs = obj.get("problems")
+    if not isinstance(probs, list):
+        errors.append(f"{where}: 'problems' must be a list")
+    elif probs:
+        errors.append(f"{where}: artifact carries {len(probs)} "
+                      f"problem(s) (first: {probs[0]!r}) — streams "
+                      f"that disagree with each other must not be "
+                      f"committed as an artifact")
+
+    rows = obj.get("per_request")
+    if not isinstance(rows, list):
+        return errors + [f"{where}: 'per_request' must be a list"]
+    from tpu_aggcomm.obs.workload import BOUNDARIES as _BOUNDS
+    verdict_counts: dict = {}
+    for i, r in enumerate(rows):
+        w = f"{where}.per_request[{i}]"
+        if not isinstance(r, dict):
+            errors.append(f"{w}: must be an object")
+            continue
+        if r.get("status") not in _FLOW_STATUSES:
+            errors.append(f"{w}: status {r.get('status')!r} not in "
+                          f"{_FLOW_STATUSES}")
+        if r.get("server_source") not in ("journal", "trace"):
+            errors.append(f"{w}: server_source "
+                          f"{r.get('server_source')!r} must be "
+                          f"'journal' or 'trace'")
+        phases = r.get("phases")
+        if not isinstance(phases, dict):
+            errors.append(f"{w}: 'phases' must be an object")
+            continue
+        comp = r.get("components")
+        frac = r.get("fractions")
+        if not isinstance(comp, dict) or not isinstance(frac, dict):
+            errors.append(f"{w}: 'components' and 'fractions' must be "
+                          f"objects")
+            continue
+        # -- the decomposition, identical expression by expression ----
+        t_send, t_recv = r.get("t_send"), r.get("t_recv")
+        want_cw = (t_recv - t_send if _is_num(t_send) and _is_num(t_recv)
+                   else None)
+        if r.get("client_wall_s") != want_cw:
+            errors.append(f"{w}: client_wall_s {r.get('client_wall_s')!r}"
+                          f" != t_recv - t_send == {want_cw!r}")
+        vals = [phases[b] for b in _BOUNDS if b in phases]
+        want_sw = sum(vals) if vals else None
+        if r.get("server_wall_s") != want_sw:
+            errors.append(f"{w}: server_wall_s {r.get('server_wall_s')!r}"
+                          f" != canonical phase sum == {want_sw!r}")
+        want_wire = (want_cw - want_sw
+                     if want_cw is not None and want_sw is not None
+                     else None)
+        if r.get("wire_s") != want_wire:
+            errors.append(f"{w}: wire_s {r.get('wire_s')!r} != "
+                          f"client_wall_s - server_wall_s == "
+                          f"{want_wire!r}")
+        run = r.get("run")
+        if run is not None and not isinstance(run, dict):
+            errors.append(f"{w}: 'run' must be an object or null")
+            run = None
+        run_wall = run.get("wall_s") if run else None
+        want_comp: dict = {}
+        if want_wire is not None:
+            want_comp["wire"] = want_wire
+        for b in ("queue", "batch", "cache", "respond"):
+            if b in phases:
+                want_comp[b] = phases[b]
+        want_res = None
+        if _is_num(run_wall):
+            want_comp["round"] = run_wall
+            if "dispatch" in phases:
+                want_res = phases["dispatch"] - run_wall
+                want_comp["overhead"] = want_res
+        elif "dispatch" in phases:
+            want_comp["round"] = phases["dispatch"]
+        if r.get("residual_s") != want_res:
+            errors.append(f"{w}: residual_s {r.get('residual_s')!r} != "
+                          f"dispatch phase - run wall == {want_res!r}")
+        if _json.dumps(comp, sort_keys=True) \
+                != _json.dumps(want_comp, sort_keys=True):
+            errors.append(f"{w}: 'components' does not re-derive from "
+                          f"the row's own phases/run fields: artifact "
+                          f"{comp} vs re-derived {want_comp}")
+        want_frac = ({k: v / want_cw for k, v in want_comp.items()}
+                     if _is_num(want_cw) and want_cw > 0 else {})
+        if _json.dumps(frac, sort_keys=True) \
+                != _json.dumps(want_frac, sort_keys=True):
+            errors.append(f"{w}: 'fractions' do not re-derive as "
+                          f"component / client_wall_s float-exactly")
+        want_dom = _flow.dominant_component(want_comp)
+        if r.get("dominant") != want_dom:
+            errors.append(f"{w}: dominant {r.get('dominant')!r} != the "
+                          f"canonical-order arg-max {want_dom!r}")
+        want_verdict = (_flow.VERDICTS[want_dom]
+                        if want_dom is not None else None)
+        if r.get("verdict") != want_verdict:
+            errors.append(f"{w}: verdict {r.get('verdict')!r} != "
+                          f"{want_verdict!r} — every dominant component "
+                          f"maps to its NAMED verdict")
+        elif want_verdict is not None:
+            verdict_counts[want_verdict] = \
+                verdict_counts.get(want_verdict, 0) + 1
+        if run is not None:
+            rounds = run.get("rounds")
+            if not isinstance(rounds, list) or not all(
+                    isinstance(x, dict) and _is_num(x.get("wall_s"))
+                    for x in rounds):
+                errors.append(f"{w}.run: 'rounds' must be a list of "
+                              f"objects with numeric wall_s")
+            else:
+                want_rt = sum(x["wall_s"] for x in rounds)
+                if run.get("rounds_total_s") != want_rt:
+                    errors.append(f"{w}.run: rounds_total_s "
+                                  f"{run.get('rounds_total_s')!r} != sum "
+                                  f"of round walls == {want_rt!r}")
+
+    # -- summary blocks must recount/re-derive from the rows ----------
+    if _json.dumps(obj.get("verdicts"), sort_keys=True) \
+            != _json.dumps(verdict_counts, sort_keys=True):
+        errors.append(f"{where}: 'verdicts' {obj.get('verdicts')!r} "
+                      f"does not recount from the per_request rows "
+                      f"== {verdict_counts!r}")
+    seed = obj.get("seed")
+    if isinstance(seed, int):
+        try:
+            want_wo = _flow.warm_overhead_block(rows, seed=seed)
+            want_wc = _flow.warm_components_block(rows)
+        except Exception as e:  # lint: broad-ok (validation must report malformed rows as schema errors, not crash the checker)
+            errors.append(f"{where}: per_request rows do not fold into "
+                          f"the warm ledger: {type(e).__name__}: {e}")
+        else:
+            if _json.dumps(obj.get("warm_overhead"), sort_keys=True) \
+                    != _json.dumps(want_wo, sort_keys=True):
+                errors.append(f"{where}: 'warm_overhead' does not "
+                              f"re-derive from the rows + seed (the "
+                              f"warm_overhead_block arithmetic, seeded "
+                              f"CI included)")
+            if _json.dumps(obj.get("warm_components"), sort_keys=True) \
+                    != _json.dumps(want_wc, sort_keys=True):
+                errors.append(f"{where}: 'warm_components' does not "
+                              f"re-derive from the rows (the "
+                              f"warm_components_block arithmetic)")
+
+    req = obj.get("requests")
+    if not isinstance(req, dict):
+        errors.append(f"{where}: 'requests' must be an object")
+    else:
+        if isinstance(req.get("joined"), int) \
+                and req["joined"] != len(rows):
+            errors.append(f"{where}: requests.joined claims "
+                          f"{req['joined']} but the artifact carries "
+                          f"{len(rows)} per_request row(s)")
+        for k in ("client_only", "server_only", "lost"):
+            if not isinstance(req.get(k), list):
+                errors.append(f"{where}.requests: {k!r} must be a list")
+    integ = obj.get("integrity")
+    if not isinstance(integ, dict):
+        errors.append(f"{where}: 'integrity' must be an object")
+    else:
+        for k in ("client_torn_lines", "journal_torn_lines",
+                  "trace_torn_lines"):
+            v = integ.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"{where}.integrity: {k!r} must be a "
+                              f"non-negative int, got {v!r}")
     return errors
 
 

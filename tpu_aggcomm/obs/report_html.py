@@ -44,6 +44,11 @@ Four panels:
   artifact (obs/watch.py): per-objective SLO burn rates over the
   tumbling windows, overall compliance, stream-integrity counters, and
   the confirmed changepoints with their NAMED root-cause verdicts.
+- **flow tracing** — every committed ``FLOW_r*.json`` causal-flow
+  artifact (obs/flow.py): the warm overhead ledger (mean + seeded CI),
+  the per-component warm fraction bars, verdict counts, and per-request
+  decomposition rows — where each client-observed wall actually goes,
+  with the residual quantified.
 
 Empty inputs degrade to an honest "no data" panel, never a broken page.
 """
@@ -441,6 +446,42 @@ def _watch_rows(root: str, errors: list[str]) -> list[dict]:
     return rows
 
 
+def _flow_rows(root: str, errors: list[str]) -> list[dict]:
+    """Flow pane data from every ``FLOW_r*.json`` under the history
+    root (obs/flow.py, discovered via load_history like every other
+    family) — jax-free. A schema-invalid flow artifact becomes an
+    error payload, never a silently trusted decomposition."""
+    from tpu_aggcomm.obs.flow import COMPONENT_ORDER
+    from tpu_aggcomm.obs.history import load_history
+    from tpu_aggcomm.obs.regress import validate_flow
+
+    rows: list[dict] = []
+    for rnd, path, blob in load_history(root, "FLOW", errors=errors):
+        name = os.path.basename(path)
+        errs = validate_flow(blob, name)
+        if errs:
+            rows.append({"round": rnd, "file": name, "error": errs[0]})
+            continue
+        rows.append({
+            "round": rnd, "file": name, "error": None,
+            "seed": blob.get("seed"),
+            "requests": blob.get("requests"),
+            "integrity": blob.get("integrity"),
+            "verdicts": blob.get("verdicts"),
+            "warm_overhead": blob.get("warm_overhead"),
+            "warm_components": blob.get("warm_components"),
+            "component_order": list(COMPONENT_ORDER),
+            "per_request": [
+                {"rid": r.get("rid"),
+                 "client_wall_s": r.get("client_wall_s"),
+                 "cache": r.get("cache"),
+                 "verdict": r.get("verdict"),
+                 "fractions": r.get("fractions"),
+                 "residual_s": r.get("residual_s")}
+                for r in (blob.get("per_request") or [])[:12]]})
+    return rows
+
+
 def build_payload(history_root: str = ".",
                   trace_paths: list[str] | None = None) -> dict:
     """The dashboard's inlined data: bench/multichip history + tuner
@@ -457,6 +498,7 @@ def build_payload(history_root: str = ".",
             "explain": _explain_rows(history_root),
             "workload": _workload_rows(history_root, errors),
             "watch": _watch_rows(history_root, errors),
+            "flow": _flow_rows(history_root, errors),
             "trend": check_trends(history_root),
             "errors": errors}
 
@@ -509,6 +551,8 @@ time; lower is better everywhere (seconds per rep).</p>
 <div id="workload"></div>
 <h2>Monitoring (watchtower SLO + named anomalies)</h2>
 <div id="watch"></div>
+<h2>Flow tracing (client &rarr; server &rarr; round decomposition)</h2>
+<div id="flow"></div>
 <script id="data" type="application/json">{payload}</script>
 <script>
 "use strict";
@@ -1351,6 +1395,117 @@ function fmtS(v) {{
       "(obs/watch.py, seeded — float-exact vs `inspect watch`); every " +
       "root-cause verdict names its evidence stream, UNEXPLAINED " +
       "quantifies the residual — advisory only, nothing here gates"));
+}})();
+
+(function flowPane() {{
+  var host = document.getElementById("flow");
+  var rows = DATA.flow || [];
+  if (!rows.length) {{
+    host.appendChild(el("p", {{class: "note"}},
+        "no FLOW_r*.json under the history root (run `cli inspect " +
+        "flow CLIENT.journal SERVE.journal TRACE... --json " +
+        "FLOW_rNN.json` over a client-journaled loadgen run)"));
+    return;
+  }}
+  function pct(v) {{
+    return v === null || v === undefined ? "-" :
+        (v * 100).toFixed(1) + "%";
+  }}
+  rows.forEach(function (f) {{
+    var cap = el("p", {{}});
+    cap.appendChild(el("b", {{}}, f.file));
+    if (f.error) {{
+      host.appendChild(cap);
+      host.appendChild(el("p", {{class: "err"}},
+          "flow artifact error: " + f.error));
+      return;
+    }}
+    var req = f.requests || {{}};
+    var wo = f.warm_overhead;
+    cap.appendChild(document.createTextNode(
+        " (seed " + f.seed + ") — " + req.joined + " joined of " +
+        req.client + " client request(s), " +
+        (req.lost || []).length + " LOST — warm overhead " +
+        (wo ? pct(wo.mean) + " of the warm wall (n=" + wo.n +
+              (wo.ci95 ? ", 95% CI [" + pct(wo.ci95[0]) + ", " +
+                         pct(wo.ci95[1]) + "]" : "") + ")"
+            : "no warm requests")));
+    host.appendChild(cap);
+    var ig = f.integrity || {{}};
+    if (ig.client_torn_lines || ig.journal_torn_lines ||
+        ig.trace_torn_lines || (req.lost || []).length)
+      host.appendChild(el("p", {{class: "err"}},
+          "integrity: " + (ig.client_torn_lines || 0) +
+          " torn client line(s), " + (ig.journal_torn_lines || 0) +
+          " torn journal line(s), " + (ig.trace_torn_lines || 0) +
+          " torn trace line(s), LOST [" +
+          (req.lost || []).join(", ") + "]"));
+    var verd = f.verdicts || {{}};
+    var vtxt = Object.keys(verd).sort(function (a, b) {{
+      return verd[b] - verd[a] || (a < b ? -1 : 1);
+    }}).map(function (v) {{ return v + " \\u00d7" + verd[v]; }});
+    if (vtxt.length)
+      host.appendChild(el("p", {{}}, "verdicts: " + vtxt.join(", ")));
+    // warm component fractions: where the warm walls go, as bars
+    var wc = f.warm_components || {{}};
+    var order = f.component_order || Object.keys(wc).sort();
+    var any = order.some(function (c) {{ return wc[c]; }});
+    if (any) {{
+      var ct = el("table");
+      var ch = el("tr");
+      ["component", "warm mean fraction", "", "n"].forEach(
+          function (h, i) {{
+        ch.appendChild(el("th", i === 0 || i === 2 ?
+            {{class: "l"}} : {{}}, h)); }});
+      ct.appendChild(ch);
+      order.forEach(function (c) {{
+        var b = wc[c];
+        if (!b) return;
+        var tr = el("tr");
+        tr.appendChild(el("td", {{class: "l"}}, c));
+        tr.appendChild(el("td", {{}}, pct(b.mean_fraction)));
+        var bar = el("td", {{class: "l"}});
+        var sw = el("span", {{class: "swatch"}});
+        sw.style.width = Math.max(1,
+            Math.round((b.mean_fraction || 0) * 160)) + "px";
+        sw.style.background = COLORS[0];
+        bar.appendChild(sw);
+        tr.appendChild(bar);
+        tr.appendChild(el("td", {{}}, String(b.n)));
+        ct.appendChild(tr);
+      }});
+      host.appendChild(ct);
+    }}
+    if ((f.per_request || []).length) {{
+      var rt = el("table");
+      var rh = el("tr");
+      var comps = f.component_order || [];
+      ["rid", "client wall", "cache", "verdict"].concat(comps)
+          .forEach(function (h, i) {{
+        rh.appendChild(el("th", i === 2 || i === 3 ?
+            {{class: "l"}} : {{}}, h)); }});
+      rt.appendChild(rh);
+      f.per_request.forEach(function (r) {{
+        var tr = el("tr");
+        tr.appendChild(el("td", {{}}, String(r.rid)));
+        tr.appendChild(el("td", {{}}, fmtS(r.client_wall_s)));
+        tr.appendChild(el("td", {{class: "l"}}, r.cache || "-"));
+        tr.appendChild(el("td", {{class: "l"}}, r.verdict || "-"));
+        comps.forEach(function (c) {{
+          tr.appendChild(el("td", {{}}, pct((r.fractions || {{}})[c])));
+        }});
+        rt.appendChild(tr);
+      }});
+      host.appendChild(rt);
+    }}
+  }});
+  host.appendChild(el("p", {{class: "note"}},
+      "decompositions join the client stamp journal, the serve " +
+      "journal's phase boundaries and the flight-recorder round walls " +
+      "by correlation id (obs/flow.py, jax-free — every number " +
+      "re-derives float-exactly via `inspect flow --replay`); the " +
+      "residual is quantified, never absorbed — advisory only, " +
+      "nothing here gates"));
 }})();
 </script></body></html>
 """

@@ -32,12 +32,13 @@ is a single ``is None`` check. Nothing in this module imports jax.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 
 __all__ = ["TraceRecorder", "aggregate_run", "current", "disable", "enable",
-           "enabled", "flush", "hbm_sample", "instant", "span",
-           "summarize_trace", "summarize_events", "load_events",
+           "enabled", "flush", "hbm_sample", "instant", "run_context",
+           "span", "summarize_trace", "summarize_events", "load_events",
            "round_key", "WHOLE_REP", "BUCKET_FIELDS"]
 
 #: ``round`` value of a slice that covers the whole rep (attributions with
@@ -201,7 +202,7 @@ class TraceRecorder:
             combine = "sum"
         round_bytes = _round_bytes(schedule)
         round_traffic = _round_traffic(schedule)
-        self._events.append({
+        run_event = {
             "ev": "run", "id": run_id, "method": method, "name": name,
             "iter": iter_, "ntimes": ntimes, "nprocs": p.nprocs,
             "data_size": p.data_size, "comm_size": p.comm_size,
@@ -210,7 +211,10 @@ class TraceRecorder:
             "backend": requested, "executed": executed,
             "phase_source": phase_source, "combine": combine,
             "round_bytes": round_bytes, "round_traffic": round_traffic,
-            "fault": fault})
+            "fault": fault}
+        for k, v in _RUN_EXTRA.items():
+            run_event.setdefault(k, v)   # context extras never shadow core
+        self._events.append(run_event)
 
         if calls:
             for rep in range(ntimes):
@@ -543,6 +547,31 @@ def summarize_events(events: list[dict]) -> str:
 # Module-level recorder (one active tracing session, like logging's root).
 
 _RECORDER: TraceRecorder | None = None
+
+#: Extra key/value pairs merged into run events recorded while a
+#: :func:`run_context` block is active — the causal-correlation channel:
+#: the serve layer stamps its batch correlation id (``cid``) here so the
+#: flow joiner (obs/flow.py) can tie a request's journal record to the
+#: run event of the dispatch that served it. Extras never shadow core
+#: run-event fields (``setdefault`` merge).
+_RUN_EXTRA: dict = {}
+
+
+@contextlib.contextmanager
+def run_context(**extra):
+    """Merge ``extra`` into every run event recorded inside the block.
+
+    Works whether or not tracing is armed (the recorder reads the module
+    dict at record time); nested contexts stack, innermost wins, and the
+    previous extras are restored on exit — the same discipline as
+    ``harness.attribution.cell_recording``."""
+    global _RUN_EXTRA
+    prev = _RUN_EXTRA
+    _RUN_EXTRA = {**prev, **extra}
+    try:
+        yield
+    finally:
+        _RUN_EXTRA = prev
 
 
 def enable() -> TraceRecorder:

@@ -68,7 +68,11 @@ WATCH_SCHEMA = "watch-v1"
 
 #: Every evidence stream an attribution verdict may cite. "none" is the
 #: UNEXPLAINED residual — still a named verdict, never a bare anomaly.
-EVIDENCE_STREAMS = ("ledger", "resilience", "shed", "explain", "none")
+#: "flow" is the causal-flow join (obs/flow.py): a committed FLOW_r*
+#: artifact's per-request dominant-component verdicts, consulted when a
+#: request-wall step coincides with a dominant-component shift.
+EVIDENCE_STREAMS = ("ledger", "resilience", "shed", "explain", "flow",
+                    "none")
 
 # -- detection constants (the trend-gate discipline: conservative,
 # seeded, documented) -------------------------------------------------------
@@ -412,7 +416,8 @@ def attribute_anomaly(detection: dict, *, rows: list[dict],
     """One NAMED root-cause verdict for one confirmed changepoint.
 
     Evidence is consulted in a fixed order (ledger → resilience → shed
-    → explain), each check derived from blob-representable inputs only,
+    → explain → flow), each check derived from blob-representable inputs
+    only,
     so ``validate_watch`` re-runs this exact function over a committed
     artifact's own rows + evidence blocks and refuses a verdict they
     contradict. The fallback is ``UNEXPLAINED`` with the residual step
@@ -517,6 +522,29 @@ def attribute_anomaly(detection: dict, *, rows: list[dict],
                                f"rounds UNEXPLAINED{devtxt} — outside "
                                f"its physics")}
 
+    # -- flow: dominant-component shift across the step --------------------
+    fl = evidence.get("flow") or {}
+    doms = fl.get("dominants") or []
+    if after is not None and doms:
+        def _mode(rids):
+            vals = [d.get("verdict") for d in doms
+                    if d.get("rid") in rids and d.get("verdict")]
+            return (max(sorted(set(vals)), key=vals.count)
+                    if vals else None)
+        mb = _mode({r["rid"] for r in before})
+        ma = _mode({r["rid"] for r in after})
+        if mb is not None and ma is not None and mb != ma:
+            return {"cause": f"dominant-shift:{mb}->{ma}",
+                    "evidence": "flow",
+                    "detail": (f"the flow decomposition's modal "
+                               f"dominant component shifts {mb} -> {ma} "
+                               f"across the step ({fl.get('artifact')} "
+                               f"per-request verdicts)")}
+
+    # the fallback detail keeps naming the original four streams
+    # verbatim: committed WATCH artifacts pin this string byte-for-byte
+    # (replay_watch), and "flow" only ever fires above when its
+    # evidence block is present
     return {"cause": "UNEXPLAINED", "evidence": "none",
             "detail": (f"residual {detection['delta_rel']:+.0%} step in "
                        f"the {detection['direction']} direction — no "
@@ -565,13 +593,19 @@ def _explain_rounds(path: str, predict_path: str) -> dict:
 
 def watch_streams(journal_paths, trace_paths=(), *, slo: dict | None = None,
                   slo_source: str = "default", seed: int = 0,
-                  predict_path: str | None = None) -> dict:
+                  predict_path: str | None = None,
+                  flow_path: str | None = None) -> dict:
     """The whole watchtower pass: tail → evaluate → detect → attribute.
 
     Returns the watch-v1 body minus the artifact envelope (schema/
     manifest/created_unix, added by :func:`write_watch`). Deterministic
     by construction: a pure function of (streams, slo, seed, predict
-    artifact) — the replay gate depends on it."""
+    artifact, flow artifact) — the replay gate depends on it.
+    ``flow_path`` joins a committed FLOW_r*.json's per-request dominant
+    verdicts as the ``flow`` evidence stream (a request-wall step that
+    coincides with a dominant-component shift attributes by name
+    instead of UNEXPLAINED); the evidence block is only present when
+    the artifact was given, so flow-less artifacts stay byte-stable."""
     journal_paths = list(journal_paths)
     trace_paths = list(trace_paths)
     if slo is None:
@@ -618,6 +652,15 @@ def watch_streams(journal_paths, trace_paths=(), *, slo: dict | None = None,
                     retries["sites"].append(site)
     evidence = {"sessions": sessions, "states": scan["states"],
                 "resilience_retries": retries}
+    if flow_path is not None:
+        with open(flow_path) as fh:
+            fblob = json.load(fh)
+        evidence["flow"] = {
+            "artifact": os.path.basename(flow_path),
+            "dominants": [{"rid": r.get("rid"),
+                           "verdict": r.get("verdict")}
+                          for r in fblob.get("per_request") or []
+                          if isinstance(r, dict) and r.get("verdict")]}
 
     explain: dict = {}
     if predict_path is not None:
@@ -659,6 +702,8 @@ def watch_streams(journal_paths, trace_paths=(), *, slo: dict | None = None,
         "traces": [os.path.basename(p) for p in trace_paths],
         "predict": os.path.basename(predict_path)
         if predict_path is not None else None,
+        "flow": os.path.basename(flow_path)
+        if flow_path is not None else None,
         "slo": slo, "slo_source": slo_source,
         "requests": scan["requests"],
         "integrity": {"journal_torn_lines": scan["skipped_lines"],
@@ -725,12 +770,16 @@ def replay_watch(path: str) -> dict:
     predict = None
     if blob.get("predict") is not None:
         predict = _resolve([blob["predict"]], "predict artifact")[0]
+    flow = None
+    if blob.get("flow") is not None:
+        flow = _resolve([blob["flow"]], "flow artifact")[0]
     if problems:
         return {"verdict": "MISMATCH", "problems": problems}
     rederived = watch_streams(
         journals, traces, slo=blob.get("slo"),
         slo_source=blob.get("slo_source", "default"),
-        seed=blob.get("seed", 0), predict_path=predict)
+        seed=blob.get("seed", 0), predict_path=predict,
+        flow_path=flow)
     want = {k: v for k, v in blob.items() if k not in _ENVELOPE}
     for k in sorted(set(want) | set(rederived)):
         a = json.dumps(want.get(k), sort_keys=True)

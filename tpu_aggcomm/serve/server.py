@@ -948,18 +948,22 @@ class ScheduleServer:
         # same backend (one CPU core — concurrent measured workloads
         # corrupt each other's differenced timings)
         from tpu_aggcomm.tune.measure import serve_dispatch_inflight
+        rec = trace.current()
         try:
             with serve_dispatch_inflight(head.backend_name), \
-                    trace.span("serve.batch", seq=seq, n=len(batch),
+                    trace.span("serve.batch", seq=seq, cid=f"b{seq}",
+                               n=len(batch),
                                backend=head.backend_name,
                                method=head.schedule.method_id,
                                padded=padded,
                                rids=[p.rid for p in batch]):
+                t_disp = time.perf_counter()
                 results = retry_call(
                     lambda: executor.execute_batch(
                         chain, [p.req for p in batch]),
                     site=f"serve:dispatch:b{seq}",
                     policy=self._retry_policy)
+                disp_wall = time.perf_counter() - t_disp
         except Exception as e:  # lint: broad-ok (fault isolation: a dispatch error is the batch's response, never the server's death)
             if retries_exhausted(e):
                 self._enter_degraded(
@@ -969,6 +973,8 @@ class ScheduleServer:
                              f"dispatch failed: {type(e).__name__}: {e}",
                              seq=seq, padded=padded)
             return
+        if rec is not None:   # one armed-recorder check on the hot path
+            self._record_dispatch_run(rec, head, seq, disp_wall)
         for p in batch:
             p.mark("dispatch")
         for p, res in zip(batch, results):
@@ -976,6 +982,42 @@ class ScheduleServer:
                          compile_s=compile_s, verified=res["verified"],
                          error=res["error"], batch_seq=seq,
                          batch_padded=padded)
+
+    def _record_dispatch_run(self, rec, head: _Pending, seq: int,
+                             wall_s: float) -> None:
+        """One ATTRIBUTED run event per traced batch dispatch, stamped
+        with the batch correlation id (``cid="b<seq>"``) via
+        ``trace.run_context`` — the hook the flow joiner (obs/flow.py)
+        uses to tie a request's journal record to the round timeline of
+        the dispatch that served it. The measured host wall around the
+        dispatch is split by the fenced-segment model
+        (``harness.attribution.attribute_total`` — contextlib/numpy/core
+        only, never jax: the control plane stays pure) and labelled
+        ``"attributed"`` (report.py:PHASE_SOURCES), never oversold as
+        measured rounds. Called only when the recorder is armed; a
+        recording failure must never sink the batch it describes."""
+        try:
+            from tpu_aggcomm.harness.attribution import (attribute_total,
+                                                         cell_recording)
+            try:
+                from tpu_aggcomm.core.methods import METHODS
+                name = METHODS[head.schedule.method_id].name
+            except (ImportError, KeyError):
+                name = f"m{head.schedule.method_id}"
+            with cell_recording() as calls:
+                timers = attribute_total(head.schedule, wall_s)
+            with trace.run_context(cid=f"b{seq}"):
+                rec.record_method_run(
+                    head.schedule, method=head.schedule.method_id,
+                    name=name, iter_=seq, ntimes=1,
+                    requested=head.backend_name,
+                    executed=head.backend_name,
+                    phase_source="attributed", timers=timers,
+                    calls=calls,
+                    fault=getattr(head.schedule, "fault", None))
+        except Exception as e:  # lint: broad-ok (observability enrichment must never sink the batch it describes)
+            print(f"serve: dispatch trace record failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
 
     def _finish(self, p: _Pending, *, batch_n: int, disposition: str,
                 compile_s, verified, error, batch_seq: int,
@@ -1022,9 +1064,14 @@ class ScheduleServer:
                 cache=disposition, deadline_ms=p.req.deadline_ms,
                 batch={"seq": batch_seq, "n": batch_n,
                        "padded": batch_padded})
+        # the batch correlation id rides in BOTH the journal record and
+        # the trace instant (satellite of the flow contract: when the
+        # journal tail is torn, inspect flow can still join on traces
+        # alone) and matches the run event's run_context cid exactly
+        cid = f"b{batch_seq}"
         trace.instant("serve.request", rid=p.rid, ok=ok,
                       backend=p.backend_name, cache=disposition,
-                      batch_seq=batch_seq, batch_n=batch_n,
+                      batch_seq=batch_seq, batch_n=batch_n, cid=cid,
                       wall_s=latency, phases=dict(p.marks))
         if self._journal is not None:
             self._journal.record(
@@ -1035,7 +1082,7 @@ class ScheduleServer:
                 iter=p.req.iter_, latency_s=latency, batch_n=batch_n,
                 cache=disposition, error=error, phases=dict(p.marks),
                 batch_seq=batch_seq, batch_padded=batch_padded,
-                queue_depth=p.depth_at_admit)
+                cid=cid, queue_depth=p.depth_at_admit)
         p.event.set()
 
     # -- stats -------------------------------------------------------------
